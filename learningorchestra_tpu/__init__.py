@@ -1,0 +1,34 @@
+"""learningorchestra_tpu — a TPU-native ML pipeline-orchestration framework.
+
+A ground-up, TPU-first re-design of the capabilities of
+joaoderocha/learningOrchestra (reference mounted at /root/reference): a
+REST-fronted system where every step of an ML pipeline — dataset ingest,
+transform, explore, model, tune, train, evaluate, predict, arbitrary
+functions, whole-pipeline builders — runs as an asynchronous, stateful,
+individually re-executable job over named, lineage-tracked artifacts.
+
+Where the reference wires Flask microservices to Scikit-learn / TensorFlow /
+Spark MLlib containers and distributes training with Horovod-on-Ray (Gloo
+ring-allreduce), this framework is designed for TPUs from the start:
+
+- compute is JAX/XLA: jitted train loops, Flax model zoo, JAX-native
+  classical estimators (no sklearn/TF on the hot path);
+- data parallelism is a sharding annotation (`pjit` / `shard_map` over a
+  `jax.sharding.Mesh`), with XLA emitting ICI collectives — replacing the
+  reference's host-side Horovod ring (reference:
+  microservices/binary_executor_image/training_function/train_function.py);
+- long-context is first-class: ring attention over a sequence mesh axis;
+- multi-host runs over DCN via `jax.distributed.initialize`, orchestrated by
+  the framework's own coordinator instead of Ray
+  (reference: microservices/binary_executor_image/server.py:13-17);
+- artifacts keep the reference's contract — named collections whose document
+  `_id=0` is the metadata record with `finished` + lineage
+  (reference: microservices/database_api_image/utils.py:50-63) — but are
+  stored in an embedded, thread-safe document store instead of MongoDB.
+"""
+
+__version__ = "0.1.0"
+
+from learningorchestra_tpu.config import Config, get_config, set_config
+
+__all__ = ["Config", "get_config", "set_config", "__version__"]

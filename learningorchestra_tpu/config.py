@@ -1,0 +1,156 @@
+"""Typed configuration tree.
+
+The reference spreads configuration over three tiers — Dockerfile/compose env
+vars, per-service ``constants.py`` modules, and hard-coded tuning in source
+(reference: microservices/binary_executor_image/constants.py,
+docker-compose.yml:20-24, builder_image/server.py:57-62).  Here there is one
+typed tree covering the store backend, volume roots, API server, mesh shape
+and job-engine sizing, overridable from the environment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from pathlib import Path
+
+
+@dataclasses.dataclass
+class StoreConfig:
+    """Where artifacts live."""
+
+    # Root directory for the document store (collections + WAL files).
+    root: str = "~/.learningorchestra_tpu/store"
+    # Root for volume-backed binaries.  The reference keys binary paths by
+    # service type onto six named Docker volumes
+    # (reference: microservices/binary_executor_image/Dockerfile:10-13).
+    volume_root: str = "~/.learningorchestra_tpu/volumes"
+    # fsync appends on every write (durable) vs. rely on OS flush (fast).
+    durable_writes: bool = False
+
+    def store_path(self) -> Path:
+        return Path(os.path.expanduser(self.root))
+
+    def volume_path(self) -> Path:
+        return Path(os.path.expanduser(self.volume_root))
+
+
+@dataclasses.dataclass
+class APIConfig:
+    """REST front server (single entry point, replacing the KrakenD gateway +
+    9 Flask containers; reference: microservices/krakend/krakend.json)."""
+
+    host: str = "0.0.0.0"
+    port: int = 80
+    # Reference gateway budget: 10s timeout, 300s cache (krakend.json tail).
+    request_timeout_s: float = 10.0
+    cache_ttl_s: float = 300.0
+    # GET pagination cap (reference: database_api_image/constants.py:42-44).
+    page_limit_max: int = 100
+    page_limit_default: int = 20
+    api_prefix: str = "/api/learningOrchestra/v1"
+
+
+@dataclasses.dataclass
+class JobConfig:
+    """Async job engine sizing."""
+
+    max_workers: int = 8
+    # Reference Ray placement-group timeout
+    # (binary_executor_image/server.py:16).
+    start_timeout_s: float = 120.0
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Logical device-mesh shape for distributed execution.
+
+    Axis names are fixed framework-wide:
+      - ``dp``: data parallelism (batch sharding)
+      - ``fsdp``: parameter sharding within the data axis (zero-style)
+      - ``tp``: tensor parallelism (feature/head sharding)
+      - ``sp``: sequence/context parallelism (ring attention)
+      - ``pp``: pipeline stages
+    A dimension of 0 means "auto": fill with remaining devices on dp.
+    """
+
+    dp: int = 0
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    pp: int = 1
+
+    axis_names: tuple = ("dp", "fsdp", "pp", "tp", "sp")
+
+    def shape(self, n_devices: int) -> dict:
+        fixed = self.fsdp * self.tp * self.sp * self.pp
+        dp = self.dp
+        if dp == 0:
+            if n_devices % max(fixed, 1) != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {fixed}"
+                )
+            dp = n_devices // max(fixed, 1)
+        return {
+            "dp": dp,
+            "fsdp": self.fsdp,
+            "pp": self.pp,
+            "tp": self.tp,
+            "sp": self.sp,
+        }
+
+
+@dataclasses.dataclass
+class DistributedConfig:
+    """Multi-host (DCN) bootstrap — replaces Ray GCS + client
+    (reference: binary_executor_image/start.sh:7, server.py:13-17)."""
+
+    coordinator_address: str | None = None  # "host:port" of process 0
+    num_processes: int = 1
+    process_id: int = 0
+    agent_port: int = 7077  # per-host agent control port
+
+
+@dataclasses.dataclass
+class Config:
+    store: StoreConfig = dataclasses.field(default_factory=StoreConfig)
+    api: APIConfig = dataclasses.field(default_factory=APIConfig)
+    jobs: JobConfig = dataclasses.field(default_factory=JobConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    dist: DistributedConfig = dataclasses.field(
+        default_factory=DistributedConfig
+    )
+
+    @staticmethod
+    def from_env() -> "Config":
+        """Build a config from LO_TPU_* environment variables."""
+        cfg = Config()
+        env = os.environ
+        if "LO_TPU_STORE_ROOT" in env:
+            cfg.store.root = env["LO_TPU_STORE_ROOT"]
+        if "LO_TPU_VOLUME_ROOT" in env:
+            cfg.store.volume_root = env["LO_TPU_VOLUME_ROOT"]
+        if "LO_TPU_API_PORT" in env:
+            cfg.api.port = int(env["LO_TPU_API_PORT"])
+        if "LO_TPU_MAX_WORKERS" in env:
+            cfg.jobs.max_workers = int(env["LO_TPU_MAX_WORKERS"])
+        return cfg
+
+
+_lock = threading.Lock()
+_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _config
+    with _lock:
+        if _config is None:
+            _config = Config.from_env()
+        return _config
+
+
+def set_config(cfg: Config) -> None:
+    global _config
+    with _lock:
+        _config = cfg

@@ -1,0 +1,177 @@
+"""The request-parameter DSL.
+
+The reference rewrites request JSON values before calling toolkit methods
+(``Parameters.treat``, duplicated across four services — reference:
+microservices/binary_executor_image/binary_execution.py:13-97,
+database_executor_image/database_execution.py:8-89, model_image/model.py:8-89,
+code_executor_image/code_execution.py:24-105):
+
+- ``"$name"``   → load artifact ``name`` (dataset collection → DataFrame, or
+  volume binary);
+- ``"$name.key"`` → load artifact then index ``instance[key]``;
+- ``"#<python expr>"`` → **exec** the string and pass the resulting object
+  (used for optimizers, layers, callbacks).
+
+This framework keeps the ``$`` forms verbatim and re-scopes ``#``: instead
+of arbitrary ``exec`` inside the service process, a ``#`` value is a Python
+*expression* evaluated with no builtins against a whitelisted namespace of
+framework modules (optax, flax.linen, jax.numpy, numpy, the model zoo and
+estimator registry).  That covers the reference's real uses —
+``#optax.adam(1e-3)``, ``#nn.relu``, ``#[nn.Dense(128), nn.relu]`` — while
+the truly-arbitrary-code contract lives only in the ``function/python``
+service (SURVEY §7 "hard parts": the exec boundary is design, not code).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Protocol
+
+_DOLLAR_RE = re.compile(r"^\$(?P<name>[A-Za-z0-9_.\-]+)$")
+
+
+class ArtifactLoader(Protocol):
+    """How the DSL turns ``$name`` into an object.  Implemented by the
+    service layer over the store + volumes."""
+
+    def load(self, name: str) -> Any: ...
+
+
+class DSLResolutionError(Exception):
+    pass
+
+
+def _spec_namespace() -> dict:
+    """Whitelisted namespace for ``#`` expressions.  Imports are local so
+    the DSL module stays importable without JAX for host-only tooling."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from flax import linen as nn
+
+    from learningorchestra_tpu import models as zoo
+    from learningorchestra_tpu.toolkit import registry
+
+    ns: dict[str, Any] = {
+        "jax": jax,
+        "jnp": jnp,
+        "np": np,
+        "numpy": np,
+        "optax": optax,
+        "nn": nn,
+        "zoo": zoo,
+        "True": True,
+        "False": False,
+        "None": None,
+    }
+    # Every registered estimator/model constructor is addressable by its
+    # class name, e.g. "#LogisticRegression(max_iter=50)".
+    ns.update(registry.constructors())
+    return ns
+
+
+def evaluate_spec(expr: str, extra_namespace: dict | None = None) -> Any:
+    """Evaluate a ``#`` spec expression against the whitelisted namespace.
+
+    The reference's equivalent rewrites ``#x = <code>`` into
+    ``class_instance = <code>`` and ``exec``s it
+    (binary_execution.py:59-72); here it is a single expression with
+    ``__builtins__`` stripped.
+    """
+    if "__" in expr:
+        # Dunder access would let a spec walk ().__class__.__mro__ out of
+        # the sandbox; no legitimate optimizer/layer spec needs it.
+        raise DSLResolutionError(
+            f"spec {expr!r} rejected: double underscores are not allowed"
+        )
+    ns = _spec_namespace()
+    if extra_namespace:
+        ns.update(extra_namespace)
+    try:
+        return eval(expr, {"__builtins__": {}}, ns)  # noqa: S307
+    except Exception as exc:
+        raise DSLResolutionError(
+            f"cannot evaluate spec {expr!r}: {exc!r}"
+        ) from exc
+
+
+def resolve_value(
+    value: Any,
+    loader: ArtifactLoader,
+    spec_namespace: dict | None = None,
+) -> Any:
+    """Resolve one request-JSON value per the DSL rules.
+
+    Mirrors ``Parameters.treat``: strings starting with ``$`` load
+    artifacts, ``$name.key`` indexes into the loaded object, ``#`` evaluates
+    a spec; lists and dicts resolve element-wise
+    (binary_execution.py:26-31 treats lists; dicts are an extension so
+    nested kwargs like ``{"optimizer": "#optax.adam(1e-3)"}`` work).
+    """
+    if isinstance(value, str):
+        if value.startswith("$"):
+            body = value[1:]
+            if not _DOLLAR_RE.match(value):
+                raise DSLResolutionError(f"bad artifact reference {value!r}")
+            if "." in body:
+                # Names may legitimately contain dots ("titanic.csv"), so
+                # prefer the whole body as an artifact name and only fall
+                # back to the reference's name.key split
+                # (binary_executor_image/utils.py:332-336) if that misses.
+                try:
+                    return loader.load(body)
+                except KeyError:
+                    pass
+                name, key = body.split(".", 1)
+                instance = loader.load(name)
+                return _index(instance, key)
+            return loader.load(body)
+        if value.startswith("#"):
+            return evaluate_spec(value[1:], spec_namespace)
+        return value
+    if isinstance(value, list):
+        return [resolve_value(v, loader, spec_namespace) for v in value]
+    if isinstance(value, dict):
+        return {
+            k: resolve_value(v, loader, spec_namespace)
+            for k, v in value.items()
+        }
+    return value
+
+
+def resolve_params(
+    params: dict | None,
+    loader: ArtifactLoader,
+    spec_namespace: dict | None = None,
+) -> dict:
+    if not params:
+        return {}
+    return {
+        k: resolve_value(v, loader, spec_namespace)
+        for k, v in params.items()
+    }
+
+
+def _index(instance: Any, key: str) -> Any:
+    """``$name.key`` indexing: tuple/list positions by int, mappings and
+    DataFrames by key (binary_executor_image/utils.py:332-336)."""
+    try:
+        if isinstance(instance, (tuple, list)):
+            return instance[int(key)]
+        return instance[key]
+    except Exception as exc:
+        raise DSLResolutionError(
+            f"cannot index loaded artifact with {key!r}: {exc!r}"
+        ) from exc
+
+
+def split_special_params(
+    params: dict | None, special_keys: tuple[str, ...]
+) -> tuple[dict, dict]:
+    """Split request params into (special, rest) — the pattern the
+    distributed path uses to peel ``callbacks``/``rank0callbacks`` off
+    training kwargs (binary_execution.py:246-255)."""
+    params = dict(params or {})
+    special = {k: params.pop(k) for k in special_keys if k in params}
+    return special, params

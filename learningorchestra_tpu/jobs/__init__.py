@@ -1,0 +1,7 @@
+"""Async job engine — replaces the reference's per-service
+``ThreadPoolExecutor.submit(__pipeline)`` pattern (e.g. reference:
+microservices/binary_executor_image/binary_execution.py:139,155-186)."""
+
+from learningorchestra_tpu.jobs.engine import JobEngine, JobState
+
+__all__ = ["JobEngine", "JobState"]

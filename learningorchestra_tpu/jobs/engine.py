@@ -1,0 +1,197 @@
+"""The async job engine.
+
+Every reference service runs its pipeline body on a bare thread pool and
+signals completion by flipping the ``finished`` boolean in the metadata doc,
+recording exceptions as execution documents (reference:
+binary_executor_image/binary_execution.py:155-186,
+code_executor_image/code_execution.py:149-196 which also captures stdout).
+
+This engine keeps that durable contract but adds what the reference lacks
+(SURVEY §5.3):
+- explicit job states (pending → running → finished | failed | cancelled)
+  persisted in the metadata doc as ``jobState``;
+- a process-local registry of live jobs so status/wait/cancel work without
+  polling the store;
+- structured retry for preemptible hardware: a job function may raise
+  ``Preempted`` to request re-execution (TPU preemption is a first-class
+  event, not a crash).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import threading
+import traceback
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable
+
+from learningorchestra_tpu.store import ArtifactStore
+
+
+class JobState:
+    PENDING = "pending"
+    RUNNING = "running"
+    FINISHED = "finished"
+    FAILED = "failed"
+    CANCELLED = "cancelled"
+
+
+class Preempted(Exception):
+    """Raised by a job body to request re-execution after preemption."""
+
+
+class JobEngine:
+    def __init__(
+        self,
+        artifacts: ArtifactStore,
+        max_workers: int = 8,
+        max_preemption_retries: int = 3,
+    ):
+        self.artifacts = artifacts
+        self.pool = ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="lo-job"
+        )
+        self.max_preemption_retries = max_preemption_retries
+        self._futures: dict[str, Future] = {}
+        self._last_tracebacks: dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    # -- submission -----------------------------------------------------------
+
+    def submit(
+        self,
+        name: str,
+        fn: Callable[[], Any],
+        *,
+        description: str | None = None,
+        method: str | None = None,
+        parameters: Any = None,
+        capture_stdout: bool = False,
+        on_success: Callable[[Any], dict | None] | None = None,
+    ) -> Future:
+        """Run ``fn`` asynchronously as the job for artifact ``name``.
+
+        The artifact's metadata document must already exist (services create
+        it before submitting, exactly as the reference creates metadata then
+        spawns the thread — the HTTP response returns before the work runs).
+
+        ``on_success(result)`` may return extra metadata fields to merge into
+        the finished metadata doc (e.g. result row counts, checkpoint paths).
+        """
+
+        def run() -> Any:
+            meta = self.artifacts.metadata
+            ledger = self.artifacts.ledger
+            attempts = 0
+            while True:
+                meta.mark_running(name)
+                buf = io.StringIO()
+                try:
+                    if capture_stdout:
+                        with contextlib.redirect_stdout(buf):
+                            result = fn()
+                    else:
+                        result = fn()
+                except Preempted:
+                    attempts += 1
+                    ledger.record(
+                        name,
+                        description=description,
+                        method=method,
+                        parameters=parameters,
+                        state="preempted",
+                        stdout=buf.getvalue() if capture_stdout else None,
+                    )
+                    if attempts <= self.max_preemption_retries:
+                        continue
+                    meta.mark_failed(name, "Preempted (retries exhausted)")
+                    return None
+                except BaseException as exc:  # jobs must never kill workers
+                    err = repr(exc)
+                    meta.mark_failed(name, err)
+                    ledger.record(
+                        name,
+                        description=description,
+                        method=method,
+                        parameters=parameters,
+                        state=JobState.FAILED,
+                        exception=err,
+                        stdout=buf.getvalue() if capture_stdout else None,
+                    )
+                    # Keep the traceback reachable for debugging without
+                    # crashing the pool thread.
+                    self._last_tracebacks[name] = traceback.format_exc()
+                    return None
+
+                extra = on_success(result) if on_success else None
+                meta.mark_finished(name, extra or None)
+                ledger.record(
+                    name,
+                    description=description,
+                    method=method,
+                    parameters=parameters,
+                    state=JobState.FINISHED,
+                    stdout=buf.getvalue() if capture_stdout else None,
+                )
+                return result
+
+        future = self.pool.submit(run)
+        with self._lock:
+            self._futures[name] = future
+            self._prune_locked()
+        return future
+
+    # Cap retained completed futures/tracebacks so a long-lived API process
+    # doesn't accumulate every past job's result object.
+    _MAX_DONE_RETAINED = 128
+
+    def _prune_locked(self) -> None:
+        done = [n for n, f in self._futures.items() if f.done()]
+        excess = len(done) - self._MAX_DONE_RETAINED
+        for name in done[:max(excess, 0)]:
+            self._futures.pop(name, None)
+            self._last_tracebacks.pop(name, None)
+
+    # -- status / control -----------------------------------------------------
+
+    def state(self, name: str) -> str:
+        meta = self.artifacts.metadata.read(name)
+        if meta is None:
+            raise KeyError(name)
+        return meta.get(
+            "jobState",
+            JobState.FINISHED if meta.get("finished") else JobState.PENDING,
+        )
+
+    def wait(self, name: str, timeout: float | None = None) -> Any:
+        """Block until the job for ``name`` completes; returns its result.
+
+        (Clients normally poll GET instead — this is for in-process callers
+        and tests.)
+        """
+        with self._lock:
+            future = self._futures.get(name)
+        if future is None:
+            return None
+        return future.result(timeout=timeout)
+
+    def cancel(self, name: str) -> bool:
+        """Cancel if not yet started (running jobs are not interruptible —
+        same as the reference, where a running job dies only with its
+        container; SURVEY §5.3)."""
+        with self._lock:
+            future = self._futures.get(name)
+        if future is not None and future.cancel():
+            self.artifacts.metadata.update(
+                name, {"jobState": JobState.CANCELLED, "finished": False}
+            )
+            return True
+        return False
+
+    def running_jobs(self) -> list[str]:
+        with self._lock:
+            return [n for n, f in self._futures.items() if not f.done()]
+
+    def shutdown(self, wait: bool = True) -> None:
+        self.pool.shutdown(wait=wait)

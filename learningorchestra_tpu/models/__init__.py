@@ -1,0 +1,30 @@
+"""Flax model zoo.
+
+Replaces the neural-network surface the reference reaches through
+``tensorflow.keras`` — both user-defined keras models shipped as JSON
+(reference: microservices/binary_executor_image/binary_execution.py:248-251)
+and pre-trained ``keras.applications`` classes instantiated by the model
+service (model_image/model.py:92-162).  Every zoo entry is a Flax module
+wrapped in a :class:`~learningorchestra_tpu.train.neural.NeuralEstimator`,
+which provides the keras-like ``fit/evaluate/predict`` methods the executor
+layer drives by reflection.
+"""
+
+from learningorchestra_tpu.models.mlp import MLPClassifier, MLPRegressor
+from learningorchestra_tpu.models.vision import MnistCNN, ResNet18, ResNet50
+from learningorchestra_tpu.models.text import (
+    LSTMClassifier,
+    TransformerClassifier,
+    BertModel,
+)
+
+__all__ = [
+    "MLPClassifier",
+    "MLPRegressor",
+    "MnistCNN",
+    "ResNet18",
+    "ResNet50",
+    "LSTMClassifier",
+    "TransformerClassifier",
+    "BertModel",
+]

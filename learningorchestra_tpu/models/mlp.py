@@ -1,0 +1,67 @@
+"""MLP classifier/regressor — the generic dense-net workhorse.
+
+Stands in for both ``sklearn.neural_network.MLPClassifier`` and small
+user-defined keras Sequential models the reference ships as JSON
+(reference: microservices/binary_executor_image/binary_execution.py:248-251).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from flax import linen as nn
+
+from learningorchestra_tpu.toolkit.registry import register
+from learningorchestra_tpu.train.neural import NeuralEstimator
+
+_MODULE = "learningorchestra_tpu.models.mlp"
+
+
+class _MLP(nn.Module):
+    features: tuple
+    out_dim: int
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        for width in self.features:
+            x = nn.relu(nn.Dense(width)(x))
+        return nn.Dense(self.out_dim)(x)
+
+
+@register(_MODULE)
+class MLPClassifier(NeuralEstimator):
+    def __init__(
+        self,
+        hidden_layer_sizes: Sequence[int] = (128, 64),
+        num_classes: int = 2,
+        learning_rate: float = 1e-3,
+        seed: int = 0,
+    ):
+        self.hidden_layer_sizes = tuple(hidden_layer_sizes)
+        self.num_classes = num_classes
+        super().__init__(
+            _MLP(features=self.hidden_layer_sizes, out_dim=num_classes),
+            loss="softmax_ce",
+            learning_rate=learning_rate,
+            seed=seed,
+        )
+
+
+@register(_MODULE)
+class MLPRegressor(NeuralEstimator):
+    def __init__(
+        self,
+        hidden_layer_sizes: Sequence[int] = (128, 64),
+        out_dim: int = 1,
+        learning_rate: float = 1e-3,
+        seed: int = 0,
+    ):
+        self.hidden_layer_sizes = tuple(hidden_layer_sizes)
+        self.out_dim = out_dim
+        super().__init__(
+            _MLP(features=self.hidden_layer_sizes, out_dim=out_dim),
+            loss="mse",
+            learning_rate=learning_rate,
+            seed=seed,
+        )

@@ -1,0 +1,205 @@
+"""Text models: LSTM sentiment classifier, Transformer encoder, BERT.
+
+Covers the reference's demo NLP workloads (IMDb sentiment — README.md:53,
+BASELINE.md config 3) and the BERT-base fine-tune target (BASELINE.md
+config 4).  Inputs are int32 token-id matrices ``(batch, seq_len)``.
+
+TPU notes: attention and the LSTM recurrence are expressed with
+``nn.scan``/`lax` control flow (static trip counts, XLA-compilable); the
+attention projections are feature-dim matmuls that shard cleanly on a
+``tp`` mesh axis.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from learningorchestra_tpu.toolkit.registry import register
+from learningorchestra_tpu.train.neural import NeuralEstimator
+
+_MODULE = "learningorchestra_tpu.models.text"
+
+
+class _LSTMClassifier(nn.Module):
+    vocab_size: int
+    embed_dim: int
+    hidden_dim: int
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, tokens):
+        tokens = tokens.astype(jnp.int32)
+        x = nn.Embed(self.vocab_size, self.embed_dim)(tokens)
+        lstm = nn.RNN(nn.OptimizedLSTMCell(self.hidden_dim))
+        x = lstm(x)  # (B, T, H)
+        # Mean-pool over non-pad positions (pad id 0).
+        mask = (tokens != 0).astype(x.dtype)[..., None]
+        pooled = (x * mask).sum(1) / jnp.maximum(mask.sum(1), 1.0)
+        return nn.Dense(self.num_classes)(pooled)
+
+
+@register(_MODULE)
+class LSTMClassifier(NeuralEstimator):
+    def __init__(
+        self,
+        vocab_size: int = 20000,
+        embed_dim: int = 128,
+        hidden_dim: int = 128,
+        num_classes: int = 2,
+        learning_rate: float = 1e-3,
+        seed: int = 0,
+    ):
+        self.vocab_size = vocab_size
+        self.embed_dim = embed_dim
+        self.hidden_dim = hidden_dim
+        self.num_classes = num_classes
+        super().__init__(
+            _LSTMClassifier(
+                vocab_size=vocab_size,
+                embed_dim=embed_dim,
+                hidden_dim=hidden_dim,
+                num_classes=num_classes,
+            ),
+            loss="softmax_ce",
+            learning_rate=learning_rate,
+            seed=seed,
+        )
+
+
+class TransformerBlock(nn.Module):
+    hidden_dim: int
+    num_heads: int
+    mlp_dim: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads,
+            qkv_features=self.hidden_dim,
+            dtype=self.dtype,
+        )(y, y, mask=mask)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.Dense(self.mlp_dim, dtype=self.dtype)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(self.hidden_dim, dtype=self.dtype)(y)
+        return x + y
+
+
+class BertEncoder(nn.Module):
+    """BERT-style bidirectional transformer encoder (pre-LN)."""
+
+    vocab_size: int = 30522
+    hidden_dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 512
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens):
+        tokens = tokens.astype(jnp.int32)
+        seq = tokens.shape[1]
+        tok = nn.Embed(self.vocab_size, self.hidden_dim, dtype=self.dtype)(
+            tokens
+        )
+        pos = nn.Embed(self.max_len, self.hidden_dim, dtype=self.dtype)(
+            jnp.arange(seq)[None, :]
+        )
+        x = tok + pos
+        pad_mask = tokens != 0  # (B, T)
+        attn_mask = pad_mask[:, None, None, :] & pad_mask[:, None, :, None]
+        for _ in range(self.num_layers):
+            x = TransformerBlock(
+                hidden_dim=self.hidden_dim,
+                num_heads=self.num_heads,
+                mlp_dim=self.mlp_dim,
+                dtype=self.dtype,
+            )(x, mask=attn_mask)
+        return nn.LayerNorm(dtype=self.dtype)(x)
+
+
+class _BertClassifier(nn.Module):
+    encoder: BertEncoder
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, tokens):
+        x = self.encoder(tokens)
+        cls = x[:, 0]  # [CLS] pooling
+        cls = jnp.tanh(nn.Dense(self.encoder.hidden_dim)(cls))
+        return nn.Dense(self.num_classes)(cls)
+
+
+@register(_MODULE)
+class BertModel(NeuralEstimator):
+    """BERT encoder + classification head (fine-tune surface).
+
+    Defaults are BERT-base (L=12, H=768, A=12) per BASELINE.md config 4;
+    shrink for tests with num_layers/hidden_dim kwargs.
+    """
+
+    def __init__(
+        self,
+        vocab_size: int = 30522,
+        hidden_dim: int = 768,
+        num_layers: int = 12,
+        num_heads: int = 12,
+        mlp_dim: int | None = None,
+        max_len: int = 512,
+        num_classes: int = 2,
+        learning_rate: float = 2e-5,
+        seed: int = 0,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_dim = hidden_dim
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.mlp_dim = mlp_dim or hidden_dim * 4
+        self.max_len = max_len
+        self.num_classes = num_classes
+        encoder = BertEncoder(
+            vocab_size=vocab_size,
+            hidden_dim=hidden_dim,
+            num_layers=num_layers,
+            num_heads=num_heads,
+            mlp_dim=self.mlp_dim,
+            max_len=max_len,
+        )
+        super().__init__(
+            _BertClassifier(encoder=encoder, num_classes=num_classes),
+            loss="softmax_ce",
+            learning_rate=learning_rate,
+            seed=seed,
+        )
+
+
+@register(_MODULE)
+class TransformerClassifier(BertModel):
+    """Small-transformer alias with test-friendly defaults."""
+
+    def __init__(
+        self,
+        vocab_size: int = 20000,
+        hidden_dim: int = 128,
+        num_layers: int = 2,
+        num_heads: int = 4,
+        max_len: int = 256,
+        num_classes: int = 2,
+        learning_rate: float = 1e-3,
+        seed: int = 0,
+    ):
+        super().__init__(
+            vocab_size=vocab_size,
+            hidden_dim=hidden_dim,
+            num_layers=num_layers,
+            num_heads=num_heads,
+            max_len=max_len,
+            num_classes=num_classes,
+            learning_rate=learning_rate,
+            seed=seed,
+        )

@@ -1,0 +1,176 @@
+"""Vision models: MNIST CNN and ResNet.
+
+The reference reaches ResNet50 through ``tensorflow.keras.applications``
+in the model service (reference: microservices/model_image/model.py:92-162,
+README demo pipelines at README.md:53).  Here they are Flax modules:
+
+- convolutions in NHWC (TPU-native layout; XLA tiles convs onto the MXU);
+- GroupNorm instead of BatchNorm — batch-statistics-free, so the module is
+  a pure function of (params, x): no mutable state collections to thread
+  through jit/shard_map, and normalization is independent of the
+  data-parallel batch split (BatchNorm under DP needs cross-replica stats
+  sync, a host of complexity the reference's Horovod path simply got wrong
+  by using per-replica stats).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from flax import linen as nn
+
+from learningorchestra_tpu.toolkit.registry import register
+from learningorchestra_tpu.train.neural import NeuralEstimator
+
+_MODULE = "learningorchestra_tpu.models.vision"
+
+
+class _MnistCNN(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        # Accept (B, 784) flat or (B, 28, 28) or (B, 28, 28, 1).
+        if x.ndim == 2:
+            x = x.reshape((x.shape[0], 28, 28, 1))
+        elif x.ndim == 3:
+            x = x[..., None]
+        x = nn.Conv(32, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+@register(_MODULE)
+class MnistCNN(NeuralEstimator):
+    def __init__(
+        self,
+        num_classes: int = 10,
+        learning_rate: float = 1e-3,
+        seed: int = 0,
+    ):
+        self.num_classes = num_classes
+        super().__init__(
+            _MnistCNN(num_classes=num_classes),
+            loss="softmax_ce",
+            learning_rate=learning_rate,
+            seed=seed,
+        )
+
+
+class _ResNetBlock(nn.Module):
+    filters: int
+    strides: tuple = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), self.strides, use_bias=False)(x)
+        y = nn.GroupNorm(num_groups=min(32, self.filters))(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), use_bias=False)(y)
+        y = nn.GroupNorm(num_groups=min(32, self.filters))(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.filters, (1, 1), self.strides, use_bias=False
+            )(x)
+            residual = nn.GroupNorm(num_groups=min(32, self.filters))(
+                residual
+            )
+        return nn.relu(y + residual)
+
+
+class _BottleneckBlock(nn.Module):
+    filters: int
+    strides: tuple = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
+        y = nn.GroupNorm(num_groups=min(32, self.filters))(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), self.strides, use_bias=False)(y)
+        y = nn.GroupNorm(num_groups=min(32, self.filters))(y)
+        y = nn.relu(y)
+        y = nn.Conv(4 * self.filters, (1, 1), use_bias=False)(y)
+        y = nn.GroupNorm(num_groups=min(32, 4 * self.filters))(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                4 * self.filters, (1, 1), self.strides, use_bias=False
+            )(x)
+            residual = nn.GroupNorm(num_groups=min(32, 4 * self.filters))(
+                residual
+            )
+        return nn.relu(y + residual)
+
+
+class _ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block: type
+    num_classes: int = 1000
+    width: int = 64
+
+    @nn.compact
+    def __call__(self, x):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.Conv(self.width, (7, 7), (2, 2), use_bias=False)(x)
+        x = nn.GroupNorm(num_groups=min(32, self.width))(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for block_i in range(n_blocks):
+                strides = (2, 2) if stage > 0 and block_i == 0 else (1, 1)
+                x = self.block(
+                    self.width * (2**stage), strides=strides
+                )(x)
+        x = x.mean(axis=(1, 2))  # global average pool
+        return nn.Dense(self.num_classes)(x)
+
+
+@register(_MODULE)
+class ResNet18(NeuralEstimator):
+    def __init__(
+        self,
+        num_classes: int = 1000,
+        learning_rate: float = 1e-3,
+        seed: int = 0,
+    ):
+        self.num_classes = num_classes
+        super().__init__(
+            _ResNet(
+                stage_sizes=(2, 2, 2, 2),
+                block=_ResNetBlock,
+                num_classes=num_classes,
+            ),
+            loss="softmax_ce",
+            learning_rate=learning_rate,
+            seed=seed,
+        )
+
+
+@register(_MODULE)
+class ResNet50(NeuralEstimator):
+    def __init__(
+        self,
+        num_classes: int = 1000,
+        learning_rate: float = 1e-3,
+        seed: int = 0,
+    ):
+        self.num_classes = num_classes
+        super().__init__(
+            _ResNet(
+                stage_sizes=(3, 4, 6, 3),
+                block=_BottleneckBlock,
+                num_classes=num_classes,
+            ),
+            loss="softmax_ce",
+            learning_rate=learning_rate,
+            seed=seed,
+        )

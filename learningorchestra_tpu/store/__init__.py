@@ -1,0 +1,29 @@
+"""Artifact persistence: embedded document store, metadata/lineage, volumes.
+
+Replaces the reference's MongoDB replica set + named Docker volumes
+(reference: docker-compose.yml:42-100, 355-363) with an embedded,
+write-ahead-logged document store and a host-filesystem object store —
+while keeping the exact artifact contract every reference service relies on:
+a named collection whose document ``_id=0`` is the metadata record
+(``finished`` flag, lineage via ``parentName``), result rows at ``_id>=1``
+(reference: microservices/database_api_image/utils.py:50-63,
+binary_executor_image/utils.py:70-139).
+"""
+
+from learningorchestra_tpu.store.document_store import DocumentStore
+from learningorchestra_tpu.store.artifacts import (
+    ArtifactStore,
+    Metadata,
+    LineageError,
+    DuplicateArtifact,
+)
+from learningorchestra_tpu.store.volumes import VolumeStorage
+
+__all__ = [
+    "DocumentStore",
+    "ArtifactStore",
+    "Metadata",
+    "LineageError",
+    "DuplicateArtifact",
+    "VolumeStorage",
+]

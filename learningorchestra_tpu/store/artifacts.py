@@ -1,0 +1,240 @@
+"""Artifact metadata, lineage, and the durable execution ledger.
+
+The cross-cutting data model of the reference (SURVEY §1): every pipeline
+artifact is a named collection whose document ``_id=0`` is the metadata
+record ``{name, type, finished, timeCreated, parentName?, modulePath?,
+class?, method?, fields?, url?}`` (reference:
+microservices/database_api_image/utils.py:50-63,
+binary_executor_image/utils.py:70-101); the ``finished`` boolean is the
+async-completion signal clients poll; ``parentName`` chains give lineage and
+the model-lookup walk (binary_executor_image/utils.py:261-280).
+
+Improvements over the reference, deliberate:
+- a ``jobState`` field (pending/running/finished/failed) alongside
+  ``finished`` — the reference can only express "not finished", which
+  conflates running and dead (SURVEY §5.3);
+- atomic execution-document ID allocation (the reference's read-then-insert
+  races, binary_executor_image/utils.py:116-139);
+- lineage-walk loop detection.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Any
+
+from learningorchestra_tpu.store.document_store import DocumentStore
+
+METADATA_ID = 0
+
+
+class LineageError(Exception):
+    pass
+
+
+class DuplicateArtifact(Exception):
+    """An artifact with this name already exists (API layer maps to 409,
+    the reference's duplicate-name conflict —
+    database_api_image/server.py:114-136)."""
+
+
+def _now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%S.%fZ"
+    )
+
+
+class Metadata:
+    """Create/read/update the ``_id=0`` metadata document of an artifact."""
+
+    def __init__(self, store: DocumentStore):
+        self.store = store
+
+    def create(
+        self,
+        name: str,
+        artifact_type: str,
+        *,
+        parent_name: str | None = None,
+        module_path: str | None = None,
+        class_name: str | None = None,
+        method: str | None = None,
+        extra: dict | None = None,
+        overwrite: bool = False,
+    ) -> dict:
+        if not overwrite and self.exists(name):
+            raise DuplicateArtifact(name)
+        doc = {
+            "name": name,
+            "type": artifact_type,
+            "finished": False,
+            "jobState": "pending",
+            "timeCreated": _now(),
+        }
+        if parent_name is not None:
+            doc["parentName"] = parent_name
+        if module_path is not None:
+            doc["modulePath"] = module_path
+        if class_name is not None:
+            doc["class"] = class_name
+        if method is not None:
+            doc["method"] = method
+        if extra:
+            doc.update(extra)
+        self.store.insert_one(name, doc, _id=METADATA_ID)
+        return doc
+
+    def read(self, name: str) -> dict | None:
+        return self.store.find_one(name, METADATA_ID)
+
+    def exists(self, name: str) -> bool:
+        return self.read(name) is not None
+
+    def is_finished(self, name: str) -> bool:
+        doc = self.read(name)
+        return bool(doc and doc.get("finished"))
+
+    def get_type(self, name: str) -> str | None:
+        doc = self.read(name)
+        return doc.get("type") if doc else None
+
+    def update(self, name: str, fields: dict) -> bool:
+        return self.store.update_one(name, METADATA_ID, fields)
+
+    def mark_running(self, name: str) -> None:
+        self.update(name, {"jobState": "running", "finished": False})
+
+    def mark_finished(self, name: str, extra: dict | None = None) -> None:
+        fields = {"jobState": "finished", "finished": True}
+        if extra:
+            fields.update(extra)
+        self.update(name, fields)
+
+    def mark_failed(self, name: str, exception: str) -> None:
+        self.update(
+            name,
+            {"jobState": "failed", "finished": False, "exception": exception},
+        )
+
+    def restart(self, name: str) -> None:
+        """PATCH re-run semantics: flip back to unfinished/pending
+        (reference: binary_executor_image/server.py:110-156)."""
+        self.update(
+            name,
+            {"jobState": "pending", "finished": False, "exception": None},
+        )
+
+    # -- lineage --------------------------------------------------------------
+
+    def parent_chain(self, name: str) -> list[dict]:
+        """Walk ``parentName`` links upward, loop-safe; returns metadata docs
+        from ``name`` to the root."""
+        chain: list[dict] = []
+        seen: set[str] = set()
+        cur: str | None = name
+        while cur is not None:
+            if cur in seen:
+                raise LineageError(f"lineage cycle at {cur!r}")
+            seen.add(cur)
+            doc = self.read(cur)
+            if doc is None:
+                raise LineageError(f"missing artifact in lineage: {cur!r}")
+            chain.append(doc)
+            cur = doc.get("parentName")
+        return chain
+
+    def find_model_ancestor(self, name: str) -> dict:
+        """Walk the parent chain upward until an artifact of type ``model/*``
+        — how a predict step finds the original model spec behind a train
+        step (reference: binary_executor_image/utils.py:261-280)."""
+        for doc in self.parent_chain(name):
+            if str(doc.get("type", "")).startswith("model"):
+                return doc
+        raise LineageError(f"no model ancestor for {name!r}")
+
+
+class ExecutionLedger:
+    """Append-only per-artifact execution records at ``_id>=1``.
+
+    Every job appends a document recording what ran and how it ended —
+    the reference's durable observability (binary_executor_image/
+    binary_execution.py:174-186, code_executor_image/utils.py:113-138,
+    which additionally captures stdout as ``functionMessage``).
+    """
+
+    def __init__(self, store: DocumentStore):
+        self.store = store
+
+    def record(
+        self,
+        name: str,
+        *,
+        description: str | None = None,
+        method: str | None = None,
+        parameters: Any = None,
+        state: str = "finished",
+        exception: str | None = None,
+        stdout: str | None = None,
+        metrics: dict | None = None,
+    ) -> int:
+        doc: dict = {
+            "executionTime": _now(),
+            "state": state,
+        }
+        if description is not None:
+            doc["description"] = description
+        if method is not None:
+            doc["method"] = method
+        if parameters is not None:
+            doc["parameters"] = parameters
+        if exception is not None:
+            doc["exception"] = exception
+        if stdout is not None:
+            doc["functionMessage"] = stdout
+        if metrics:
+            doc["metrics"] = metrics
+        return self.store.insert_one(name, doc)
+
+    def history(self, name: str) -> list[dict]:
+        return self.store.find(name, query={"_id": {"$gte": 1}})
+
+
+class ArtifactStore:
+    """Facade tying the document store, metadata and ledger together.
+
+    One per process; services receive this rather than raw stores.
+    """
+
+    def __init__(self, store: DocumentStore):
+        self.documents = store
+        self.metadata = Metadata(store)
+        self.ledger = ExecutionLedger(store)
+
+    # Universal GET/poll read path: metadata doc first, then rows
+    # (reference: database_api_image/server.py:52-80 — metadata appears
+    # first because results sort on _id and metadata is _id=0).
+    def read_page(
+        self,
+        name: str,
+        query: dict | None = None,
+        skip: int = 0,
+        limit: int = 20,
+    ) -> list[dict]:
+        return self.documents.find(
+            name, query=query, sort_key="_id", skip=skip, limit=limit
+        )
+
+    def list_by_type(self, artifact_type_prefix: str = "") -> list[dict]:
+        """Metadata of all artifacts whose type starts with a prefix
+        (reference: database_api_image/server.py:83-93 lists by type)."""
+        out = []
+        for coll in self.documents.list_collections():
+            meta = self.metadata.read(coll)
+            if meta and str(meta.get("type", "")).startswith(
+                artifact_type_prefix
+            ):
+                out.append(meta)
+        return out
+
+    def delete(self, name: str) -> bool:
+        return self.documents.drop(name)

@@ -1,0 +1,315 @@
+"""Embedded, thread-safe, WAL-backed document store.
+
+System-of-record for every artifact, replacing the reference's MongoDB 3.6
+replica set (reference: docker-compose.yml:42-90).  The API surface is the
+subset of Mongo the reference actually uses:
+
+- ``insert_one`` / ``insert_many`` with auto-incremented integer ``_id``
+  (the reference allocates IDs read-then-insert, which races —
+  binary_executor_image/utils.py:116-139; here allocation is atomic);
+- ``find(query, sort, skip, limit)`` with equality / ``$gt``-style operators
+  (database_api_image/utils.py:17-23);
+- ``update_one`` on ``_id`` (metadata finished-flips);
+- ``aggregate_counts`` — the ``$group``/``$sum`` value-count pipeline used by
+  the histogram service (histogram_image/histogram.py:31-36), vectorized
+  host-side;
+- ``drop`` / ``list_collections``.
+
+Durability model: one JSONL write-ahead log per collection (`<name>.wal`);
+each line is an op record (insert/update/delete).  Full state is replayed on
+open; ``compact()`` rewrites the log to current state.  All mutation goes
+through a per-collection lock; ID allocation is a counter under that lock.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+from pathlib import Path
+from typing import Any, Iterable
+
+# Collection names become file names; keep them safe.
+_NAME_RE = re.compile(r"^[A-Za-z0-9_][A-Za-z0-9_.\-]*$")
+
+
+class CollectionExists(Exception):
+    pass
+
+
+class NoSuchCollection(Exception):
+    pass
+
+
+def _match(doc: dict, query: dict | None) -> bool:
+    """Mongo-style document match supporting equality and the small operator
+    set the reference's GET query path needs ($gt/$gte/$lt/$lte/$ne/$in)."""
+    if not query:
+        return True
+    for key, cond in query.items():
+        val = doc.get(key)
+        if isinstance(cond, dict):
+            for op, operand in cond.items():
+                try:
+                    if op == "$gt" and not (val is not None and val > operand):
+                        return False
+                    elif op == "$gte" and not (
+                        val is not None and val >= operand
+                    ):
+                        return False
+                    elif op == "$lt" and not (val is not None and val < operand):
+                        return False
+                    elif op == "$lte" and not (
+                        val is not None and val <= operand
+                    ):
+                        return False
+                    elif op == "$ne" and not (val != operand):
+                        return False
+                    elif op == "$in" and val not in operand:
+                        return False
+                except TypeError:
+                    return False
+        else:
+            if val != cond:
+                return False
+    return True
+
+
+class _Collection:
+    def __init__(self, path: Path, durable: bool):
+        self.path = path
+        self.durable = durable
+        self.lock = threading.RLock()
+        self.docs: dict[int, dict] = {}
+        self.next_id = 0
+        self._fh = None
+        if path.exists():
+            self._replay()
+        self._open_log()
+
+    def _replay(self) -> None:
+        # next_id must stay monotonic across deletes, so it tracks the max
+        # _id ever inserted, not the max surviving doc.
+        max_seen = -1
+        with open(self.path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                op = json.loads(line)
+                kind = op["op"]
+                if kind == "i":
+                    doc = op["d"]
+                    self.docs[doc["_id"]] = doc
+                    max_seen = max(max_seen, doc["_id"])
+                elif kind == "u":
+                    _id = op["id"]
+                    if _id in self.docs:
+                        self.docs[_id].update(op["d"])
+                elif kind == "d":
+                    self.docs.pop(op["id"], None)
+                elif kind == "n":
+                    max_seen = max(max_seen, op["v"] - 1)
+        self.next_id = max_seen + 1
+
+    def _open_log(self) -> None:
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._fh = open(self.path, "a", encoding="utf-8")
+
+    def _append(self, op: dict) -> None:
+        self._fh.write(json.dumps(op, default=str) + "\n")
+        self._fh.flush()
+        if self.durable:
+            os.fsync(self._fh.fileno())
+
+    def close(self) -> None:
+        with self.lock:
+            if self._fh:
+                self._fh.close()
+                self._fh = None
+
+
+class DocumentStore:
+    """A directory of collections, each a WAL-backed dict of documents."""
+
+    def __init__(self, root: str | Path, durable_writes: bool = False):
+        self.root = Path(root).expanduser()
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.durable = durable_writes
+        self._collections: dict[str, _Collection] = {}
+        self._lock = threading.Lock()
+        for wal in sorted(self.root.glob("*.wal")):
+            name = wal.stem
+            self._collections[name] = _Collection(wal, durable_writes)
+
+    # -- collection lifecycle -------------------------------------------------
+
+    def _validate_name(self, name: str) -> None:
+        if not _NAME_RE.match(name or ""):
+            raise ValueError(f"invalid collection name: {name!r}")
+
+    def collection_exists(self, name: str) -> bool:
+        with self._lock:
+            return name in self._collections
+
+    def list_collections(self) -> list[str]:
+        with self._lock:
+            return sorted(self._collections)
+
+    def _get(self, name: str, create: bool = False) -> _Collection:
+        with self._lock:
+            coll = self._collections.get(name)
+            if coll is None:
+                if not create:
+                    raise NoSuchCollection(name)
+                self._validate_name(name)
+                coll = _Collection(self.root / f"{name}.wal", self.durable)
+                self._collections[name] = coll
+            return coll
+
+    def drop(self, name: str) -> bool:
+        with self._lock:
+            coll = self._collections.pop(name, None)
+        if coll is None:
+            return False
+        coll.close()
+        try:
+            coll.path.unlink()
+        except FileNotFoundError:
+            pass
+        return True
+
+    # -- writes ---------------------------------------------------------------
+
+    def insert_one(self, name: str, doc: dict, _id: int | None = None) -> int:
+        """Insert, atomically allocating ``_id`` unless one is given."""
+        coll = self._get(name, create=True)
+        with coll.lock:
+            if _id is None:
+                _id = coll.next_id
+            doc = dict(doc)
+            doc["_id"] = _id
+            coll.next_id = max(coll.next_id, _id + 1)
+            coll.docs[_id] = doc
+            coll._append({"op": "i", "d": doc})
+            return _id
+
+    def insert_many(self, name: str, docs: Iterable[dict]) -> int:
+        """Batched insert (the reference ingests CSV with per-row
+        ``insert_one`` — its known bottleneck, database_api_image/
+        database.py:139-151; batching is the fix)."""
+        coll = self._get(name, create=True)
+        n = 0
+        with coll.lock:
+            lines = []
+            for doc in docs:
+                doc = dict(doc)
+                doc["_id"] = coll.next_id
+                coll.next_id += 1
+                coll.docs[doc["_id"]] = doc
+                lines.append(json.dumps({"op": "i", "d": doc}, default=str))
+                n += 1
+            if lines:
+                coll._fh.write("\n".join(lines) + "\n")
+                coll._fh.flush()
+                if coll.durable:
+                    os.fsync(coll._fh.fileno())
+        return n
+
+    def update_one(self, name: str, _id: int, fields: dict) -> bool:
+        coll = self._get(name)
+        with coll.lock:
+            doc = coll.docs.get(_id)
+            if doc is None:
+                return False
+            fields = dict(fields)
+            fields.pop("_id", None)
+            doc.update(fields)
+            coll._append({"op": "u", "id": _id, "d": fields})
+            return True
+
+    def delete_one(self, name: str, _id: int) -> bool:
+        coll = self._get(name)
+        with coll.lock:
+            if _id not in coll.docs:
+                return False
+            del coll.docs[_id]
+            coll._append({"op": "d", "id": _id})
+            return True
+
+    # -- reads ----------------------------------------------------------------
+
+    def find(
+        self,
+        name: str,
+        query: dict | None = None,
+        sort_key: str = "_id",
+        skip: int = 0,
+        limit: int | None = None,
+    ) -> list[dict]:
+        """Query → sorted (by ``sort_key``) → skip → limit, mirroring the
+        universal GET/poll path (database_api_image/database.py:19-28)."""
+        coll = self._get(name)
+        with coll.lock:
+            docs = [dict(d) for d in coll.docs.values() if _match(d, query)]
+        docs.sort(key=lambda d: (d.get(sort_key) is None, d.get(sort_key)))
+        if skip:
+            docs = docs[skip:]
+        if limit is not None:
+            docs = docs[:limit]
+        return docs
+
+    def find_one(self, name: str, _id: int) -> dict | None:
+        try:
+            coll = self._get(name)
+        except NoSuchCollection:
+            return None
+        with coll.lock:
+            doc = coll.docs.get(_id)
+            return dict(doc) if doc is not None else None
+
+    def count(self, name: str, query: dict | None = None) -> int:
+        coll = self._get(name)
+        with coll.lock:
+            if query is None:
+                return len(coll.docs)
+            return sum(1 for d in coll.docs.values() if _match(d, query))
+
+    def aggregate_counts(
+        self, name: str, field: str, exclude_ids: tuple = (0,)
+    ) -> dict[Any, int]:
+        """Value-count aggregation for histograms — the `$group`/`$sum`
+        pipeline of histogram_image/histogram.py:31-36, done host-side."""
+        coll = self._get(name)
+        counts: dict[Any, int] = {}
+        with coll.lock:
+            for _id, doc in coll.docs.items():
+                if _id in exclude_ids:
+                    continue
+                val = doc.get(field)
+                if isinstance(val, (list, dict)):
+                    val = json.dumps(val, default=str)
+                counts[val] = counts.get(val, 0) + 1
+        return counts
+
+    # -- maintenance ----------------------------------------------------------
+
+    def compact(self, name: str) -> None:
+        """Rewrite a collection's WAL to current state."""
+        coll = self._get(name)
+        with coll.lock:
+            tmp = coll.path.with_suffix(".wal.tmp")
+            with open(tmp, "w", encoding="utf-8") as fh:
+                fh.write(json.dumps({"op": "n", "v": coll.next_id}) + "\n")
+                for doc in coll.docs.values():
+                    fh.write(json.dumps({"op": "i", "d": doc}, default=str) + "\n")
+            coll._fh.close()
+            os.replace(tmp, coll.path)
+            coll._open_log()
+
+    def close(self) -> None:
+        with self._lock:
+            for coll in self._collections.values():
+                coll.close()
+            self._collections.clear()

@@ -1,0 +1,158 @@
+"""Volume-backed binary artifact storage.
+
+The reference persists model instances and transform outputs as files on
+service-type-keyed Docker volumes — keras SavedModel when possible, dill
+otherwise (reference: microservices/binary_executor_image/utils.py:199-251,
+model_image/utils.py:186-210).  Here the same contract is a host directory
+tree keyed by service type, with three formats:
+
+- ``pytree``: JAX pytrees (model params / optimizer states) saved as an
+  orbax-style checkpoint directory — the TPU-native replacement for keras
+  SavedModel, shard-friendly and HBM↔host explicit;
+- ``dill``: arbitrary Python objects (classical estimators, tuples of
+  arrays) — the reference's fallback path, kept for parity;
+- ``bytes``: raw streams (generic dataset ingest,
+  database_api_image/database.py:61-83).
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import shutil
+from pathlib import Path
+from typing import Any
+
+import dill
+
+# Same grammar as DocumentStore collection names: binary names come from
+# REST request JSON and become file names — no separators, no traversal.
+_NAME_RE = re.compile(r"^[A-Za-z0-9_][A-Za-z0-9_.\-]*$")
+
+
+def _validate_name(name: str) -> str:
+    if not _NAME_RE.match(name or "") or ".." in name:
+        raise ValueError(f"invalid artifact name: {name!r}")
+    return name
+
+# Service-type → volume directory, mirroring the reference's six named
+# volumes (binary_executor_image/Dockerfile:10-13, docker-compose.yml:355-363).
+VOLUME_KEYS = (
+    "datasets",
+    "models",
+    "binaries",
+    "transform",
+    "explore",
+    "code_executions",
+)
+
+
+def volume_key_for_type(artifact_type: str) -> str:
+    """Map an artifact type like ``train/tensorflow`` to its volume."""
+    head = artifact_type.split("/", 1)[0]
+    return {
+        "dataset": "datasets",
+        "model": "models",
+        "train": "binaries",
+        "tune": "binaries",
+        "evaluate": "binaries",
+        "predict": "binaries",
+        "builder": "binaries",
+        "transform": "transform",
+        "explore": "explore",
+        "function": "code_executions",
+    }.get(head, "binaries")
+
+
+class VolumeStorage:
+    def __init__(self, root: str | Path):
+        self.root = Path(root).expanduser()
+        for key in VOLUME_KEYS:
+            (self.root / key).mkdir(parents=True, exist_ok=True)
+
+    def path_for(self, artifact_type: str, name: str) -> Path:
+        return self.root / volume_key_for_type(artifact_type) / _validate_name(
+            name
+        )
+
+    # -- dill (parity fallback) ----------------------------------------------
+
+    def save_object(self, artifact_type: str, name: str, obj: Any) -> Path:
+        path = self.path_for(artifact_type, name)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "wb") as fh:
+            dill.dump(obj, fh)
+        return path
+
+    def read_object(self, artifact_type: str, name: str) -> Any:
+        path = self.path_for(artifact_type, name)
+        with open(path, "rb") as fh:
+            return dill.load(fh)
+
+    # -- pytree checkpoints (TPU-native model persistence) --------------------
+
+    def save_pytree(self, artifact_type: str, name: str, tree: Any) -> Path:
+        """Checkpoint a JAX pytree.  Arrays are device_get'd to host before
+        serialization so the HBM↔host boundary is explicit at the job edge
+        (SURVEY §5.4 TPU-native plan)."""
+        import jax
+        import numpy as np
+
+        host_tree = jax.tree_util.tree_map(
+            lambda x: np.asarray(jax.device_get(x))
+            if hasattr(x, "shape")
+            else x,
+            tree,
+        )
+        path = self.path_for(artifact_type, name)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "wb") as fh:
+            dill.dump(host_tree, fh)
+        return path
+
+    def read_pytree(self, artifact_type: str, name: str) -> Any:
+        return self.read_object(artifact_type, name)
+
+    # -- raw bytes ------------------------------------------------------------
+
+    def save_stream(
+        self, artifact_type: str, name: str, stream: io.BufferedIOBase,
+        chunk_size: int = 1 << 20,
+    ) -> Path:
+        path = self.path_for(artifact_type, name)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "wb") as fh:
+            shutil.copyfileobj(stream, fh, chunk_size)
+        return path
+
+    def read_bytes(self, artifact_type: str, name: str) -> bytes:
+        return self.path_for(artifact_type, name).read_bytes()
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def exists(self, artifact_type: str, name: str) -> bool:
+        return self.path_for(artifact_type, name).exists()
+
+    def delete(self, artifact_type: str, name: str) -> bool:
+        path = self.path_for(artifact_type, name)
+        if path.is_dir():
+            shutil.rmtree(path)
+            return True
+        if path.exists():
+            path.unlink()
+            return True
+        return False
+
+    def delete_everywhere(self, name: str) -> bool:
+        """Remove a named binary from whichever volume holds it."""
+        _validate_name(name)
+        hit = False
+        for key in VOLUME_KEYS:
+            path = self.root / key / name
+            if path.is_dir():
+                shutil.rmtree(path)
+                hit = True
+            elif path.exists():
+                path.unlink()
+                hit = True
+        return hit

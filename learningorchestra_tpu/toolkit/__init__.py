@@ -1,0 +1,17 @@
+"""Toolkit layer: the JAX-native model/estimator registry.
+
+The reference instantiates "any class from a whitelisted importable module"
+— ``sklearn.*``, ``tensorflow.keras.applications.*`` — inside its model
+service (reference: microservices/model_image/model.py:92-162,
+utils.py:151-159).  Here the same request shape (``modulePath`` +
+``class`` + ``classParameters``) resolves against a registry of JAX-native
+implementations: Flax neural models compiled by XLA to TPU and classical
+estimators re-implemented on jax.numpy.  Reference-style module paths
+(``sklearn.linear_model``, ``tensorflow.keras.applications``) are accepted
+as aliases so existing client pipelines keep working.
+"""
+
+from learningorchestra_tpu.toolkit import registry
+from learningorchestra_tpu.toolkit.base import Estimator, as_array
+
+__all__ = ["registry", "Estimator", "as_array"]

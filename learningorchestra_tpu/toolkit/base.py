@@ -1,0 +1,82 @@
+"""Estimator protocol and array coercion helpers.
+
+Estimators expose the sklearn-style surface the reference drives by
+reflection — ``getattr(instance, method)(**treated_params)`` with
+``inspect.signature`` validation (reference:
+microservices/binary_executor_image/binary_execution.py:188-200,
+utils.py:142-188) — so the executor layer works identically here.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def as_array(x: Any, dtype=None) -> jnp.ndarray:
+    """Coerce DataFrames / lists / numpy / jax arrays to a jnp array.
+
+    Dataset artifacts load as pandas DataFrames (the reference's convention
+    — Mongo collection → pd.DataFrame, binary_executor_image/
+    utils.py:322-330); numeric coercion happens here at the toolkit edge.
+    """
+    if hasattr(x, "to_numpy"):  # pandas DataFrame / Series
+        x = x.to_numpy()
+    arr = np.asarray(x)
+    if arr.dtype == object:
+        arr = arr.astype(np.float32)
+    out = jnp.asarray(arr)
+    if dtype is not None:
+        out = out.astype(dtype)
+    return out
+
+
+def as_labels(y: Any) -> jnp.ndarray:
+    """Coerce labels to an int32 vector, mapping arbitrary class values to
+    contiguous ids; returns the array (classes kept by the caller)."""
+    if hasattr(y, "to_numpy"):
+        y = y.to_numpy()
+    arr = np.asarray(y).reshape(-1)
+    return jnp.asarray(arr)
+
+
+def encode_classes(y: Any) -> tuple[np.ndarray, np.ndarray]:
+    """(classes, encoded int ids) — np.unique inverse mapping."""
+    if hasattr(y, "to_numpy"):
+        y = y.to_numpy()
+    arr = np.asarray(y).reshape(-1)
+    classes, inv = np.unique(arr, return_inverse=True)
+    return classes, inv.astype(np.int32)
+
+
+class Estimator:
+    """Base class: get_params/set_params over __init__ kwargs, repr."""
+
+    def get_params(self) -> dict:
+        sig = inspect.signature(type(self).__init__)
+        return {
+            name: getattr(self, name)
+            for name in sig.parameters
+            if name != "self" and hasattr(self, name)
+        }
+
+    def set_params(self, **params) -> "Estimator":
+        for key, val in params.items():
+            setattr(self, key, val)
+        return self
+
+    def __repr__(self) -> str:
+        params = ", ".join(f"{k}={v!r}" for k, v in self.get_params().items())
+        return f"{type(self).__name__}({params})"
+
+    # Classification scorer shared by classifiers.
+    def score(self, x, y) -> float:
+        import numpy as np
+
+        preds = np.asarray(self.predict(x)).reshape(-1)
+        truth = np.asarray(y if not hasattr(y, "to_numpy") else y.to_numpy())
+        truth = truth.reshape(-1)
+        return float((preds == truth).mean())

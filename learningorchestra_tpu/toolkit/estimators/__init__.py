@@ -1,0 +1,9 @@
+"""JAX-native classical estimators.
+
+Stand-ins for the sklearn / Spark-MLlib estimator surface the reference
+orchestrates (reference: microservices/builder_image/utils.py:119-123 —
+LR/DT/RF/GB/NB whitelist — and the arbitrary ``sklearn.*`` instantiation of
+model_image/model.py:92-162).  Each is a ground-up jax.numpy implementation:
+dense vectorized math that XLA tiles onto the MXU, not a wrapper over
+sklearn's C extensions.
+"""

@@ -1,0 +1,104 @@
+"""KMeans: kmeans++ seeding (host) + jitted Lloyd iterations (lax.scan).
+
+Replaces ``sklearn.cluster.KMeans`` instantiable through the model service
+(reference: microservices/model_image/model.py:92-162).  The assignment
+step is one big (n, k) distance matmul — exactly the shape the MXU wants.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from learningorchestra_tpu.toolkit.base import Estimator, as_array
+from learningorchestra_tpu.toolkit.registry import register
+
+_MODULE = "learningorchestra_tpu.toolkit.estimators.cluster"
+
+
+@functools.partial(jax.jit, static_argnames=("n_iter",))
+def _lloyd(x, centers0, n_iter: int):
+    def assign(centers):
+        # ||x-c||^2 = ||x||^2 - 2 x.c + ||c||^2 ; argmin over k.
+        d = (
+            jnp.sum(x * x, 1, keepdims=True)
+            - 2.0 * x @ centers.T
+            + jnp.sum(centers * centers, 1)[None]
+        )
+        return jnp.argmin(d, axis=1)
+
+    def step(centers, _):
+        labels = assign(centers)
+        one_hot = jax.nn.one_hot(labels, centers.shape[0], dtype=x.dtype)
+        counts = one_hot.sum(0)
+        sums = one_hot.T @ x
+        new = jnp.where(
+            counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1), centers
+        )
+        return new, None
+
+    centers, _ = jax.lax.scan(step, centers0, None, length=n_iter)
+    labels = assign(centers)
+    dists = jnp.sum((x - centers[labels]) ** 2, axis=1)
+    return centers, labels, jnp.sum(dists)
+
+
+@register(_MODULE)
+class KMeans(Estimator):
+    def __init__(
+        self,
+        n_clusters: int = 8,
+        max_iter: int = 100,
+        random_state: int = 0,
+    ):
+        self.n_clusters = n_clusters
+        self.max_iter = max_iter
+        self.random_state = random_state
+        self.cluster_centers_ = None
+        self.labels_ = None
+        self.inertia_ = None
+
+    def _init_centers(self, x: np.ndarray) -> np.ndarray:
+        """kmeans++ seeding on host (data-dependent control flow)."""
+        rng = np.random.default_rng(self.random_state)
+        n = x.shape[0]
+        centers = [x[rng.integers(n)]]
+        for _ in range(1, self.n_clusters):
+            d2 = np.min(
+                ((x[:, None, :] - np.stack(centers)[None]) ** 2).sum(-1),
+                axis=1,
+            )
+            probs = d2 / max(d2.sum(), 1e-12)
+            centers.append(x[rng.choice(n, p=probs)])
+        return np.stack(centers)
+
+    def fit(self, x, y=None):
+        xj = as_array(x, jnp.float32)
+        centers0 = jnp.asarray(
+            self._init_centers(np.asarray(xj)), jnp.float32
+        )
+        centers, labels, inertia = _lloyd(xj, centers0, self.max_iter)
+        self.cluster_centers_ = centers
+        self.labels_ = np.asarray(labels)
+        self.inertia_ = float(inertia)
+        return self
+
+    def predict(self, x):
+        x = as_array(x, jnp.float32)
+        c = self.cluster_centers_
+        d = (
+            jnp.sum(x * x, 1, keepdims=True)
+            - 2.0 * x @ c.T
+            + jnp.sum(c * c, 1)[None]
+        )
+        return np.asarray(jnp.argmin(d, axis=1))
+
+    def score(self, x, y=None):
+        x = as_array(x, jnp.float32)
+        labels = jnp.asarray(self.predict(x))
+        return -float(
+            jnp.sum((x - self.cluster_centers_[labels]) ** 2)
+        )

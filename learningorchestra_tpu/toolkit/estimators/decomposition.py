@@ -1,0 +1,178 @@
+"""PCA and t-SNE — the Explore-service projections.
+
+The reference's Explore path runs arbitrary sklearn classes and renders
+scatterplots (reference: microservices/database_executor_image/
+database_execution.py:92-188, utils.py:295-320); t-SNE is named in the
+IMDb demo config (BASELINE.md config 3).  PCA is an SVD on the MXU; t-SNE
+is the exact O(n²) algorithm as a jitted `lax.scan` — the pairwise-affinity
+matrix is a dense matmul, which on TPU beats Barnes-Hut-style pointer
+chasing for the few-thousand-point datasets Explore plots.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from learningorchestra_tpu.toolkit.base import Estimator, as_array
+from learningorchestra_tpu.toolkit.registry import register
+
+_MODULE = "learningorchestra_tpu.toolkit.estimators.decomposition"
+
+
+@register(_MODULE)
+class PCA(Estimator):
+    def __init__(self, n_components: int = 2):
+        self.n_components = n_components
+        self.mean_ = None
+        self.components_ = None
+        self.explained_variance_ratio_ = None
+
+    def fit(self, x, y=None):
+        x = as_array(x, jnp.float32)
+        self.mean_ = jnp.mean(x, 0)
+        xc = x - self.mean_
+        _, s, vt = jnp.linalg.svd(xc, full_matrices=False)
+        self.components_ = vt[: self.n_components]
+        var = (s**2) / (x.shape[0] - 1)
+        self.explained_variance_ratio_ = var[: self.n_components] / jnp.sum(
+            var
+        )
+        return self
+
+    def transform(self, x):
+        x = as_array(x, jnp.float32)
+        return (x - self.mean_) @ self.components_.T
+
+    def fit_transform(self, x, y=None):
+        return self.fit(x).transform(x)
+
+    def inverse_transform(self, z):
+        return as_array(z, jnp.float32) @ self.components_ + self.mean_
+
+
+def _pairwise_sq_dists(x):
+    s = jnp.sum(x * x, axis=1)
+    return s[:, None] - 2.0 * x @ x.T + s[None, :]
+
+
+@functools.partial(jax.jit, static_argnames=("max_bisect",))
+def _binary_search_perplexity(d2, target_entropy, max_bisect: int = 50):
+    """Per-point beta (precision) search so each row's conditional
+    distribution hits the target perplexity."""
+    n = d2.shape[0]
+    inf = jnp.float32(jnp.inf)
+
+    def row_probs(beta):
+        p = jnp.exp(-d2 * beta[:, None])
+        p = p * (1.0 - jnp.eye(n))
+        psum = jnp.maximum(jnp.sum(p, axis=1, keepdims=True), 1e-12)
+        return p / psum
+
+    def entropy(beta):
+        p = row_probs(beta)
+        return -jnp.sum(jnp.where(p > 0, p * jnp.log(p), 0.0), axis=1)
+
+    def body(_, state):
+        beta, lo, hi = state
+        h = entropy(beta)
+        too_high = h > target_entropy  # entropy too high → beta too small
+        lo = jnp.where(too_high, beta, lo)
+        hi = jnp.where(too_high, hi, beta)
+        beta = jnp.where(
+            too_high,
+            jnp.where(jnp.isinf(hi), beta * 2.0, (beta + hi) / 2.0),
+            jnp.where(lo == 0, beta / 2.0, (beta + lo) / 2.0),
+        )
+        return beta, lo, hi
+
+    beta0 = jnp.ones((n,), jnp.float32)
+    lo0 = jnp.zeros((n,), jnp.float32)
+    hi0 = jnp.full((n,), inf)
+    beta, _, _ = jax.lax.fori_loop(0, max_bisect, body, (beta0, lo0, hi0))
+    return row_probs(beta)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_iter", "early_exaggeration_iters")
+)
+def _tsne_optimize(
+    p, y0, learning_rate, n_iter: int, early_exaggeration_iters: int
+):
+    n = p.shape[0]
+    eye = jnp.eye(n)
+
+    def grad_kl(y, p_eff):
+        d2 = _pairwise_sq_dists(y)
+        num = 1.0 / (1.0 + d2)
+        num = num * (1.0 - eye)
+        q = num / jnp.maximum(jnp.sum(num), 1e-12)
+        pq = (p_eff - q) * num  # (n, n)
+        return 4.0 * (
+            y * jnp.sum(pq, axis=1, keepdims=True) - pq @ y
+        )
+
+    def step(carry, i):
+        y, vel = carry
+        exag = jnp.where(i < early_exaggeration_iters, 12.0, 1.0)
+        g = grad_kl(y, p * exag)
+        momentum = jnp.where(i < early_exaggeration_iters, 0.5, 0.8)
+        vel = momentum * vel - learning_rate * g
+        y = y + vel
+        return (y, vel), None
+
+    (y, _), _ = jax.lax.scan(
+        step, (y0, jnp.zeros_like(y0)), jnp.arange(n_iter)
+    )
+    return y
+
+
+@register(_MODULE)
+class TSNE(Estimator):
+    """Exact t-SNE, fully jitted (dense affinities → MXU-friendly)."""
+
+    def __init__(
+        self,
+        n_components: int = 2,
+        perplexity: float = 30.0,
+        learning_rate: float = 200.0,
+        n_iter: int = 500,
+        random_state: int = 0,
+    ):
+        self.n_components = n_components
+        self.perplexity = perplexity
+        self.learning_rate = learning_rate
+        self.n_iter = n_iter
+        self.random_state = random_state
+        self.embedding_ = None
+
+    def fit_transform(self, x, y=None):
+        x = as_array(x, jnp.float32)
+        n = x.shape[0]
+        d2 = _pairwise_sq_dists(x)
+        cond = _binary_search_perplexity(
+            d2, jnp.log(jnp.float32(self.perplexity))
+        )
+        p = (cond + cond.T) / (2.0 * n)
+        p = jnp.maximum(p, 1e-12)
+        rng = np.random.default_rng(self.random_state)
+        y0 = jnp.asarray(
+            rng.normal(scale=1e-4, size=(n, self.n_components)),
+            jnp.float32,
+        )
+        emb = _tsne_optimize(
+            p,
+            y0,
+            self.learning_rate,
+            n_iter=self.n_iter,
+            early_exaggeration_iters=min(250, self.n_iter // 2),
+        )
+        self.embedding_ = emb
+        return emb
+
+    def fit(self, x, y=None):
+        self.fit_transform(x)
+        return self

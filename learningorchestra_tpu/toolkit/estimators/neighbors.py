@@ -1,0 +1,70 @@
+"""KNeighborsClassifier — brute-force distances as one (n, m) matmul.
+
+On TPU the "smart" tree-based kNN of sklearn loses to a single dense
+distance computation that XLA tiles onto the MXU; this implementation is
+brute-force by design.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from learningorchestra_tpu.toolkit.base import (
+    Estimator,
+    as_array,
+    encode_classes,
+)
+from learningorchestra_tpu.toolkit.registry import register
+
+_MODULE = "learningorchestra_tpu.toolkit.estimators.neighbors"
+
+
+@functools.partial(jax.jit, static_argnames=("k", "n_classes"))
+def _knn_votes(train_x, train_y, test_x, k: int, n_classes: int):
+    d = (
+        jnp.sum(test_x * test_x, 1, keepdims=True)
+        - 2.0 * test_x @ train_x.T
+        + jnp.sum(train_x * train_x, 1)[None]
+    )
+    _, idx = jax.lax.top_k(-d, k)  # (m, k) nearest indices
+    votes = jax.nn.one_hot(train_y[idx], n_classes).sum(axis=1)
+    return votes
+
+
+@register(_MODULE)
+class KNeighborsClassifier(Estimator):
+    def __init__(self, n_neighbors: int = 5):
+        self.n_neighbors = n_neighbors
+        self.classes_ = None
+        self._x = None
+        self._y = None
+
+    def fit(self, x, y):
+        self._x = as_array(x, jnp.float32)
+        self.classes_, y_idx = encode_classes(y)
+        self._y = jnp.asarray(y_idx)
+        return self
+
+    def predict_proba(self, x):
+        votes = _knn_votes(
+            self._x,
+            self._y,
+            as_array(x, jnp.float32),
+            k=self.n_neighbors,
+            n_classes=len(self.classes_),
+        )
+        return votes / jnp.sum(votes, axis=1, keepdims=True)
+
+    def predict(self, x):
+        votes = _knn_votes(
+            self._x,
+            self._y,
+            as_array(x, jnp.float32),
+            k=self.n_neighbors,
+            n_classes=len(self.classes_),
+        )
+        return self.classes_[np.asarray(jnp.argmax(votes, axis=1))]

@@ -1,0 +1,95 @@
+"""Preprocessing transforms: StandardScaler, MinMaxScaler, OneHotEncoder.
+
+The reference's Transform service instantiates exactly these kinds of
+classes generically (``databaseExecutor`` with type=transform, reference:
+microservices/database_executor_image/database_execution.py:92-188).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from learningorchestra_tpu.toolkit.base import Estimator, as_array
+from learningorchestra_tpu.toolkit.registry import register
+
+_MODULE = "learningorchestra_tpu.toolkit.estimators.preprocessing"
+
+
+@register(_MODULE)
+class StandardScaler(Estimator):
+    def __init__(self, with_mean: bool = True, with_std: bool = True):
+        self.with_mean = with_mean
+        self.with_std = with_std
+        self.mean_ = None
+        self.scale_ = None
+
+    def fit(self, x, y=None):
+        x = as_array(x, jnp.float32)
+        self.mean_ = jnp.mean(x, 0) if self.with_mean else jnp.zeros(x.shape[1])
+        std = jnp.std(x, 0) if self.with_std else jnp.ones(x.shape[1])
+        self.scale_ = jnp.where(std == 0, 1.0, std)
+        return self
+
+    def transform(self, x):
+        x = as_array(x, jnp.float32)
+        return (x - self.mean_) / self.scale_
+
+    def fit_transform(self, x, y=None):
+        return self.fit(x).transform(x)
+
+    def inverse_transform(self, x):
+        return as_array(x, jnp.float32) * self.scale_ + self.mean_
+
+
+@register(_MODULE)
+class MinMaxScaler(Estimator):
+    def __init__(self, feature_range: tuple = (0.0, 1.0)):
+        self.feature_range = tuple(feature_range)
+        self.min_ = None
+        self.scale_ = None
+
+    def fit(self, x, y=None):
+        x = as_array(x, jnp.float32)
+        lo, hi = jnp.min(x, 0), jnp.max(x, 0)
+        span = jnp.where(hi - lo == 0, 1.0, hi - lo)
+        a, b = self.feature_range
+        self.scale_ = (b - a) / span
+        self.min_ = a - lo * self.scale_
+        return self
+
+    def transform(self, x):
+        return as_array(x, jnp.float32) * self.scale_ + self.min_
+
+    def fit_transform(self, x, y=None):
+        return self.fit(x).transform(x)
+
+
+@register(_MODULE)
+class OneHotEncoder(Estimator):
+    def __init__(self):
+        self.categories_ = None
+
+    def fit(self, x, y=None):
+        arr = np.asarray(x if not hasattr(x, "to_numpy") else x.to_numpy())
+        if arr.ndim == 1:
+            arr = arr[:, None]
+        self.categories_ = [np.unique(arr[:, j]) for j in range(arr.shape[1])]
+        return self
+
+    def transform(self, x):
+        arr = np.asarray(x if not hasattr(x, "to_numpy") else x.to_numpy())
+        if arr.ndim == 1:
+            arr = arr[:, None]
+        cols = []
+        for j, cats in enumerate(self.categories_):
+            idx = np.searchsorted(cats, arr[:, j])
+            idx = np.clip(idx, 0, len(cats) - 1)
+            valid = cats[idx] == arr[:, j]
+            block = np.zeros((arr.shape[0], len(cats)), np.float32)
+            block[np.arange(arr.shape[0])[valid], idx[valid]] = 1.0
+            cols.append(block)
+        return jnp.asarray(np.concatenate(cols, axis=1))
+
+    def fit_transform(self, x, y=None):
+        return self.fit(x).transform(x)

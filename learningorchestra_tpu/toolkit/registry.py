@@ -1,0 +1,158 @@
+"""Constructor registry with reference-path aliasing.
+
+Maps ``(module_path, class_name)`` request pairs to JAX-native factories.
+The reference validates ``modulePath`` by importing it and checking the
+class exists with ``inspect`` (reference:
+microservices/model_image/utils.py:151-159); here validity means "the pair
+is registered", and reference-era module paths alias to the native ones so
+a client that posts ``{"modulePath": "sklearn.linear_model", "class":
+"LogisticRegression"}`` transparently gets the JAX estimator.
+"""
+
+from __future__ import annotations
+
+import inspect
+import threading
+from typing import Any, Callable
+
+_lock = threading.Lock()
+_registry: dict[tuple[str, str], Callable] = {}
+_loaded = False
+
+# Reference-style module path → native module path.
+MODULE_ALIASES = {
+    "sklearn.linear_model": "learningorchestra_tpu.toolkit.estimators.linear",
+    "sklearn.ensemble": "learningorchestra_tpu.toolkit.estimators.trees",
+    "sklearn.tree": "learningorchestra_tpu.toolkit.estimators.trees",
+    "sklearn.naive_bayes": "learningorchestra_tpu.toolkit.estimators.bayes",
+    "sklearn.cluster": "learningorchestra_tpu.toolkit.estimators.cluster",
+    "sklearn.decomposition":
+        "learningorchestra_tpu.toolkit.estimators.decomposition",
+    "sklearn.manifold":
+        "learningorchestra_tpu.toolkit.estimators.decomposition",
+    "sklearn.preprocessing":
+        "learningorchestra_tpu.toolkit.estimators.preprocessing",
+    "sklearn.neighbors": "learningorchestra_tpu.toolkit.estimators.neighbors",
+    "tensorflow.keras.applications": "learningorchestra_tpu.models.vision",
+    "tensorflow.keras.models": "learningorchestra_tpu.models",
+    "torch.nn": "learningorchestra_tpu.models",
+}
+
+
+class RegistryError(KeyError):
+    pass
+
+
+def register(
+    module_path: str, class_name: str | None = None
+) -> Callable[[Callable], Callable]:
+    """Class decorator: ``@register("learningorchestra_tpu.toolkit...")``."""
+
+    def deco(cls: Callable) -> Callable:
+        name = class_name or cls.__name__
+        with _lock:
+            _registry[(module_path, name)] = cls
+        return cls
+
+    return deco
+
+
+def _ensure_loaded() -> None:
+    """Import all implementation modules once so decorators run."""
+    global _loaded
+    with _lock:
+        if _loaded:
+            return
+        _loaded = True
+    import importlib
+
+    for mod in (
+        "learningorchestra_tpu.toolkit.estimators.linear",
+        "learningorchestra_tpu.toolkit.estimators.trees",
+        "learningorchestra_tpu.toolkit.estimators.bayes",
+        "learningorchestra_tpu.toolkit.estimators.cluster",
+        "learningorchestra_tpu.toolkit.estimators.decomposition",
+        "learningorchestra_tpu.toolkit.estimators.preprocessing",
+        "learningorchestra_tpu.toolkit.estimators.neighbors",
+        "learningorchestra_tpu.models.mlp",
+        "learningorchestra_tpu.models.vision",
+        "learningorchestra_tpu.models.text",
+    ):
+        importlib.import_module(mod)
+
+
+def resolve(module_path: str, class_name: str) -> Callable:
+    """Look up a factory; reference-era paths go through MODULE_ALIASES."""
+    _ensure_loaded()
+    native = MODULE_ALIASES.get(module_path, module_path)
+    with _lock:
+        factory = _registry.get((native, class_name))
+    if factory is None:
+        raise RegistryError(
+            f"unknown model/estimator: modulePath={module_path!r} "
+            f"class={class_name!r}"
+        )
+    return factory
+
+
+def exists(module_path: str, class_name: str) -> bool:
+    try:
+        resolve(module_path, class_name)
+        return True
+    except RegistryError:
+        return False
+
+
+def validate_init_params(
+    module_path: str, class_name: str, params: dict
+) -> list[str]:
+    """Names in ``params`` not accepted by the constructor — the
+    reference's signature check (model_image/utils.py:151-159) returning
+    the offending keys instead of a bare boolean."""
+    factory = resolve(module_path, class_name)
+    sig = inspect.signature(factory.__init__)
+    accepted = set(sig.parameters) - {"self"}
+    if any(
+        p.kind == inspect.Parameter.VAR_KEYWORD
+        for p in sig.parameters.values()
+    ):
+        return []
+    return [k for k in params if k not in accepted]
+
+
+def validate_method(class_or_factory: Any, method: str) -> bool:
+    """Method-exists check (reference: binary_executor_image/
+    utils.py:152-165 via inspect.getmembers)."""
+    return callable(getattr(class_or_factory, method, None))
+
+
+def validate_method_params(
+    class_or_factory: Any, method: str, params: dict
+) -> list[str]:
+    fn = getattr(class_or_factory, method, None)
+    if fn is None:
+        return list(params)
+    sig = inspect.signature(fn)
+    if any(
+        p.kind == inspect.Parameter.VAR_KEYWORD
+        for p in sig.parameters.values()
+    ):
+        return []
+    accepted = set(sig.parameters) - {"self"}
+    return [k for k in params if k not in accepted]
+
+
+def constructors() -> dict[str, Callable]:
+    """class_name → factory map (for the ``#`` spec namespace)."""
+    _ensure_loaded()
+    with _lock:
+        return {name: fac for (_, name), fac in _registry.items()}
+
+
+def list_registered() -> list[dict]:
+    _ensure_loaded()
+    with _lock:
+        return [
+            {"modulePath": mod, "class": name}
+            for (mod, name) in sorted(_registry)
+        ]

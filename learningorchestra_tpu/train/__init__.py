@@ -1,0 +1,5 @@
+"""Training layer: jitted loops with the keras-``fit`` contract."""
+
+from learningorchestra_tpu.train.neural import NeuralEstimator, TrainHistory
+
+__all__ = ["NeuralEstimator", "TrainHistory"]

@@ -1,0 +1,347 @@
+"""NeuralEstimator — keras-``fit`` semantics over a jitted JAX train loop.
+
+The reference trains keras models by calling ``model.fit(**params)`` inside
+a Flask worker, with epochs/batch_size/validation_split/callbacks arriving
+as request JSON (reference: microservices/binary_executor_image/
+training_function/train_function.py:84-87, binary_execution.py:188-200).
+This class accepts the same request shape but executes TPU-first:
+
+- the loss/grad/update step is a single jitted function; an epoch is one
+  `lax.scan` over pre-batched device-resident data — zero host round-trips
+  per step (the reference pays Python dispatch per batch);
+- parameters and optimizer state live in HBM between epochs; host sees them
+  only at checkpoint boundaries (`jax.device_get` at job edges, SURVEY §5.4);
+- compute dtype is bfloat16 by default on TPU (MXU-native), params fp32;
+- the distributed (mesh-sharded) training path lives in
+  ``learningorchestra_tpu.parallel`` — it reuses these loss definitions and
+  shards the batch axis so XLA inserts the gradient all-reduce over ICI
+  (replacing Horovod's host-side ring, reference: train_function.py:55-61).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from learningorchestra_tpu.toolkit.base import Estimator, as_array
+
+
+class TrainHistory(dict):
+    """keras-History-shaped: {"loss": [...], "accuracy": [...], ...}."""
+
+    def append(self, metrics: dict) -> None:
+        for key, val in metrics.items():
+            self.setdefault(key, []).append(float(val))
+
+
+def _batch_data(x: np.ndarray, y: np.ndarray, batch_size: int, rng):
+    """Shuffle + pad to a whole number of batches; returns (xb, yb, mask)
+    with shapes (n_batches, bs, ...).  Padding rows carry mask 0 so metrics
+    and gradients ignore them — keras parity without dropping remainders."""
+    n = x.shape[0]
+    if n == 0:
+        raise ValueError("cannot batch an empty dataset")
+    perm = rng.permutation(n)
+    n_batches = max(1, -(-n // batch_size))
+    pad = n_batches * batch_size - n
+    # np.resize cycles perm, so pad may exceed n (tiny datasets).
+    idx = np.concatenate([perm, np.resize(perm, pad)]) if pad else perm
+    mask = np.ones(n_batches * batch_size, np.float32)
+    if pad:
+        mask[n:] = 0.0
+    xb = x[idx].reshape(n_batches, batch_size, *x.shape[1:])
+    yb = y[idx].reshape(n_batches, batch_size, *y.shape[1:])
+    mb = mask.reshape(n_batches, batch_size)
+    return xb, yb, mb
+
+
+class NeuralEstimator(Estimator):
+    """Wraps a Flax module with fit/evaluate/predict/save/load."""
+
+    def __init__(
+        self,
+        module: nn.Module,
+        *,
+        loss: str = "auto",  # auto | softmax_ce | sigmoid_ce | mse
+        optimizer: Any = None,
+        learning_rate: float = 1e-3,
+        seed: int = 0,
+        compute_dtype: str = "bfloat16",
+    ):
+        self.module = module
+        self.loss = loss
+        self.learning_rate = learning_rate
+        self.seed = seed
+        self.compute_dtype = compute_dtype
+        self.optimizer = optimizer or optax.adam(learning_rate)
+        self.params = None
+        self.opt_state = None
+        self.history = TrainHistory()
+        self._step_fn = None
+        self._eval_fn = None
+        self._apply_fn = None
+
+    # -- keras-compile parity -------------------------------------------------
+
+    def compile(self, optimizer=None, loss: str | None = None, **_) -> None:
+        """Reconfigure optimizer/loss — the reference's ``compile_code``
+        contract, declaratively (train_function.py:75-82)."""
+        if optimizer is not None:
+            self.optimizer = optimizer
+        if loss is not None:
+            self.loss = loss
+        self._step_fn = None  # force re-jit with new config
+        self._eval_fn = None
+
+    # -- loss -----------------------------------------------------------------
+
+    def _resolve_loss(self, y: np.ndarray) -> str:
+        if self.loss != "auto":
+            return self.loss
+        if np.issubdtype(y.dtype, np.floating) and y.ndim > 1:
+            return "mse"
+        if np.issubdtype(y.dtype, np.floating) and y.ndim == 1:
+            return "mse"
+        return "softmax_ce"
+
+    @staticmethod
+    def _loss_and_metrics(loss_kind: str) -> Callable:
+        def fn(logits, y, mask):
+            msum = jnp.maximum(mask.sum(), 1.0)
+            if loss_kind == "softmax_ce":
+                per = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, y
+                )
+                loss = jnp.sum(per * mask) / msum
+                acc = jnp.sum(
+                    (jnp.argmax(logits, -1) == y).astype(jnp.float32) * mask
+                ) / msum
+                return loss, {"loss": loss, "accuracy": acc}
+            if loss_kind == "sigmoid_ce":
+                per = optax.sigmoid_binary_cross_entropy(
+                    logits[..., 0], y.astype(jnp.float32)
+                )
+                loss = jnp.sum(per * mask) / msum
+                acc = jnp.sum(
+                    ((logits[..., 0] > 0) == (y > 0)).astype(jnp.float32)
+                    * mask
+                ) / msum
+                return loss, {"loss": loss, "accuracy": acc}
+            # mse
+            pred = logits if logits.ndim == y.ndim else logits[..., 0]
+            per = jnp.mean(
+                (pred - y) ** 2, axis=tuple(range(1, pred.ndim))
+            ) if pred.ndim > 1 else (pred - y) ** 2
+            loss = jnp.sum(per * mask) / msum
+            return loss, {"loss": loss}
+
+        return fn
+
+    # -- init / jit -----------------------------------------------------------
+
+    def _init_params(self, x0: jnp.ndarray) -> None:
+        rng = jax.random.PRNGKey(self.seed)
+        self.params = self.module.init(rng, x0)
+        self.opt_state = self.optimizer.init(self.params)
+
+    def _build_step(self, loss_kind: str):
+        module, optimizer = self.module, self.optimizer
+        loss_fn = self._loss_and_metrics(loss_kind)
+        dtype = jnp.bfloat16 if self.compute_dtype == "bfloat16" else None
+
+        def step(params, opt_state, xb, yb, mb):
+            def objective(p):
+                xin = xb.astype(dtype) if dtype and jnp.issubdtype(
+                    xb.dtype, jnp.floating
+                ) else xb
+                logits = module.apply(p, xin).astype(jnp.float32)
+                return loss_fn(logits, yb, mb)
+
+            grads, metrics = jax.grad(
+                lambda p: objective(p), has_aux=True
+            )(params)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, metrics
+
+        def epoch(params, opt_state, xs, ys, ms):
+            def body(carry, batch):
+                params, opt_state = carry
+                xb, yb, mb = batch
+                params, opt_state, metrics = step(
+                    params, opt_state, xb, yb, mb
+                )
+                return (params, opt_state), metrics
+
+            (params, opt_state), metrics = jax.lax.scan(
+                body, (params, opt_state), (xs, ys, ms)
+            )
+            mean_metrics = jax.tree_util.tree_map(jnp.mean, metrics)
+            return params, opt_state, mean_metrics
+
+        def evaluate(params, xs, ys, ms):
+            def body(_, batch):
+                xb, yb, mb = batch
+                xin = xb.astype(dtype) if dtype and jnp.issubdtype(
+                    xb.dtype, jnp.floating
+                ) else xb
+                logits = module.apply(params, xin).astype(jnp.float32)
+                _, metrics = loss_fn(logits, yb, mb)
+                return None, metrics
+
+            _, metrics = jax.lax.scan(body, None, (xs, ys, ms))
+            return jax.tree_util.tree_map(jnp.mean, metrics)
+
+        return jax.jit(epoch), jax.jit(evaluate)
+
+    # -- keras-fit surface ----------------------------------------------------
+
+    def fit(
+        self,
+        x,
+        y,
+        epochs: int = 1,
+        batch_size: int = 32,
+        validation_split: float = 0.0,
+        validation_data: tuple | None = None,
+        shuffle: bool = True,
+        verbose: int = 0,
+        callbacks: list | None = None,
+        **_,
+    ) -> "NeuralEstimator":
+        x = np.asarray(as_array(x))
+        y_arr = np.asarray(y if not hasattr(y, "to_numpy") else y.to_numpy())
+        y_arr = y_arr.reshape(-1) if y_arr.ndim == 2 and y_arr.shape[1] == 1 \
+            else y_arr
+        loss_kind = self._resolve_loss(y_arr)
+        if loss_kind == "softmax_ce":
+            y_arr = y_arr.astype(np.int32)
+        else:
+            y_arr = y_arr.astype(np.float32)
+
+        if validation_data is None and validation_split > 0:
+            n_val = int(len(x) * validation_split)
+            # Tiny datasets: never let the split empty the train set; skip
+            # validation instead of silently training on nothing.
+            if 0 < n_val < len(x):
+                x, x_val = x[:-n_val], x[-n_val:]
+                y_arr, y_val = y_arr[:-n_val], y_arr[-n_val:]
+                validation_data = (x_val, y_val)
+
+        if self.params is None:
+            self._init_params(jnp.asarray(x[:1]))
+        if self._step_fn is None:
+            self._step_fn, self._eval_fn = self._build_step(loss_kind)
+
+        rng = np.random.default_rng(self.seed)
+        params, opt_state = self.params, self.opt_state
+        for epoch_i in range(epochs):
+            t0 = time.perf_counter()
+            xb, yb, mb = _batch_data(
+                x, y_arr, batch_size, rng if shuffle else _NoShuffle()
+            )
+            xs = jnp.asarray(xb)
+            ys = jnp.asarray(yb)
+            ms = jnp.asarray(mb)
+            params, opt_state, metrics = self._step_fn(
+                params, opt_state, xs, ys, ms
+            )
+            metrics = {k: float(v) for k, v in metrics.items()}
+            metrics["epoch_time"] = time.perf_counter() - t0
+            if validation_data is not None:
+                vx, vy = validation_data
+                vmetrics = self._evaluate_arrays(
+                    params, np.asarray(as_array(vx)),
+                    np.asarray(vy).reshape(-1), batch_size, loss_kind,
+                )
+                metrics.update({f"val_{k}": v for k, v in vmetrics.items()})
+            self.history.append(metrics)
+            if verbose:
+                print(f"epoch {epoch_i + 1}/{epochs}: {metrics}", flush=True)
+            for cb in callbacks or []:
+                if callable(cb):
+                    cb(epoch_i, metrics, self)
+        self.params, self.opt_state = params, opt_state
+        return self
+
+    def _evaluate_arrays(self, params, x, y, batch_size, loss_kind):
+        if loss_kind == "softmax_ce":
+            y = y.astype(np.int32)
+        else:
+            y = y.astype(np.float32)
+        xb, yb, mb = _batch_data(x, y, batch_size, _NoShuffle())
+        metrics = self._eval_fn(
+            params, jnp.asarray(xb), jnp.asarray(yb), jnp.asarray(mb)
+        )
+        return {k: float(v) for k, v in metrics.items()}
+
+    def evaluate(self, x, y, batch_size: int = 128, **_) -> dict:
+        x = np.asarray(as_array(x))
+        y = np.asarray(y if not hasattr(y, "to_numpy") else y.to_numpy())
+        y = y.reshape(-1)
+        loss_kind = self._resolve_loss(y)
+        if self._eval_fn is None:
+            if self.params is None:
+                raise RuntimeError("evaluate() before fit()")
+            self._step_fn, self._eval_fn = self._build_step(loss_kind)
+        return self._evaluate_arrays(
+            self.params, x, y, batch_size, loss_kind
+        )
+
+    def predict(self, x, batch_size: int = 512, **_):
+        x = np.asarray(as_array(x))
+        outs = []
+        if self._apply_fn is None:
+            self._apply_fn = jax.jit(self.module.apply)
+        apply = self._apply_fn
+        for i in range(0, len(x), batch_size):
+            outs.append(
+                np.asarray(apply(self.params, jnp.asarray(x[i:i + batch_size])))
+            )
+        return np.concatenate(outs, axis=0)
+
+    def predict_classes(self, x, batch_size: int = 512):
+        return np.argmax(self.predict(x, batch_size), axis=-1)
+
+    def score(self, x, y) -> float:
+        return float(self.evaluate(x, y).get("accuracy", 0.0))
+
+    # -- persistence (pytree checkpoint; see store/volumes.py) ---------------
+
+    def state_dict(self) -> dict:
+        return {
+            "params": jax.device_get(self.params),
+            "opt_state": jax.device_get(self.opt_state),
+            "history": dict(self.history),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+        self.history = TrainHistory(state.get("history", {}))
+
+    def __getstate__(self):
+        """dill support: drop jitted closures, keep module + host arrays."""
+        d = dict(self.__dict__)
+        d["_step_fn"] = None
+        d["_eval_fn"] = None
+        d["_apply_fn"] = None
+        d["params"] = jax.device_get(d["params"]) if d["params"] is not None \
+            else None
+        d["opt_state"] = jax.device_get(d["opt_state"]) \
+            if d["opt_state"] is not None else None
+        return d
+
+
+class _NoShuffle:
+    """Identity 'rng' for deterministic batching."""
+
+    def permutation(self, n: int):
+        return np.arange(n)

@@ -1,0 +1,41 @@
+"""Test config: force JAX onto a virtual 8-device CPU platform.
+
+This is the standard JAX trick for exercising multi-device semantics
+(sharding, collectives, ring attention) without TPU hardware — the
+substitute for the reference's missing fake-backend story (SURVEY §4).
+Must run before the first `import jax` anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_store(tmp_path):
+    from learningorchestra_tpu.store import DocumentStore
+
+    store = DocumentStore(tmp_path / "store")
+    yield store
+    store.close()
+
+
+@pytest.fixture()
+def artifacts(tmp_store):
+    from learningorchestra_tpu.store import ArtifactStore
+
+    return ArtifactStore(tmp_store)
+
+
+@pytest.fixture()
+def volumes(tmp_path):
+    from learningorchestra_tpu.store import VolumeStorage
+
+    return VolumeStorage(tmp_path / "volumes")

@@ -1,0 +1,111 @@
+"""Tests for metadata/lineage/ledger semantics (SURVEY §1 cross-cutting
+data model)."""
+
+import pytest
+
+from learningorchestra_tpu.store import LineageError
+
+
+def test_metadata_lifecycle(artifacts):
+    meta = artifacts.metadata.create("ds1", "dataset/csv")
+    assert meta["finished"] is False
+    assert meta["jobState"] == "pending"
+    assert artifacts.metadata.exists("ds1")
+    assert not artifacts.metadata.is_finished("ds1")
+
+    artifacts.metadata.mark_running("ds1")
+    assert artifacts.metadata.read("ds1")["jobState"] == "running"
+
+    artifacts.metadata.mark_finished("ds1", {"fields": ["a", "b"]})
+    doc = artifacts.metadata.read("ds1")
+    assert doc["finished"] is True
+    assert doc["fields"] == ["a", "b"]
+
+
+def test_metadata_failure_and_restart(artifacts):
+    artifacts.metadata.create("j", "train/tensorflow")
+    artifacts.metadata.mark_failed("j", "ValueError('boom')")
+    doc = artifacts.metadata.read("j")
+    assert doc["jobState"] == "failed"
+    assert doc["finished"] is False
+    artifacts.metadata.restart("j")
+    doc = artifacts.metadata.read("j")
+    assert doc["jobState"] == "pending"
+    assert doc["exception"] is None
+
+
+def test_lineage_walk_to_model(artifacts):
+    """A predict step must find the model spec behind a train step by
+    walking parentName upward (reference:
+    binary_executor_image/utils.py:261-280)."""
+    artifacts.metadata.create(
+        "m", "model/tensorflow", module_path="zoo.cnn", class_name="MnistCNN"
+    )
+    artifacts.metadata.create("t", "train/tensorflow", parent_name="m")
+    artifacts.metadata.create("p", "predict/tensorflow", parent_name="t")
+    model = artifacts.metadata.find_model_ancestor("p")
+    assert model["name"] == "m"
+    assert model["class"] == "MnistCNN"
+
+
+def test_lineage_missing_parent_raises(artifacts):
+    artifacts.metadata.create("t", "train/x", parent_name="ghost")
+    with pytest.raises(LineageError):
+        artifacts.metadata.parent_chain("t")
+
+
+def test_lineage_cycle_detected(artifacts):
+    artifacts.metadata.create("a", "train/x", parent_name="b")
+    artifacts.metadata.create("b", "train/x", parent_name="a")
+    with pytest.raises(LineageError):
+        artifacts.metadata.parent_chain("a")
+
+
+def test_ledger_records_and_history(artifacts):
+    artifacts.metadata.create("j", "train/x")
+    artifacts.ledger.record(
+        "j", description="run 1", method="fit", state="finished",
+        metrics={"loss": 0.5},
+    )
+    artifacts.ledger.record(
+        "j", description="run 2", state="failed", exception="OOM"
+    )
+    hist = artifacts.ledger.history("j")
+    assert len(hist) == 2
+    assert hist[0]["metrics"]["loss"] == 0.5
+    assert hist[1]["exception"] == "OOM"
+
+
+def test_read_page_metadata_first(artifacts):
+    """Clients read `finished` from the first doc of page 1 — metadata is
+    _id=0 and results sort by _id (reference: database_api_image/
+    server.py:52-80)."""
+    artifacts.metadata.create("r", "predict/x")
+    for i in range(5):
+        artifacts.documents.insert_one("r", {"row": i})
+    page = artifacts.read_page("r", limit=3)
+    assert page[0]["_id"] == 0
+    assert "finished" in page[0]
+
+
+def test_list_by_type(artifacts):
+    artifacts.metadata.create("d1", "dataset/csv")
+    artifacts.metadata.create("d2", "dataset/generic")
+    artifacts.metadata.create("m1", "model/tensorflow")
+    names = {m["name"] for m in artifacts.list_by_type("dataset")}
+    assert names == {"d1", "d2"}
+
+
+def test_volume_roundtrip(volumes):
+    import numpy as np
+
+    tree = {"w": np.arange(6).reshape(2, 3), "b": np.zeros(3)}
+    volumes.save_pytree("train/tensorflow", "t1", tree)
+    back = volumes.read_pytree("train/tensorflow", "t1")
+    assert np.array_equal(back["w"], tree["w"])
+
+    volumes.save_object("model/scikitlearn", "m1", {"k": 1})
+    assert volumes.read_object("model/scikitlearn", "m1") == {"k": 1}
+    assert volumes.exists("model/scikitlearn", "m1")
+    assert volumes.delete("model/scikitlearn", "m1")
+    assert not volumes.exists("model/scikitlearn", "m1")

@@ -1,0 +1,115 @@
+"""Unit tests for the embedded document store (SURVEY §7 step 1)."""
+
+import threading
+
+from learningorchestra_tpu.store import DocumentStore
+
+
+def test_insert_auto_id_and_find(tmp_store):
+    assert tmp_store.insert_one("c", {"a": 1}) == 0
+    assert tmp_store.insert_one("c", {"a": 2}) == 1
+    docs = tmp_store.find("c")
+    assert [d["_id"] for d in docs] == [0, 1]
+    assert docs[0]["a"] == 1
+
+
+def test_insert_explicit_id_reserves_counter(tmp_store):
+    tmp_store.insert_one("c", {"m": True}, _id=0)
+    assert tmp_store.insert_one("c", {"r": 1}) == 1
+
+
+def test_query_operators(tmp_store):
+    for i in range(10):
+        tmp_store.insert_one("c", {"v": i})
+    assert len(tmp_store.find("c", {"v": {"$gte": 5}})) == 5
+    assert len(tmp_store.find("c", {"v": {"$lt": 3}})) == 3
+    assert len(tmp_store.find("c", {"v": {"$in": [1, 2]}})) == 2
+    assert len(tmp_store.find("c", {"v": 7})) == 1
+    assert len(tmp_store.find("c", {"v": {"$ne": 7}})) == 9
+
+
+def test_skip_limit_sort(tmp_store):
+    for i in range(10):
+        tmp_store.insert_one("c", {"v": i})
+    page = tmp_store.find("c", skip=2, limit=3)
+    assert [d["_id"] for d in page] == [2, 3, 4]
+
+
+def test_update_delete(tmp_store):
+    tmp_store.insert_one("c", {"v": 1})
+    assert tmp_store.update_one("c", 0, {"v": 2})
+    assert tmp_store.find_one("c", 0)["v"] == 2
+    assert tmp_store.delete_one("c", 0)
+    assert tmp_store.find_one("c", 0) is None
+
+
+def test_persistence_replay(tmp_path):
+    s1 = DocumentStore(tmp_path / "db")
+    s1.insert_one("c", {"v": 1})
+    s1.insert_one("c", {"v": 2})
+    s1.update_one("c", 0, {"v": 10})
+    s1.delete_one("c", 1)
+    s1.close()
+
+    s2 = DocumentStore(tmp_path / "db")
+    docs = s2.find("c")
+    assert len(docs) == 1
+    assert docs[0]["v"] == 10
+    # IDs keep advancing after replay.
+    assert s2.insert_one("c", {"v": 3}) == 2
+    s2.close()
+
+
+def test_compact(tmp_path):
+    s = DocumentStore(tmp_path / "db")
+    for i in range(100):
+        s.insert_one("c", {"v": i})
+        s.update_one("c", i, {"v": i * 2})
+    s.compact("c")
+    s.close()
+    s2 = DocumentStore(tmp_path / "db")
+    assert s2.count("c") == 100
+    assert s2.find_one("c", 50)["v"] == 100
+    s2.close()
+
+
+def test_aggregate_counts_excludes_metadata(tmp_store):
+    tmp_store.insert_one("c", {"meta": True}, _id=0)
+    for v in ["a", "b", "a", "a"]:
+        tmp_store.insert_one("c", {"f": v})
+    counts = tmp_store.aggregate_counts("c", "f")
+    assert counts == {"a": 3, "b": 1}
+
+
+def test_concurrent_inserts_unique_ids(tmp_store):
+    """Atomic ID allocation — the reference's read-then-insert races
+    (binary_executor_image/utils.py:116-139); ours must not."""
+
+    def worker():
+        for _ in range(50):
+            tmp_store.insert_one("c", {"x": 1})
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    docs = tmp_store.find("c")
+    ids = [d["_id"] for d in docs]
+    assert len(ids) == 400
+    assert len(set(ids)) == 400
+
+
+def test_insert_many_batched(tmp_store):
+    n = tmp_store.insert_many("c", ({"v": i} for i in range(1000)))
+    assert n == 1000
+    assert tmp_store.count("c") == 1000
+
+
+def test_drop_and_list(tmp_store):
+    tmp_store.insert_one("a1", {})
+    tmp_store.insert_one("b1", {})
+    assert tmp_store.list_collections() == ["a1", "b1"]
+    assert tmp_store.drop("a1")
+    assert not tmp_store.drop("a1")
+    assert tmp_store.list_collections() == ["b1"]

@@ -1,0 +1,76 @@
+"""Parameter-DSL tests ($name, $name.key, #spec — SURVEY §1 cross-cutting
+parameter DSL)."""
+
+import pytest
+
+from learningorchestra_tpu import dsl
+
+
+class FakeLoader:
+    def __init__(self, artifacts):
+        self.artifacts = artifacts
+
+    def load(self, name):
+        return self.artifacts[name]
+
+
+def test_dollar_loads_artifact():
+    loader = FakeLoader({"ds": [1, 2, 3]})
+    assert dsl.resolve_value("$ds", loader) == [1, 2, 3]
+
+
+def test_dollar_key_indexes():
+    loader = FakeLoader({"split": ([10, 20], [1, 2]), "d": {"x": 5}})
+    assert dsl.resolve_value("$split.0", loader) == [10, 20]
+    assert dsl.resolve_value("$split.1", loader) == [1, 2]
+    assert dsl.resolve_value("$d.x", loader) == 5
+
+
+def test_plain_values_pass_through():
+    loader = FakeLoader({})
+    assert dsl.resolve_value(42, loader) == 42
+    assert dsl.resolve_value("plain", loader) == "plain"
+    assert dsl.resolve_value(None, loader) is None
+
+
+def test_lists_and_dicts_resolve_elementwise():
+    loader = FakeLoader({"a": 1, "b": 2})
+    assert dsl.resolve_value(["$a", "$b", 3], loader) == [1, 2, 3]
+    assert dsl.resolve_params(
+        {"x": "$a", "nested": {"y": "$b"}}, loader
+    ) == {"x": 1, "nested": {"y": 2}}
+
+
+def test_hash_spec_evaluates_whitelisted():
+    loader = FakeLoader({})
+    opt = dsl.resolve_value("#optax.adam(0.001)", loader)
+    assert hasattr(opt, "update")  # GradientTransformation
+    arr = dsl.resolve_value("#jnp.ones((2, 2))", loader)
+    assert arr.shape == (2, 2)
+
+
+def test_hash_spec_can_construct_registry_classes():
+    est = dsl.evaluate_spec("LogisticRegression(max_iter=5)")
+    assert type(est).__name__ == "LogisticRegression"
+
+
+def test_hash_spec_no_builtins():
+    with pytest.raises(dsl.DSLResolutionError):
+        dsl.evaluate_spec("__import__('os').system('true')")
+    with pytest.raises(dsl.DSLResolutionError):
+        dsl.evaluate_spec("open('/etc/passwd')")
+
+
+def test_missing_artifact_raises():
+    loader = FakeLoader({})
+    with pytest.raises(KeyError):
+        dsl.resolve_value("$ghost", loader)
+
+
+def test_split_special_params():
+    special, rest = dsl.split_special_params(
+        {"epochs": 3, "callbacks": ["x"], "rank0callbacks": ["y"]},
+        ("callbacks", "rank0callbacks"),
+    )
+    assert special == {"callbacks": ["x"], "rank0callbacks": ["y"]}
+    assert rest == {"epochs": 3}

@@ -1,0 +1,114 @@
+"""Job engine tests (SURVEY §7 step 2)."""
+
+import threading
+import time
+
+import pytest
+
+from learningorchestra_tpu.jobs import JobEngine, JobState
+from learningorchestra_tpu.jobs.engine import Preempted
+
+
+@pytest.fixture()
+def engine(artifacts):
+    eng = JobEngine(artifacts, max_workers=4)
+    yield eng
+    eng.shutdown()
+
+
+def test_success_flow(artifacts, engine):
+    artifacts.metadata.create("j1", "train/x")
+    engine.submit(
+        "j1", lambda: 42, description="d", method="fit",
+        on_success=lambda r: {"answer": r},
+    )
+    assert engine.wait("j1", timeout=10) == 42
+    meta = artifacts.metadata.read("j1")
+    assert meta["finished"] is True
+    assert meta["jobState"] == JobState.FINISHED
+    assert meta["answer"] == 42
+    hist = artifacts.ledger.history("j1")
+    assert hist[-1]["state"] == "finished"
+
+
+def test_failure_recorded(artifacts, engine):
+    artifacts.metadata.create("j2", "train/x")
+
+    def boom():
+        raise ValueError("bad hyperparameter")
+
+    engine.submit("j2", boom, description="d")
+    engine.wait("j2", timeout=10)
+    meta = artifacts.metadata.read("j2")
+    assert meta["jobState"] == JobState.FAILED
+    assert meta["finished"] is False
+    assert "bad hyperparameter" in meta["exception"]
+    hist = artifacts.ledger.history("j2")
+    assert hist[-1]["state"] == "failed"
+    assert "ValueError" in hist[-1]["exception"]
+
+
+def test_stdout_capture(artifacts, engine):
+    """Function jobs capture stdout into the execution document, like the
+    reference's functionMessage (code_executor_image/utils.py:113-138)."""
+    artifacts.metadata.create("j3", "function/python")
+
+    def chatty():
+        print("hello from user code")
+        return 1
+
+    engine.submit("j3", chatty, capture_stdout=True)
+    engine.wait("j3", timeout=10)
+    hist = artifacts.ledger.history("j3")
+    assert "hello from user code" in hist[-1]["functionMessage"]
+
+
+def test_preemption_retry(artifacts, engine):
+    artifacts.metadata.create("j4", "train/x")
+    attempts = {"n": 0}
+
+    def flaky():
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise Preempted()
+        return "ok"
+
+    engine.submit("j4", flaky)
+    assert engine.wait("j4", timeout=10) == "ok"
+    assert attempts["n"] == 3
+    states = [h["state"] for h in artifacts.ledger.history("j4")]
+    assert states.count("preempted") == 2
+    assert states[-1] == "finished"
+
+
+def test_async_poll_until_finished(artifacts, engine):
+    """The client contract: POST returns immediately, GET polls until the
+    metadata doc shows finished=True (reference:
+    database_api_image/utils.py:72-77)."""
+    artifacts.metadata.create("j5", "train/x")
+    release = threading.Event()
+
+    def slow():
+        release.wait(10)
+        return "done"
+
+    engine.submit("j5", slow)
+    # Immediately after submit the job is not finished.
+    assert not artifacts.metadata.is_finished("j5")
+    release.set()
+    deadline = time.time() + 10
+    while not artifacts.metadata.is_finished("j5"):
+        assert time.time() < deadline
+        time.sleep(0.01)
+
+
+def test_rerun_after_restart(artifacts, engine):
+    """PATCH re-run: restart metadata, submit again, ledger accumulates."""
+    artifacts.metadata.create("j6", "train/x")
+    engine.submit("j6", lambda: 1)
+    engine.wait("j6", timeout=10)
+    artifacts.metadata.restart("j6")
+    assert artifacts.metadata.read("j6")["jobState"] == JobState.PENDING
+    engine.submit("j6", lambda: 2)
+    assert engine.wait("j6", timeout=10) == 2
+    assert len(artifacts.ledger.history("j6")) == 2

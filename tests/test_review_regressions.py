@@ -1,0 +1,75 @@
+"""Regressions for code-review findings (round 1 review pass)."""
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu import dsl
+from learningorchestra_tpu.models import MLPClassifier
+from learningorchestra_tpu.store import DuplicateArtifact
+
+
+def test_tiny_dataset_smaller_than_batch():
+    """n << batch_size: padding must cycle indices, not crash."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(10, 3)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int64)
+    m = MLPClassifier(hidden_layer_sizes=(4,), num_classes=2)
+    m.fit(x, y, epochs=1, batch_size=32)
+    assert len(m.history["loss"]) == 1
+
+
+def test_validation_split_rounding_to_zero():
+    """validation_split that rounds to 0 rows must not empty train set."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(10, 3)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int64)
+    m = MLPClassifier(hidden_layer_sizes=(4,), num_classes=2)
+    m.fit(x, y, epochs=1, batch_size=4, validation_split=0.05)
+    assert "loss" in m.history
+    assert "val_loss" not in m.history  # skipped, not trained-on-nothing
+
+
+def test_volume_name_traversal_rejected(volumes):
+    with pytest.raises(ValueError):
+        volumes.save_object("train/x", "../../evil", {})
+    with pytest.raises(ValueError):
+        volumes.path_for("train/x", "a/b")
+
+
+def test_dotted_artifact_names_resolve(artifacts):
+    class Loader:
+        def __init__(self):
+            self.arts = {"titanic.csv": "whole", "titanic": {"csv": "keyed"}}
+
+        def load(self, name):
+            return self.arts[name]
+
+    loader = Loader()
+    # Whole dotted name wins when it exists...
+    assert dsl.resolve_value("$titanic.csv", loader) == "whole"
+    # ...and the name.key split still works when it doesn't.
+    del loader.arts["titanic.csv"]
+    assert dsl.resolve_value("$titanic.csv", loader) == "keyed"
+
+
+def test_duplicate_metadata_create_raises(artifacts):
+    artifacts.metadata.create("dup", "dataset/csv")
+    with pytest.raises(DuplicateArtifact):
+        artifacts.metadata.create("dup", "dataset/csv")
+    # Explicit overwrite remains possible for internal re-creation paths.
+    artifacts.metadata.create("dup", "dataset/csv", overwrite=True)
+
+
+def test_job_engine_prunes_completed(artifacts):
+    from learningorchestra_tpu.jobs import JobEngine
+
+    eng = JobEngine(artifacts, max_workers=2)
+    eng._MAX_DONE_RETAINED = 5
+    for i in range(20):
+        name = f"job{i}"
+        artifacts.metadata.create(name, "train/x")
+        eng.submit(name, lambda: 1)
+        eng.wait(name, timeout=10)
+    with eng._lock:
+        assert len(eng._futures) <= 6  # cap + the in-flight slot
+    eng.shutdown()

@@ -1,0 +1,150 @@
+"""Registry + classical estimator tests (JAX-native sklearn/MLlib parity —
+SURVEY §2.3 toolkit row)."""
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.toolkit import registry
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    rng = np.random.default_rng(42)
+    n_per = 100
+    centers = np.array([[0, 0, 0], [4, 4, 0], [0, 4, 4]])
+    x = np.concatenate(
+        [rng.normal(c, 1.0, size=(n_per, 3)) for c in centers]
+    ).astype(np.float32)
+    y = np.repeat(np.arange(3), n_per)
+    return x, y
+
+
+def test_reference_module_paths_alias(blobs):
+    """A reference client posting sklearn paths gets JAX estimators
+    (parity with model_image/model.py:92-162)."""
+    x, y = blobs
+    factory = registry.resolve("sklearn.linear_model", "LogisticRegression")
+    model = factory(max_iter=100).fit(x, y)
+    assert model.score(x, y) > 0.9
+    assert registry.exists("sklearn.ensemble", "RandomForestClassifier")
+    assert registry.exists("sklearn.naive_bayes", "GaussianNB")
+    assert registry.exists(
+        "tensorflow.keras.applications", "ResNet50"
+    )
+    assert not registry.exists("sklearn.linear_model", "NopeClassifier")
+
+
+def test_validate_init_params():
+    bad = registry.validate_init_params(
+        "sklearn.linear_model", "LogisticRegression",
+        {"max_iter": 10, "bogus_arg": 1},
+    )
+    assert bad == ["bogus_arg"]
+
+
+def test_validate_method_and_params():
+    factory = registry.resolve("sklearn.linear_model", "LogisticRegression")
+    assert registry.validate_method(factory, "fit")
+    assert not registry.validate_method(factory, "levitate")
+    assert registry.validate_method_params(factory, "fit", {"x": 1, "y": 2}) \
+        == []
+    assert registry.validate_method_params(
+        factory, "fit", {"x": 1, "zz": 2}
+    ) == ["zz"]
+
+
+@pytest.mark.parametrize(
+    "module,cls,kwargs,min_acc",
+    [
+        ("sklearn.linear_model", "LogisticRegression", {"max_iter": 100}, 0.9),
+        ("sklearn.tree", "DecisionTreeClassifier", {"max_depth": 6}, 0.9),
+        (
+            "sklearn.ensemble",
+            "RandomForestClassifier",
+            {"n_estimators": 15, "max_depth": 6},
+            0.9,
+        ),
+        (
+            "sklearn.ensemble",
+            "GradientBoostingClassifier",
+            {"n_estimators": 10, "max_depth": 3},
+            0.9,
+        ),
+        ("sklearn.naive_bayes", "GaussianNB", {}, 0.9),
+        ("sklearn.neighbors", "KNeighborsClassifier", {"n_neighbors": 5}, 0.9),
+    ],
+)
+def test_classifiers_learn_blobs(blobs, module, cls, kwargs, min_acc):
+    x, y = blobs
+    model = registry.resolve(module, cls)(**kwargs).fit(x, y)
+    assert model.score(x, y) >= min_acc
+    preds = model.predict(x)
+    assert set(np.unique(preds)) <= set(np.unique(y))
+
+
+def test_predict_proba_shape(blobs):
+    x, y = blobs
+    model = registry.resolve("sklearn.naive_bayes", "GaussianNB")().fit(x, y)
+    probs = np.asarray(model.predict_proba(x))
+    assert probs.shape == (len(x), 3)
+    np.testing.assert_allclose(probs.sum(1), 1.0, atol=1e-4)
+
+
+def test_linear_regression_exact():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(200, 4)).astype(np.float32)
+    w = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+    y = x @ w + 0.7
+    lr = registry.resolve("sklearn.linear_model", "LinearRegression")()
+    lr.fit(x, y)
+    np.testing.assert_allclose(np.asarray(lr.coef_), w, atol=1e-3)
+    assert abs(float(lr.intercept_) - 0.7) < 1e-3
+    assert lr.score(x, y) > 0.999
+
+
+def test_kmeans_recovers_clusters(blobs):
+    x, y = blobs
+    km = registry.resolve("sklearn.cluster", "KMeans")(
+        n_clusters=3, max_iter=50
+    ).fit(x)
+    labels = km.predict(x)
+    # Cluster purity: majority label per cluster covers >90% of points.
+    purity = sum(
+        np.bincount(y[labels == c]).max()
+        for c in range(3)
+        if (labels == c).any()
+    ) / len(y)
+    assert purity > 0.9
+
+
+def test_pca_orthogonal_components(blobs):
+    x, _ = blobs
+    pca = registry.resolve("sklearn.decomposition", "PCA")(n_components=2)
+    z = np.asarray(pca.fit_transform(x))
+    assert z.shape == (len(x), 2)
+    comps = np.asarray(pca.components_)
+    np.testing.assert_allclose(comps @ comps.T, np.eye(2), atol=1e-4)
+
+
+def test_scalers(blobs):
+    x, _ = blobs
+    ss = registry.resolve("sklearn.preprocessing", "StandardScaler")()
+    z = np.asarray(ss.fit_transform(x))
+    np.testing.assert_allclose(z.mean(0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(z.std(0), 1.0, atol=1e-3)
+    mm = registry.resolve("sklearn.preprocessing", "MinMaxScaler")()
+    z2 = np.asarray(mm.fit_transform(x))
+    assert z2.min() >= -1e-6 and z2.max() <= 1 + 1e-6
+
+
+def test_tsne_runs_small():
+    rng = np.random.default_rng(0)
+    x = np.concatenate(
+        [rng.normal(0, 1, (30, 5)), rng.normal(8, 1, (30, 5))]
+    ).astype(np.float32)
+    tsne = registry.resolve("sklearn.manifold", "TSNE")(
+        n_iter=100, perplexity=10.0
+    )
+    emb = np.asarray(tsne.fit_transform(x))
+    assert emb.shape == (60, 2)
+    assert np.isfinite(emb).all()
