@@ -1,0 +1,12 @@
+"""REST API layer.
+
+One HTTP front server replaces the reference's KrakenD gateway + nine
+Flask containers (SURVEY §1 L1-L2): the full public route table of
+``microservices/krakend/krakend.json`` (~110 endpoints under
+``/api/learningOrchestra/v1``) served by a single threaded process over
+the service layer.
+"""
+
+from learningorchestra_tpu.api.server import APIServer, Router, serve
+
+__all__ = ["APIServer", "Router", "serve"]

@@ -1,0 +1,598 @@
+"""The HTTP front server and route table.
+
+Route scheme (reference: microservices/krakend/krakend.json):
+``{verb} /api/learningOrchestra/v1/{service}/{tool}[/{name}]``, with the
+dataset service's paginated GET as the universal poll path (SURVEY §3.5).
+Status mapping follows the reference's validation pipeline: 409 duplicate
+name, 404 missing artifact, 406 semantic errors, 201 created with the
+artifact's GET URI in the body (binary_executor_image/server.py:99-107).
+
+Implementation: stdlib ``ThreadingHTTPServer`` + a regex route registry —
+no web-framework dependency; handlers are thin adapters onto the service
+classes.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+from urllib.parse import parse_qs, urlparse
+
+from learningorchestra_tpu.config import Config, get_config
+from learningorchestra_tpu.services import (
+    BuilderService,
+    DatasetService,
+    ExecutorService,
+    ExploreService,
+    FunctionService,
+    ModelService,
+    ServiceContext,
+    TransformService,
+)
+from learningorchestra_tpu.services.context import (
+    ConflictError,
+    NotFoundError,
+    ValidationError,
+)
+from learningorchestra_tpu.store.artifacts import DuplicateArtifact
+from learningorchestra_tpu.toolkit import registry
+from learningorchestra_tpu.toolkit.registry import RegistryError
+
+
+class BadRequest(Exception):
+    """Malformed client input (non-JSON body handled separately) → 400."""
+
+
+class Router:
+    """Regex route table: (verb, pattern) → handler(match, body, query)."""
+
+    def __init__(self, prefix: str):
+        self.prefix = prefix.rstrip("/")
+        self.routes: list[tuple[str, re.Pattern, Callable]] = []
+
+    def add(self, verb: str, pattern: str, handler: Callable) -> None:
+        full = re.compile("^" + self.prefix + pattern + "/?$")
+        self.routes.append((verb.upper(), full, handler))
+
+    def dispatch(self, verb: str, path: str, body: dict, query: dict):
+        matched_path = False
+        for route_verb, pattern, handler in self.routes:
+            m = pattern.match(path)
+            if m:
+                matched_path = True
+                if route_verb == verb:
+                    return handler(m, body, query)
+        if matched_path:
+            return 405, {"error": f"method {verb} not allowed on {path}"}
+        return 404, {"error": f"no such route: {path}"}
+
+
+class APIServer:
+    """Service wiring + route table + HTTP plumbing."""
+
+    def __init__(self, config: Config | None = None,
+                 ctx: ServiceContext | None = None):
+        self.config = config or get_config()
+        self.ctx = ctx or ServiceContext(self.config)
+        self.dataset = DatasetService(self.ctx)
+        self.transform = TransformService(self.ctx)
+        self.explore = ExploreService(self.ctx)
+        self.model = ModelService(self.ctx)
+        self.executor = ExecutorService(self.ctx)
+        self.function = FunctionService(self.ctx)
+        self.builder = BuilderService(self.ctx)
+        self.router = Router(self.config.api.api_prefix)
+        self._register_routes()
+        self._httpd: ThreadingHTTPServer | None = None
+
+    # -- helpers --------------------------------------------------------------
+
+    def _uri(self, service_path: str, name: str) -> str:
+        return f"{self.config.api.api_prefix}/{service_path}/{name}"
+
+    def _created(self, service_path: str, meta: dict):
+        """201 + GET URI (reference: server.py:99-107)."""
+        return 201, {
+            "result": self._uri(service_path, meta["name"]),
+            "name": meta["name"],
+            "metadata": meta,
+        }
+
+    @staticmethod
+    def _page_args(query: dict):
+        q = query.get("query")
+        parsed = json.loads(q) if q else None
+        return {
+            "query": parsed,
+            "skip": _int_param(query, "skip", 0),
+            "limit": _int_param(query, "limit", 20),
+        }
+
+    # -- route table (SURVEY §2.2) -------------------------------------------
+
+    def _register_routes(self) -> None:
+        add = self.router.add
+        TOOL = r"(?P<tool>[A-Za-z0-9_\-]+)"
+        NAME = r"(?P<name>[A-Za-z0-9_.\-]+)"
+
+        # ---- Dataset ----
+        def dataset_create(m, body, query):
+            kind = m.group("tool")
+            name, url = body.get("datasetName") or body.get("name"), \
+                body.get("url")
+            if not url:
+                raise ValidationError("missing 'url'")
+            if kind == "csv":
+                meta = self.dataset.create_csv(name, url)
+            else:
+                meta = self.dataset.create_generic(name, url)
+            return self._created(f"dataset/{kind}", meta)
+
+        add("POST", rf"/dataset/{TOOL}", dataset_create)
+        add(
+            "GET", rf"/dataset/{TOOL}",
+            lambda m, b, q: (
+                200, self.dataset.list_metadata(f"dataset/{m.group('tool')}")
+            ),
+        )
+        add(
+            "GET", rf"/dataset/{TOOL}/{NAME}",
+            lambda m, b, q: (
+                200,
+                self.dataset.read_page(m.group("name"), **self._page_args(q)),
+            ),
+        )
+        add(
+            "DELETE", rf"/dataset/{TOOL}/{NAME}",
+            lambda m, b, q: (
+                self.dataset.delete(m.group("name")),
+                (200, {"result": "deleted"}),
+            )[1],
+        )
+
+        # ---- Transform: projection ----
+        def projection_create(m, body, query):
+            meta = self.transform.create_projection(
+                body.get("projectionName") or body.get("name"),
+                body.get("datasetName") or body.get("parentName"),
+                body.get("fields") or [],
+            )
+            return self._created("transform/projection", meta)
+
+        add("POST", r"/transform/projection", projection_create)
+        add(
+            "GET", r"/transform/projection/" + NAME,
+            lambda m, b, q: (
+                200,
+                self.dataset.read_page(m.group("name"), **self._page_args(q)),
+            ),
+        )
+        add(
+            "DELETE", r"/transform/projection/" + NAME,
+            lambda m, b, q: (
+                self.dataset.delete(m.group("name")),
+                (200, {"result": "deleted"}),
+            )[1],
+        )
+
+        # ---- Transform: dataType ----
+        def datatype_patch(m, body, query):
+            meta = self.transform.update_field_types(
+                body.get("datasetName") or body.get("name"),
+                body.get("types") or body.get("fields") or {},
+            )
+            return 200, {"metadata": meta}
+
+        add("PATCH", r"/transform/dataType", datatype_patch)
+
+        # ---- Transform: generic (scikitlearn | tensorflow) ----
+        def transform_create(m, body, query):
+            tool = m.group("tool")
+            meta = self.transform.create_generic(
+                body.get("name"),
+                module_path=body.get("modulePath"),
+                class_name=body.get("class"),
+                class_parameters=body.get("classParameters"),
+                method=body.get("method"),
+                method_parameters=body.get("methodParameters"),
+                artifact_type=f"transform/{tool}",
+                description=body.get("description", ""),
+            )
+            return self._created(f"transform/{tool}", meta)
+
+        add("POST", rf"/transform/{TOOL}", transform_create)
+        add(
+            "GET", rf"/transform/{TOOL}/{NAME}",
+            lambda m, b, q: (
+                200,
+                self.dataset.read_page(m.group("name"), **self._page_args(q)),
+            ),
+        )
+        add(
+            "DELETE", rf"/transform/{TOOL}/{NAME}",
+            lambda m, b, q: (
+                self.executor.delete(m.group("name")),
+                (200, {"result": "deleted"}),
+            )[1],
+        )
+
+        # ---- Explore ----
+        def histogram_create(m, body, query):
+            meta = self.explore.create_histogram(
+                body.get("histogramName") or body.get("name"),
+                body.get("datasetName") or body.get("parentName"),
+                body.get("fields") or [],
+            )
+            return self._created("explore/histogram", meta)
+
+        add("POST", r"/explore/histogram", histogram_create)
+        add(
+            "GET", r"/explore/histogram/" + NAME,
+            lambda m, b, q: (
+                200,
+                self.dataset.read_page(m.group("name"), **self._page_args(q)),
+            ),
+        )
+
+        def explore_create(m, body, query):
+            tool = m.group("tool")
+            meta = self.explore.create_plot(
+                body.get("name"),
+                module_path=body.get("modulePath"),
+                class_name=body.get("class"),
+                class_parameters=body.get("classParameters"),
+                method=body.get("method", "fit_transform"),
+                method_parameters=body.get("methodParameters"),
+                artifact_type=f"explore/{tool}",
+                color_by=body.get("colorBy"),
+                description=body.get("description", ""),
+            )
+            return self._created(f"explore/{tool}", meta)
+
+        add("POST", rf"/explore/{TOOL}", explore_create)
+        # GET {name} returns the PNG; {name}/metadata returns docs
+        # (reference: krakend.json explore block, SURVEY §2.2).
+        add(
+            "GET", rf"/explore/{TOOL}/{NAME}/metadata",
+            lambda m, b, q: (
+                200,
+                self.dataset.read_page(m.group("name"), **self._page_args(q)),
+            ),
+        )
+
+        def explore_image(m, body, query):
+            data = self.explore.read_image(m.group("name"))
+            return 200, ("image/png", data)
+
+        add("GET", rf"/explore/{TOOL}/{NAME}", explore_image)
+        add(
+            "DELETE", rf"/explore/{TOOL}/{NAME}",
+            lambda m, b, q: (
+                self.executor.delete(m.group("name")),
+                (200, {"result": "deleted"}),
+            )[1],
+        )
+
+        # ---- Model ----
+        def model_create(m, body, query):
+            tool = m.group("tool")
+            meta = self.model.create(
+                body.get("modelName") or body.get("name"),
+                module_path=body.get("modulePath"),
+                class_name=body.get("class"),
+                class_parameters=body.get("classParameters"),
+                artifact_type=f"model/{tool}",
+                description=body.get("description", ""),
+            )
+            return self._created(f"model/{tool}", meta)
+
+        def model_update(m, body, query):
+            meta = self.model.update(
+                m.group("name"),
+                class_parameters=body.get("classParameters"),
+                description=body.get("description", ""),
+            )
+            return 200, {"metadata": meta}
+
+        add("POST", rf"/model/{TOOL}", model_create)
+        add("PATCH", rf"/model/{TOOL}/{NAME}", model_update)
+        add(
+            "GET", rf"/model/{TOOL}/{NAME}",
+            lambda m, b, q: (
+                200,
+                self.dataset.read_page(m.group("name"), **self._page_args(q)),
+            ),
+        )
+        add(
+            "DELETE", rf"/model/{TOOL}/{NAME}",
+            lambda m, b, q: (
+                self.model.delete(m.group("name")),
+                (200, {"result": "deleted"}),
+            )[1],
+        )
+
+        # ---- Tune / Train / Evaluate / Predict ----
+        def exec_create(service):
+            def handler(m, body, query):
+                tool = m.group("tool")
+                name = body.get("name")
+                parent = body.get("parentName") or body.get("modelName")
+                if service == "tune" and body.get("paramGrid"):
+                    meta = self.executor.create_tune(
+                        name,
+                        parent_name=parent,
+                        method=body.get("method", "fit"),
+                        param_grid=body.get("paramGrid"),
+                        method_parameters=body.get("methodParameters"),
+                        scoring_parameters=body.get("scoringParameters"),
+                        artifact_type=f"tune/{tool}",
+                        description=body.get("description", ""),
+                    )
+                else:
+                    meta = self.executor.create(
+                        name,
+                        parent_name=parent,
+                        method=body.get("method"),
+                        method_parameters=body.get("methodParameters"),
+                        artifact_type=f"{service}/{tool}",
+                        description=body.get("description", ""),
+                    )
+                return self._created(f"{service}/{tool}", meta)
+
+            return handler
+
+        def exec_update(m, body, query):
+            meta = self.executor.update(
+                m.group("name"),
+                method_parameters=body.get("methodParameters"),
+                description=body.get("description", ""),
+            )
+            return 200, {"metadata": meta}
+
+        for service in ("tune", "train", "evaluate", "predict"):
+            add("POST", rf"/{service}/{TOOL}", exec_create(service))
+            add("PATCH", rf"/{service}/{TOOL}/{NAME}", exec_update)
+            add(
+                "GET", rf"/{service}/{TOOL}/{NAME}",
+                lambda m, b, q: (
+                    200,
+                    self.dataset.read_page(
+                        m.group("name"), **self._page_args(q)
+                    ),
+                ),
+            )
+            add(
+                "DELETE", rf"/{service}/{TOOL}/{NAME}",
+                lambda m, b, q: (
+                    self.executor.delete(m.group("name")),
+                    (200, {"result": "deleted"}),
+                )[1],
+            )
+
+        # ---- Builder ----
+        def builder_create(m, body, query):
+            metas = self.builder.create(
+                training_dataset=body.get("trainDatasetName"),
+                test_dataset=body.get("testDatasetName"),
+                classifiers=body.get("classifiersList")
+                or body.get("classifiers") or [],
+                label_field=body.get("labelField", "label"),
+                feature_fields=body.get("featureFields"),
+                modeling_code=body.get("modelingCode"),
+                classifier_parameters=body.get("classifierParameters"),
+                description=body.get("description", ""),
+            )
+            return 201, {
+                "result": [
+                    self._uri("builder/sparkml", mm["name"]) for mm in metas
+                ]
+            }
+
+        add("POST", rf"/builder/{TOOL}", builder_create)
+        add(
+            "GET", rf"/builder/{TOOL}/{NAME}",
+            lambda m, b, q: (
+                200,
+                self.dataset.read_page(m.group("name"), **self._page_args(q)),
+            ),
+        )
+
+        # ---- Function ----
+        def function_create(m, body, query):
+            meta = self.function.create(
+                body.get("name"),
+                function=body.get("function"),
+                function_parameters=body.get("functionParameters"),
+                description=body.get("description", ""),
+            )
+            return self._created("function/python", meta)
+
+        def function_update(m, body, query):
+            meta = self.function.update(
+                m.group("name"),
+                function=body.get("function"),
+                function_parameters=body.get("functionParameters"),
+                description=body.get("description", ""),
+            )
+            return 200, {"metadata": meta}
+
+        add("POST", r"/function/python", function_create)
+        add("PATCH", r"/function/python/" + NAME, function_update)
+        add(
+            "GET", r"/function/python/" + NAME,
+            lambda m, b, q: (
+                200,
+                self.dataset.read_page(m.group("name"), **self._page_args(q)),
+            ),
+        )
+        add(
+            "DELETE", r"/function/python/" + NAME,
+            lambda m, b, q: (
+                self.function.delete(m.group("name")),
+                (200, {"result": "deleted"}),
+            )[1],
+        )
+
+        # ---- Observe (the reference's separate-repo watch service) ----
+        def observe_wait(m, body, query):
+            name = m.group("name")
+            try:
+                timeout = float(query.get("timeout", 30))
+            except (TypeError, ValueError):
+                raise BadRequest("timeout must be a number")
+            self.ctx.require_existing(name)
+            import time as _time
+
+            deadline = _time.time() + min(timeout, 300)
+            while _time.time() < deadline:
+                meta = self.ctx.artifacts.metadata.read(name)
+                if meta.get("finished") or meta.get("jobState") == "failed":
+                    return 200, {"metadata": meta}
+                _time.sleep(0.1)
+            return 200, {"metadata": self.ctx.artifacts.metadata.read(name)}
+
+        add("GET", r"/observe/" + NAME, observe_wait)
+
+        # ---- Introspection ----
+        add(
+            "GET", r"/registry",
+            lambda m, b, q: (200, registry.list_registered()),
+        )
+        add(
+            "GET", r"/artifacts",
+            lambda m, b, q: (
+                200, self.dataset.list_metadata(q.get("type", ""))
+            ),
+        )
+        add("GET", r"/health", lambda m, b, q: (200, {"status": "ok"}))
+
+    # -- HTTP plumbing --------------------------------------------------------
+
+    def handle(self, verb: str, path: str, body: dict, query: dict):
+        try:
+            return self.router.dispatch(verb, path, body, query)
+        except (DuplicateArtifact, ConflictError) as exc:
+            return 409, {"error": str(exc)}
+        except NotFoundError as exc:
+            return 404, {"error": str(exc)}
+        except (ValidationError, RegistryError) as exc:
+            return 406, {"error": str(exc)}
+        except (json.JSONDecodeError, BadRequest) as exc:
+            return 400, {"error": f"bad JSON: {exc}"
+                         if isinstance(exc, json.JSONDecodeError)
+                         else str(exc)}
+        except Exception as exc:  # pragma: no cover - defensive
+            traceback.print_exc()
+            return 500, {"error": repr(exc)}
+
+    def serve_forever(self, host: str | None = None, port: int | None = None):
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _run(self, verb: str):
+                parsed = urlparse(self.path)
+                query = {
+                    k: v[0] for k, v in parse_qs(parsed.query).items()
+                }
+                body = {}
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    raw = self.rfile.read(length)
+                    try:
+                        body = json.loads(raw) if raw.strip() else {}
+                    except json.JSONDecodeError:
+                        self._send(400, {"error": "request body is not JSON"})
+                        return
+                status, payload = api.handle(verb, parsed.path, body, query)
+                self._send(status, payload)
+
+            def _send(self, status: int, payload):
+                if (
+                    isinstance(payload, tuple)
+                    and len(payload) == 2
+                    and isinstance(payload[1], (bytes, bytearray))
+                ):
+                    ctype, data = payload
+                else:
+                    ctype = "application/json"
+                    data = json.dumps(payload, default=str).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._run("GET")
+
+            def do_POST(self):
+                self._run("POST")
+
+            def do_PATCH(self):
+                self._run("PATCH")
+
+            def do_DELETE(self):
+                self._run("DELETE")
+
+        host = host or self.config.api.host
+        port = self.config.api.port if port is None else port
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.serve_forever()
+
+    def start_background(self, host: str = "127.0.0.1",
+                         port: int | None = None) -> int:
+        """Start on a daemon thread; returns the bound port (None/0 picks
+        an ephemeral one)."""
+        if port in (None, 0):
+            import socket
+
+            sock = socket.socket()
+            sock.bind((host, 0))
+            port = sock.getsockname()[1]
+            sock.close()
+        self._port = port
+        threading.Thread(
+            target=lambda: self.serve_forever(host=host, port=port),
+            daemon=True,
+        ).start()
+        # Wait until the socket accepts.
+        import socket as _socket
+        import time as _time
+
+        deadline = _time.time() + 10
+        while _time.time() < deadline:
+            try:
+                with _socket.create_connection((host, port), timeout=0.2):
+                    break
+            except OSError:
+                _time.sleep(0.02)
+        return port
+
+    def shutdown(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+        self.ctx.close()
+
+
+def serve(config: Config | None = None) -> None:
+    APIServer(config).serve_forever()
+
+
+if __name__ == "__main__":
+    serve()
+
+
+def _int_param(query: dict, key: str, default: int) -> int:
+    try:
+        return int(query.get(key, default))
+    except (TypeError, ValueError):
+        raise BadRequest(f"{key} must be an integer")

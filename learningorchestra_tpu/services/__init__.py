@@ -1,0 +1,29 @@
+"""Service layer: the business logic behind every REST route group.
+
+The reference runs nine Flask microservices with near-identical internal
+shape (SURVEY §1 L2).  Here each service is a plain class over a shared
+:class:`ServiceContext`; the API layer maps the reference's route table
+onto them.  The microservice-per-container split was a deployment choice,
+not a capability — one process serves all route groups, and the job engine
+provides the same async semantics the per-service thread pools did.
+"""
+
+from learningorchestra_tpu.services.context import ServiceContext
+from learningorchestra_tpu.services.dataset import DatasetService
+from learningorchestra_tpu.services.transform import TransformService
+from learningorchestra_tpu.services.explore import ExploreService
+from learningorchestra_tpu.services.model import ModelService
+from learningorchestra_tpu.services.executor import ExecutorService
+from learningorchestra_tpu.services.function import FunctionService
+from learningorchestra_tpu.services.builder import BuilderService
+
+__all__ = [
+    "ServiceContext",
+    "DatasetService",
+    "TransformService",
+    "ExploreService",
+    "ModelService",
+    "ExecutorService",
+    "FunctionService",
+    "BuilderService",
+]

@@ -1,0 +1,194 @@
+"""Builder service: whole-pipeline execution.
+
+Reference parity (microservices/builder_image/builder.py): one POST runs
+modeling code to produce train/test feature frames, then fits up to five
+classifiers **concurrently**, evaluates each (F1, accuracy, fitTime), and
+stores per-row predictions — one artifact per classifier, named
+``{test_dataset}{classifier}`` (builder_image/utils.py:41-44).
+
+Differences by design: the classifiers are the JAX-native estimators (no
+Spark cluster), and the "modeling code" contract accepts either the
+reference's exec-style code string (sets ``features_training`` /
+``features_testing`` / optional ``features_evaluation`` globals) or a
+declarative field split.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from learningorchestra_tpu.services.context import (
+    ServiceContext,
+    ValidationError,
+)
+from learningorchestra_tpu.toolkit import registry
+
+BUILDER_TYPE = "builder/sparkml"
+
+# Classifier whitelist (reference: builder_image/utils.py:119-123) —
+# MLlib-era names alias to the JAX estimators.
+CLASSIFIERS = {
+    "LogisticRegression": ("sklearn.linear_model", "LogisticRegression"),
+    "DecisionTree": ("sklearn.tree", "DecisionTreeClassifier"),
+    "RandomForest": ("sklearn.ensemble", "RandomForestClassifier"),
+    "GradientBoosting": (
+        "sklearn.ensemble", "GradientBoostingClassifier",
+    ),
+    "NaiveBayes": ("sklearn.naive_bayes", "GaussianNB"),
+}
+
+
+def _f1_macro(y_true: np.ndarray, y_pred: np.ndarray) -> float:
+    """Macro-averaged F1 (the reference records MLlib's F1,
+    builder.py:117-142)."""
+    classes = np.unique(np.concatenate([y_true, y_pred]))
+    f1s = []
+    for c in classes:
+        tp = float(((y_pred == c) & (y_true == c)).sum())
+        fp = float(((y_pred == c) & (y_true != c)).sum())
+        fn = float(((y_pred != c) & (y_true == c)).sum())
+        prec = tp / (tp + fp) if tp + fp else 0.0
+        rec = tp / (tp + fn) if tp + fn else 0.0
+        f1s.append(2 * prec * rec / (prec + rec) if prec + rec else 0.0)
+    return float(np.mean(f1s))
+
+
+class BuilderService:
+    def __init__(self, ctx: ServiceContext):
+        self.ctx = ctx
+
+    def create(
+        self,
+        *,
+        training_dataset: str,
+        test_dataset: str,
+        classifiers: list[str],
+        label_field: str = "label",
+        feature_fields: list[str] | None = None,
+        modeling_code: str | None = None,
+        classifier_parameters: dict | None = None,
+        description: str = "",
+    ) -> list[dict]:
+        self.ctx.require_finished_parent(training_dataset)
+        self.ctx.require_finished_parent(test_dataset)
+        unknown = [c for c in classifiers if c not in CLASSIFIERS]
+        if unknown:
+            raise ValidationError(
+                f"unknown classifiers: {unknown}; "
+                f"allowed: {sorted(CLASSIFIERS)}"
+            )
+        metas = []
+        for clf in classifiers:
+            # Result name = test dataset + classifier (utils.py:41-44);
+            # the reference pre-deletes a stale result, so re-POST works.
+            result_name = f"{test_dataset}{clf}"
+            if self.ctx.artifacts.metadata.exists(result_name):
+                self.ctx.artifacts.delete(result_name)
+                self.ctx.volumes.delete_everywhere(result_name)
+            metas.append(
+                self.ctx.artifacts.metadata.create(
+                    result_name, BUILDER_TYPE,
+                    parent_name=test_dataset,
+                    extra={"classifier": clf},
+                )
+            )
+
+        def run_all():
+            train_df = self.ctx.loader.load_dataframe(training_dataset)
+            test_df = self.ctx.loader.load_dataframe(test_dataset)
+            if modeling_code:
+                globs: dict = {
+                    "training_df": train_df,
+                    "testing_df": test_df,
+                    "np": np,
+                }
+                exec(modeling_code, globs)  # noqa: S102 — builder parity
+                feats_train = np.asarray(globs["features_training"])
+                feats_test = np.asarray(globs["features_testing"])
+                y_train = np.asarray(globs["labels_training"]).reshape(-1)
+                y_test = np.asarray(globs["labels_testing"]).reshape(-1)
+            else:
+                cols = feature_fields or [
+                    c for c in train_df.columns if c != label_field
+                ]
+                feats_train = train_df[cols].to_numpy(dtype=np.float32)
+                y_train = train_df[label_field].to_numpy()
+                feats_test = test_df[cols].to_numpy(dtype=np.float32)
+                y_test = test_df[label_field].to_numpy()
+
+            def run_one(clf: str):
+                result_name = f"{test_dataset}{clf}"
+                try:
+                    self.ctx.artifacts.metadata.mark_running(result_name)
+                    mod, cls = CLASSIFIERS[clf]
+                    kwargs = (classifier_parameters or {}).get(clf, {})
+                    model = registry.resolve(mod, cls)(**kwargs)
+                    t0 = time.perf_counter()
+                    model.fit(feats_train, y_train)
+                    fit_time = time.perf_counter() - t0
+                    preds = np.asarray(model.predict(feats_test)).reshape(-1)
+                    acc = float((preds == y_test).mean())
+                    f1 = _f1_macro(y_test, preds)
+                    self.ctx.documents.insert_many(
+                        result_name,
+                        (
+                            {"prediction": p, "label": t}
+                            for p, t in zip(
+                                _tolist(preds), _tolist(y_test)
+                            )
+                        ),
+                    )
+                    self.ctx.volumes.save_object(
+                        BUILDER_TYPE, result_name, model
+                    )
+                    self.ctx.artifacts.metadata.mark_finished(
+                        result_name,
+                        {
+                            "fitTime": fit_time,
+                            "accuracy": acc,
+                            "F1": f1,
+                        },
+                    )
+                    self.ctx.artifacts.ledger.record(
+                        result_name,
+                        description=description,
+                        state="finished",
+                        metrics={
+                            "fitTime": fit_time, "accuracy": acc, "F1": f1,
+                        },
+                    )
+                except BaseException as exc:
+                    self.ctx.artifacts.metadata.mark_failed(
+                        result_name, repr(exc)
+                    )
+                    self.ctx.artifacts.ledger.record(
+                        result_name, state="failed", exception=repr(exc)
+                    )
+
+            # Concurrent classifier training (reference trains its five
+            # MLlib classifiers in threads, builder.py:62-78).
+            with ThreadPoolExecutor(max_workers=len(classifiers)) as pool:
+                list(pool.map(run_one, classifiers))
+
+        # One coordinating job; per-classifier status lives in each
+        # result artifact's own metadata.
+        coordinator = f"{test_dataset}__builder_run"
+        if self.ctx.artifacts.metadata.exists(coordinator):
+            self.ctx.artifacts.delete(coordinator)
+        self.ctx.artifacts.metadata.create(
+            coordinator, BUILDER_TYPE,
+            extra={"classifiers": classifiers, "hidden": True},
+        )
+        self.ctx.engine.submit(
+            coordinator, run_all, description=description or "builder run"
+        )
+        return metas
+
+
+def _tolist(arr: np.ndarray) -> list:
+    return [
+        v.item() if isinstance(v, np.generic) else v for v in arr.tolist()
+    ] if hasattr(arr, "tolist") else list(arr)

@@ -1,0 +1,131 @@
+"""Shared service context: store + volumes + job engine + artifact loader.
+
+Also defines the request-validation exceptions the API layer maps onto the
+reference's status codes (409 duplicate, 404 missing, 406 semantic errors —
+reference: microservices/binary_executor_image/server.py:332-398).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import pandas as pd
+
+from learningorchestra_tpu.config import Config, get_config
+from learningorchestra_tpu.jobs import JobEngine
+from learningorchestra_tpu.store import (
+    ArtifactStore,
+    DocumentStore,
+    VolumeStorage,
+)
+
+
+class ValidationError(Exception):
+    """Semantic request error → HTTP 406 (reference's NOT_ACCEPTABLE)."""
+
+
+class NotFoundError(Exception):
+    """Missing artifact → HTTP 404."""
+
+
+class ConflictError(Exception):
+    """Duplicate artifact name → HTTP 409."""
+
+
+class ServiceContext:
+    def __init__(self, config: Config | None = None):
+        self.config = config or get_config()
+        self.documents = DocumentStore(
+            self.config.store.store_path(),
+            durable_writes=self.config.store.durable_writes,
+        )
+        self.artifacts = ArtifactStore(self.documents)
+        self.volumes = VolumeStorage(self.config.store.volume_path())
+        self.engine = JobEngine(
+            self.artifacts, max_workers=self.config.jobs.max_workers
+        )
+        self.loader = StoreLoader(self)
+        self._init_backend()
+
+    @staticmethod
+    def _init_backend() -> None:
+        """Eagerly initialize the JAX backend on the main thread.
+
+        Two job threads racing first-time backend init deadlock inside
+        xla_bridge (observed with concurrent fits on worker threads);
+        paying init once at service startup removes the race and also
+        front-loads the TPU client handshake out of the first job's
+        latency."""
+        import jax
+
+        jax.devices()
+
+    def close(self) -> None:
+        self.engine.shutdown(wait=False)
+        self.documents.close()
+
+    # -- validation helpers shared by services --------------------------------
+
+    def require_new_name(self, name: str) -> None:
+        if not name or not isinstance(name, str):
+            raise ValidationError("missing or invalid 'name'")
+        if self.artifacts.metadata.exists(name):
+            raise ConflictError(f"duplicate artifact name: {name!r}")
+
+    def require_existing(self, name: str) -> dict:
+        meta = self.artifacts.metadata.read(name)
+        if meta is None:
+            raise NotFoundError(f"no such artifact: {name!r}")
+        return meta
+
+    def delete_artifact(self, name: str) -> dict:
+        """Shared delete: collection + volume binary (dataset/model/
+        executor/function services all expose the same DELETE)."""
+        meta = self.require_existing(name)
+        self.artifacts.delete(name)
+        self.volumes.delete(meta.get("type", ""), name)
+        return meta
+
+    def require_finished_parent(self, name: str) -> dict:
+        """Downstream steps refuse unfinished parents (reference:
+        projection_image/utils.py:88-95)."""
+        meta = self.require_existing(name)
+        if not meta.get("finished"):
+            raise ValidationError(
+                f"parent artifact {name!r} is not finished "
+                f"(jobState={meta.get('jobState')})"
+            )
+        return meta
+
+
+class StoreLoader:
+    """The DSL's ``$name`` resolution over store + volumes.
+
+    Mirrors the reference's load rules (binary_executor_image/
+    utils.py:322-336): dataset collections load as DataFrames; everything
+    else loads its volume binary (checkpointed estimator / pytree / raw
+    object)."""
+
+    def __init__(self, ctx: ServiceContext):
+        self.ctx = ctx
+
+    def load(self, name: str) -> Any:
+        meta = self.ctx.artifacts.metadata.read(name)
+        if meta is None:
+            raise KeyError(name)
+        kind = str(meta.get("type", ""))
+        if kind.startswith("dataset/csv") or not self.ctx.volumes.exists(
+            kind, name
+        ):
+            return self.load_dataframe(name)
+        return self.ctx.volumes.read_object(kind, name)
+
+    def load_dataframe(self, name: str) -> pd.DataFrame:
+        docs = self.ctx.documents.find(
+            name,
+            query={"_id": {"$gte": 1}, "docType": {"$ne": "execution"}},
+        )
+        if not docs:
+            raise KeyError(f"artifact {name!r} has no rows")
+        df = pd.DataFrame(docs)
+        return df.drop(columns=["_id"])

@@ -1,0 +1,180 @@
+"""Dataset service: CSV and generic binary ingest + the universal GET path.
+
+Reference behavior (microservices/database_api_image/): ``POST
+/dataset/csv`` downloads a CSV from a URL and stores it row-per-document
+with a 3-thread download→treat→save queue pipeline and **per-row
+insert_one** — its known ingest bottleneck (database.py:86-151).  Here
+ingest is a streamed reader with **batched** inserts; headers are cleaned
+the same way (non-alphanumeric → underscore) and values optionally
+type-inferred (the reference stores everything as strings and makes users
+cast via the dataType service — that path still exists for parity, but
+inference is the sane default).
+
+``POST /dataset/generic`` streams arbitrary bytes onto the datasets
+volume in chunks (database.py:61-83).
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import re
+from typing import Iterable
+
+from learningorchestra_tpu.services.context import ServiceContext
+
+_HEADER_CLEAN_RE = re.compile(r"[^0-9a-zA-Z_]+")
+
+CSV_TYPE = "dataset/csv"
+GENERIC_TYPE = "dataset/generic"
+
+
+def _clean_header(header: list[str]) -> list[str]:
+    out = []
+    for i, h in enumerate(header):
+        h = _HEADER_CLEAN_RE.sub("_", h.strip()).strip("_")
+        out.append(h or f"col{i}")
+    return out
+
+
+def _infer(value: str):
+    if value == "":
+        return None
+    try:
+        return int(value)
+    except ValueError:
+        pass
+    try:
+        return float(value)
+    except ValueError:
+        return value
+
+
+def _open_url(url: str) -> io.TextIOBase:
+    """Stream a CSV source: http(s) URL, file:// URL, or local path."""
+    if url.startswith(("http://", "https://")):
+        import requests
+
+        resp = requests.get(url, stream=True, timeout=60)
+        resp.raise_for_status()
+        resp.raw.decode_content = True
+        return io.TextIOWrapper(resp.raw, encoding="utf-8", errors="replace")
+    path = url[len("file://"):] if url.startswith("file://") else url
+    return open(path, "r", encoding="utf-8", errors="replace")
+
+
+class DatasetService:
+    BATCH = 2000  # rows per insert_many
+
+    def __init__(self, ctx: ServiceContext):
+        self.ctx = ctx
+
+    # -- CSV ------------------------------------------------------------------
+
+    def create_csv(
+        self, name: str, url: str, *, infer_types: bool = True
+    ) -> dict:
+        """Async ingest: metadata appears immediately (finished=False),
+        rows stream in on a job thread — the reference's ASYNC BOUNDARY
+        (database.py:99-105)."""
+        self.ctx.require_new_name(name)
+        meta = self.ctx.artifacts.metadata.create(
+            name, CSV_TYPE, extra={"url": url}
+        )
+
+        def ingest():
+            n_rows = 0
+            fields: list[str] = []
+            with _open_url(url) as fh:
+                reader = csv.reader(fh)
+                batch: list[dict] = []
+                for row in reader:
+                    if not fields:
+                        fields = _clean_header(row)
+                        continue
+                    doc = {
+                        fields[i]: (_infer(v) if infer_types else v)
+                        for i, v in enumerate(row[: len(fields)])
+                    }
+                    batch.append(doc)
+                    if len(batch) >= self.BATCH:
+                        self.ctx.documents.insert_many(name, batch)
+                        n_rows += len(batch)
+                        batch = []
+                if batch:
+                    self.ctx.documents.insert_many(name, batch)
+                    n_rows += len(batch)
+            return {"fields": fields, "rows": n_rows}
+
+        self.ctx.engine.submit(
+            name,
+            ingest,
+            description=f"csv ingest from {url}",
+            on_success=lambda r: r,
+        )
+        return meta
+
+    # -- generic binary -------------------------------------------------------
+
+    def create_generic(self, name: str, url: str) -> dict:
+        self.ctx.require_new_name(name)
+        meta = self.ctx.artifacts.metadata.create(
+            name, GENERIC_TYPE, extra={"url": url}
+        )
+
+        def ingest():
+            if url.startswith(("http://", "https://")):
+                import requests
+
+                resp = requests.get(url, stream=True, timeout=60)
+                resp.raise_for_status()
+                path = self.ctx.volumes.save_stream(
+                    GENERIC_TYPE, name, resp.raw
+                )
+            else:
+                src = url[len("file://"):] if url.startswith("file://") \
+                    else url
+                with open(src, "rb") as fh:
+                    path = self.ctx.volumes.save_stream(GENERIC_TYPE, name, fh)
+            return {"sizeBytes": path.stat().st_size}
+
+        self.ctx.engine.submit(
+            name,
+            ingest,
+            description=f"generic ingest from {url}",
+            on_success=lambda r: r,
+        )
+        return meta
+
+    # -- ingest from rows (in-process path for clients/tests/benches) ---------
+
+    def create_from_rows(
+        self, name: str, rows: Iterable[dict], fields: list[str] | None = None
+    ) -> dict:
+        self.ctx.require_new_name(name)
+        self.ctx.artifacts.metadata.create(name, CSV_TYPE)
+        n = self.ctx.documents.insert_many(name, rows)
+        first = self.ctx.documents.find_one(name, 1) or {}
+        fields = fields or [k for k in first if k != "_id"]
+        self.ctx.artifacts.metadata.mark_finished(
+            name, {"fields": fields, "rows": n}
+        )
+        return self.ctx.artifacts.metadata.read(name)
+
+    # -- read / list / delete -------------------------------------------------
+
+    def read_page(
+        self, name: str, query: dict | None = None, skip: int = 0,
+        limit: int = 20,
+    ) -> list[dict]:
+        self.ctx.require_existing(name)
+        cap = self.ctx.config.api.page_limit_max
+        return self.ctx.artifacts.read_page(
+            name, query=query, skip=skip, limit=min(limit, cap)
+        )
+
+    def list_metadata(self, type_prefix: str = "") -> list[dict]:
+        return self.ctx.artifacts.list_by_type(type_prefix)
+
+    def delete(self, name: str) -> None:
+        self.ctx.delete_artifact(name)

@@ -1,0 +1,277 @@
+"""Executor service: tune / train / evaluate / predict.
+
+The reference's binaryExecutor (microservices/binary_executor_image/): load
+the parent binary, ``getattr(instance, method)(**treated_params)``, persist
+— train-family methods return the mutated instance itself
+(binary_execution.py:188-200); other methods' results are stored as result
+rows + binary.  The lineage walk finds the original model spec behind any
+chain of steps (utils.py:261-280).
+
+Tune adds what the reference leaves to the user: a managed grid-search
+(``param_grid``) that fits one candidate per combination and records each
+candidate's score as a result row, selecting the best instance.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Any
+
+import numpy as np
+
+from learningorchestra_tpu import dsl
+from learningorchestra_tpu.services.context import (
+    ServiceContext,
+    ValidationError,
+)
+from learningorchestra_tpu.toolkit import registry
+
+TRAIN_KINDS = ("train", "tune")
+
+
+class ExecutorService:
+    def __init__(self, ctx: ServiceContext):
+        self.ctx = ctx
+
+    # -- shared validation (reference: server.py:332-398) ---------------------
+
+    def _validate_request(self, name, parent_name, method, method_parameters):
+        self.ctx.require_new_name(name)
+        parent_meta = self.ctx.require_finished_parent(parent_name)
+        model_meta = self.ctx.artifacts.metadata.find_model_ancestor(
+            parent_name
+        )
+        factory = registry.resolve(
+            model_meta.get("modulePath"), model_meta.get("class")
+        )
+        if not registry.validate_method(factory, method):
+            raise ValidationError(f"no such method: {method!r}")
+        bad = registry.validate_method_params(
+            factory, method, method_parameters or {}
+        )
+        if bad:
+            raise ValidationError(f"invalid methodParameters: {bad}")
+        return parent_meta, model_meta
+
+    # -- create ---------------------------------------------------------------
+
+    def create(
+        self,
+        name: str,
+        *,
+        parent_name: str,
+        method: str,
+        method_parameters: dict | None = None,
+        artifact_type: str = "train/tensorflow",
+        description: str = "",
+    ) -> dict:
+        parent_meta, model_meta = self._validate_request(
+            name, parent_name, method, method_parameters
+        )
+        meta = self.ctx.artifacts.metadata.create(
+            name,
+            artifact_type,
+            parent_name=parent_name,
+            module_path=model_meta.get("modulePath"),
+            class_name=model_meta.get("class"),
+            method=method,
+        )
+        self._submit(
+            name, parent_meta, method, method_parameters, artifact_type,
+            description,
+        )
+        return meta
+
+    def update(
+        self,
+        name: str,
+        *,
+        method_parameters: dict | None = None,
+        description: str = "",
+    ) -> dict:
+        """PATCH re-run with new parameters (reference:
+        server.py:110-156)."""
+        meta = self.ctx.require_existing(name)
+        parent_meta = self.ctx.require_finished_parent(meta["parentName"])
+        self.ctx.artifacts.metadata.restart(name)
+        self._submit(
+            name, parent_meta, meta.get("method"), method_parameters,
+            meta.get("type"), description,
+        )
+        return self.ctx.artifacts.metadata.read(name)
+
+    def _submit(self, name, parent_meta, method, method_parameters,
+                artifact_type, description):
+        parent_name = parent_meta["name"]
+        parent_type = parent_meta.get("type", "")
+        kind = artifact_type.split("/", 1)[0]
+
+        def run():
+            instance = self.ctx.volumes.read_object(parent_type, parent_name)
+            params = dsl.resolve_params(method_parameters, self.ctx.loader)
+            t0 = time.perf_counter()
+            result = getattr(instance, method)(**params)
+            fit_time = time.perf_counter() - t0
+            if kind in TRAIN_KINDS or result is instance:
+                # Train semantics: persist the mutated instance
+                # (binary_execution.py:195-200).
+                self.ctx.volumes.save_object(artifact_type, name, instance)
+                extra = {"fitTime": fit_time}
+                hist = getattr(instance, "history", None)
+                if hist:
+                    for row_i in range(
+                        len(next(iter(hist.values()), []))
+                    ):
+                        self.ctx.documents.insert_one(
+                            name,
+                            {
+                                "epoch": row_i,
+                                **{k: v[row_i] for k, v in hist.items()},
+                            },
+                        )
+                return extra
+            # Evaluate/predict semantics: persist result rows + binary.
+            self.ctx.volumes.save_object(artifact_type, name, result)
+            self._store_result_rows(name, result)
+            return {"fitTime": fit_time}
+
+        self.ctx.engine.submit(
+            name,
+            run,
+            description=description or f"{method} on {parent_name}",
+            method=method,
+            parameters=_json_safe(method_parameters),
+            on_success=lambda extra: extra,
+        )
+
+    def _store_result_rows(self, name: str, result: Any) -> None:
+        """Make method results pollable as rows (the reference stores
+        results in the collection for GET; utils.py:116-139)."""
+        if isinstance(result, dict):
+            self.ctx.documents.insert_one(name, _json_safe(result))
+            return
+        arr = np.asarray(result)
+        if arr.ndim == 0:
+            self.ctx.documents.insert_one(name, {"result": arr.item()})
+        elif arr.ndim == 1:
+            self.ctx.documents.insert_many(
+                name, ({"result": _json_safe(v)} for v in arr.tolist())
+            )
+        else:
+            self.ctx.documents.insert_many(
+                name, ({"result": row} for row in arr.tolist())
+            )
+
+    # -- tune: managed grid search -------------------------------------------
+
+    def create_tune(
+        self,
+        name: str,
+        *,
+        parent_name: str,
+        method: str = "fit",
+        param_grid: dict | None = None,
+        method_parameters: dict | None = None,
+        scoring_parameters: dict | None = None,
+        artifact_type: str = "tune/tensorflow",
+        description: str = "",
+    ) -> dict:
+        """Grid-search over ``param_grid`` (dict of lists).  Each candidate
+        re-instantiates the model ancestor's class with those kwargs, fits
+        with ``method_parameters``, scores with ``score``/``evaluate`` on
+        ``scoring_parameters`` (defaults to the fit data), and the best
+        candidate instance is persisted as this artifact's binary."""
+        if not param_grid:
+            raise ValidationError("param_grid is required for tune")
+        for key, values in param_grid.items():
+            if not isinstance(values, (list, tuple)) or not values:
+                raise ValidationError(
+                    f"param_grid[{key!r}] must be a non-empty list"
+                )
+        self.ctx.require_new_name(name)
+        self.ctx.require_finished_parent(parent_name)
+        model_meta = self.ctx.artifacts.metadata.find_model_ancestor(
+            parent_name
+        )
+        factory = registry.resolve(
+            model_meta.get("modulePath"), model_meta.get("class")
+        )
+        bad = registry.validate_init_params(
+            model_meta.get("modulePath"), model_meta.get("class"),
+            {k: None for k in param_grid},
+        )
+        if bad:
+            raise ValidationError(f"param_grid keys not in __init__: {bad}")
+        meta = self.ctx.artifacts.metadata.create(
+            name,
+            artifact_type,
+            parent_name=parent_name,
+            module_path=model_meta.get("modulePath"),
+            class_name=model_meta.get("class"),
+            method=method,
+        )
+
+        def run():
+            fit_params = dsl.resolve_params(
+                method_parameters, self.ctx.loader
+            )
+            score_params = dsl.resolve_params(
+                scoring_parameters, self.ctx.loader
+            ) if scoring_parameters else {
+                k: v for k, v in fit_params.items() if k in ("x", "y")
+            }
+            keys = sorted(param_grid)
+            best_score, best_instance, best_combo = -np.inf, None, None
+            for combo in itertools.product(
+                *(param_grid[k] for k in keys)
+            ):
+                kwargs = dict(zip(keys, combo))
+                candidate = factory(**kwargs)
+                t0 = time.perf_counter()
+                getattr(candidate, method)(**fit_params)
+                fit_time = time.perf_counter() - t0
+                score = float(candidate.score(**score_params))
+                self.ctx.documents.insert_one(
+                    name,
+                    {
+                        "params": _json_safe(kwargs),
+                        "score": score,
+                        "fitTime": fit_time,
+                    },
+                )
+                if score > best_score:
+                    best_score, best_instance, best_combo = (
+                        score, candidate, kwargs,
+                    )
+            self.ctx.volumes.save_object(artifact_type, name, best_instance)
+            return {
+                "bestScore": best_score,
+                "bestParams": _json_safe(best_combo),
+            }
+
+        self.ctx.engine.submit(
+            name, run, description=description or f"grid search {parent_name}",
+            method=method, parameters=_json_safe(param_grid),
+            on_success=lambda extra: extra,
+        )
+        return meta
+
+    def delete(self, name: str) -> None:
+        self.ctx.delete_artifact(name)
+
+
+def _json_safe(obj):
+    if isinstance(obj, dict):
+        return {k: _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    return repr(obj)

@@ -1,0 +1,143 @@
+"""Explore service: histograms and plot-producing executions.
+
+Reference parity:
+- **histogram** — per-field value counts via Mongo ``$group``/``$sum``
+  into a new collection, one document per field
+  (microservices/histogram_image/histogram.py:13-44);
+- **generic explore** — run a registry class/method (e.g. PCA, TSNE) and
+  render a scatterplot PNG served back via GET
+  (database_executor_image/utils.py:295-320, server.py:151-166) — here
+  rendered with matplotlib (no seaborn dependency on the hot path).
+"""
+
+from __future__ import annotations
+
+from learningorchestra_tpu import dsl
+from learningorchestra_tpu.services.context import (
+    ServiceContext,
+    ValidationError,
+)
+from learningorchestra_tpu.toolkit import registry
+
+HISTOGRAM_TYPE = "explore/histogram"
+
+
+class ExploreService:
+    def __init__(self, ctx: ServiceContext):
+        self.ctx = ctx
+
+    # -- histogram ------------------------------------------------------------
+
+    def create_histogram(
+        self, name: str, parent_name: str, fields: list[str]
+    ) -> dict:
+        parent = self.ctx.require_finished_parent(parent_name)
+        self.ctx.require_new_name(name)
+        known = parent.get("fields") or []
+        missing = [f for f in fields if known and f not in known]
+        if missing:
+            raise ValidationError(f"fields not in parent: {missing}")
+        meta = self.ctx.artifacts.metadata.create(
+            name, HISTOGRAM_TYPE, parent_name=parent_name,
+            extra={"fields": fields},
+        )
+
+        def run():
+            for field in fields:
+                counts = self.ctx.documents.aggregate_counts(
+                    parent_name, field
+                )
+                self.ctx.documents.insert_one(
+                    name,
+                    {
+                        "field": field,
+                        "counts": {str(k): v for k, v in counts.items()},
+                    },
+                )
+            return {"fields": fields}
+
+        self.ctx.engine.submit(
+            name, run, description=f"histogram of {parent_name}.{fields}",
+            on_success=lambda r: r,
+        )
+        return meta
+
+    # -- plot-producing execution --------------------------------------------
+
+    def create_plot(
+        self,
+        name: str,
+        *,
+        module_path: str,
+        class_name: str,
+        class_parameters: dict | None = None,
+        method: str = "fit_transform",
+        method_parameters: dict | None = None,
+        artifact_type: str = "explore/tensorflow",
+        color_by: str | None = None,
+        description: str = "",
+    ) -> dict:
+        """Run e.g. TSNE/PCA on a dataset and persist a scatter PNG."""
+        self.ctx.require_new_name(name)
+        factory = registry.resolve(module_path, class_name)
+        if not registry.validate_method(factory, method):
+            raise ValidationError(f"no such method: {method!r}")
+        meta = self.ctx.artifacts.metadata.create(
+            name,
+            artifact_type,
+            module_path=module_path,
+            class_name=class_name,
+            method=method,
+        )
+
+        def run():
+            import numpy as np
+
+            cls_params = dsl.resolve_params(class_parameters, self.ctx.loader)
+            m_params = dsl.resolve_params(method_parameters, self.ctx.loader)
+            instance = factory(**cls_params)
+            result = np.asarray(getattr(instance, method)(**m_params))
+            colors = None
+            if color_by is not None:
+                colors = np.asarray(
+                    dsl.resolve_value(color_by, self.ctx.loader)
+                ).reshape(-1)
+            png_path = self._render_scatter(name, artifact_type, result,
+                                            colors)
+            return {"image": str(png_path)}
+
+        self.ctx.engine.submit(
+            name, run, description=description or f"{class_name} plot",
+            on_success=lambda r: r,
+        )
+        return meta
+
+    def _render_scatter(self, name, artifact_type, points, colors=None):
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots(figsize=(8, 6), dpi=120)
+        if points.ndim != 2 or points.shape[1] < 2:
+            raise ValidationError(
+                "plot execution must produce (n, >=2) points"
+            )
+        sc = ax.scatter(
+            points[:, 0], points[:, 1], c=colors, s=8, cmap="viridis",
+            alpha=0.8,
+        )
+        if colors is not None:
+            fig.colorbar(sc, ax=ax)
+        ax.set_title(name)
+        path = self.ctx.volumes.path_for(artifact_type, name)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        fig.savefig(path, format="png", bbox_inches="tight")
+        plt.close(fig)
+        return path
+
+    def read_image(self, name: str) -> bytes:
+        """GET the rendered PNG (reference streams it with send_file,
+        database_executor_image/server.py:151-166)."""
+        meta = self.ctx.require_existing(name)
+        return self.ctx.volumes.read_bytes(meta.get("type", ""), name)

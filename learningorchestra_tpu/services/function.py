@@ -1,0 +1,113 @@
+"""Function service — the arbitrary-code escape hatch.
+
+Reference parity (microservices/code_executor_image/): POST a Python
+function body (inline string or fetched from a URL) plus DSL-treated
+parameters; the code runs with the parameters as globals and must set a
+``response`` variable; stdout is captured into the execution document
+(code_execution.py:149-196, utils.py:113-138).
+
+This is the ONE place arbitrary code remains by design (SURVEY §7 "hard
+parts": the exec boundary).  Everything else in the framework is
+declarative registry specs; ``function/python`` keeps the reference's
+full power for host-side glue code.  The code runs in the service
+process — the trust model is the reference's (the API is the audience's
+own cluster, not a public service).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+
+from learningorchestra_tpu import dsl
+from learningorchestra_tpu.services.context import (
+    ServiceContext,
+    ValidationError,
+)
+
+FUNCTION_TYPE = "function/python"
+
+
+def _fetch_code(function: str) -> str:
+    """Inline code or, if it looks like a URL, fetch it (reference:
+    code_execution.py:11-21)."""
+    if function.startswith(("http://", "https://")):
+        import requests
+
+        resp = requests.get(function, timeout=60)
+        resp.raise_for_status()
+        return resp.text
+    return function
+
+
+class FunctionService:
+    def __init__(self, ctx: ServiceContext):
+        self.ctx = ctx
+
+    def create(
+        self,
+        name: str,
+        *,
+        function: str,
+        function_parameters: dict | None = None,
+        description: str = "",
+    ) -> dict:
+        self.ctx.require_new_name(name)
+        if not function or not isinstance(function, str):
+            raise ValidationError("missing 'function' code")
+        meta = self.ctx.artifacts.metadata.create(
+            name, FUNCTION_TYPE, extra={"description": description}
+        )
+        self._submit(name, function, function_parameters, description)
+        return meta
+
+    def update(
+        self,
+        name: str,
+        *,
+        function: str,
+        function_parameters: dict | None = None,
+        description: str = "",
+    ) -> dict:
+        self.ctx.require_existing(name)
+        if not function or not isinstance(function, str):
+            raise ValidationError("missing 'function' code")
+        self.ctx.artifacts.metadata.restart(name)
+        self._submit(name, function, function_parameters, description)
+        return self.ctx.artifacts.metadata.read(name)
+
+    def _submit(self, name, function, function_parameters, description):
+        def run():
+            code = _fetch_code(function)
+            params = dsl.resolve_params(
+                function_parameters, self.ctx.loader
+            )
+            globs: dict = {"__name__": f"function_{name}"}
+            globs.update(params)
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                exec(code, globs)  # noqa: S102 — the documented escape hatch
+            if "response" not in globs:
+                raise ValidationError(
+                    "function code must set a 'response' variable"
+                )
+            response = globs["response"]
+            self.ctx.volumes.save_object(FUNCTION_TYPE, name, response)
+            from learningorchestra_tpu.services.executor import _json_safe
+
+            self.ctx.documents.insert_one(
+                name,
+                {
+                    "result": _json_safe(response),
+                    "functionMessage": buf.getvalue(),
+                },
+            )
+            return response
+
+        self.ctx.engine.submit(
+            name, run, description=description or "python function",
+            capture_stdout=False,
+        )
+
+    def delete(self, name: str) -> None:
+        self.ctx.delete_artifact(name)

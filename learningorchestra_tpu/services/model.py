@@ -1,0 +1,88 @@
+"""Model service: instantiate a registry class and persist the instance.
+
+Reference parity (microservices/model_image/model.py:92-162): POST gives
+``{name, modulePath, class, classParameters}``; the service validates the
+module/class/params, instantiates **inside the async job** (pre-trained
+nets may download weights there), and persists the instance.  PATCH
+re-instantiates with new params; DELETE removes collection + binary.
+"""
+
+from __future__ import annotations
+
+from learningorchestra_tpu import dsl
+from learningorchestra_tpu.services.context import (
+    ServiceContext,
+    ValidationError,
+)
+from learningorchestra_tpu.toolkit import registry
+
+
+class ModelService:
+    def __init__(self, ctx: ServiceContext):
+        self.ctx = ctx
+
+    def _validate(self, module_path, class_name, class_parameters):
+        factory = registry.resolve(module_path, class_name)  # RegistryError
+        bad = registry.validate_init_params(
+            module_path, class_name, class_parameters or {}
+        )
+        if bad:
+            raise ValidationError(f"invalid classParameters: {bad}")
+        return factory
+
+    def create(
+        self,
+        name: str,
+        *,
+        module_path: str,
+        class_name: str,
+        class_parameters: dict | None = None,
+        artifact_type: str = "model/tensorflow",
+        description: str = "",
+    ) -> dict:
+        self.ctx.require_new_name(name)
+        factory = self._validate(module_path, class_name, class_parameters)
+        meta = self.ctx.artifacts.metadata.create(
+            name,
+            artifact_type,
+            module_path=module_path,
+            class_name=class_name,
+        )
+        self._submit(name, factory, class_parameters, artifact_type,
+                     description)
+        return meta
+
+    def update(
+        self,
+        name: str,
+        *,
+        class_parameters: dict | None = None,
+        description: str = "",
+    ) -> dict:
+        """PATCH: re-instantiate with new parameters (reference:
+        model_image/model.py:117-136)."""
+        meta = self.ctx.require_existing(name)
+        factory = self._validate(
+            meta.get("modulePath"), meta.get("class"), class_parameters
+        )
+        self.ctx.artifacts.metadata.restart(name)
+        self._submit(
+            name, factory, class_parameters, meta.get("type"), description
+        )
+        return self.ctx.artifacts.metadata.read(name)
+
+    def _submit(self, name, factory, class_parameters, artifact_type,
+                description):
+        def run():
+            params = dsl.resolve_params(class_parameters, self.ctx.loader)
+            instance = factory(**params)
+            self.ctx.volumes.save_object(artifact_type, name, instance)
+            return instance
+
+        self.ctx.engine.submit(
+            name, run, description=description or f"instantiate {name}",
+            parameters=class_parameters,
+        )
+
+    def delete(self, name: str) -> None:
+        self.ctx.delete_artifact(name)

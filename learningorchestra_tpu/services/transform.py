@@ -1,0 +1,173 @@
+"""Transform service: projection, dtype casting, generic transform executor.
+
+Reference parity:
+- **projection** — column-select a dataset into a new collection; the
+  reference runs this as a Spark job through the mongo-spark connector
+  (microservices/projection_image/projection.py:20-48).  A column
+  projection over a document store needs no cluster: here it is a
+  batched host-side copy (and numeric transforms go through the JAX
+  estimators instead).
+- **dataType** — cast dataset fields string↔number in place, re-flagging
+  the artifact unfinished while the cast runs
+  (data_type_handler_image/data_type_update.py:15-59).
+- **generic transform** — instantiate a registry class, call a method with
+  DSL-treated params, persist the result binary
+  (database_executor_image/database_execution.py:92-188).
+"""
+
+from __future__ import annotations
+
+from learningorchestra_tpu import dsl
+from learningorchestra_tpu.services.context import (
+    ServiceContext,
+    ValidationError,
+)
+from learningorchestra_tpu.toolkit import registry
+
+PROJECTION_TYPE = "transform/projection"
+
+
+class TransformService:
+    def __init__(self, ctx: ServiceContext):
+        self.ctx = ctx
+
+    # -- projection -----------------------------------------------------------
+
+    def create_projection(
+        self, name: str, parent_name: str, fields: list[str]
+    ) -> dict:
+        parent = self.ctx.require_finished_parent(parent_name)
+        self.ctx.require_new_name(name)
+        parent_fields = parent.get("fields") or []
+        if parent_fields:
+            missing = [f for f in fields if f not in parent_fields]
+            if missing:
+                raise ValidationError(
+                    f"fields not in parent dataset: {missing}"
+                )
+        meta = self.ctx.artifacts.metadata.create(
+            name, PROJECTION_TYPE, parent_name=parent_name,
+            extra={"fields": fields},
+        )
+
+        def project():
+            docs = self.ctx.documents.find(
+                parent_name,
+                query={"_id": {"$gte": 1}, "docType": {"$ne": "execution"}},
+            )
+            out = (
+                {f: d.get(f) for f in fields} for d in docs
+            )
+            n = self.ctx.documents.insert_many(name, out)
+            return {"rows": n}
+
+        self.ctx.engine.submit(
+            name, project, description=f"projection of {parent_name}",
+            on_success=lambda r: r,
+        )
+        return meta
+
+    # -- dtype casting --------------------------------------------------------
+
+    def update_field_types(self, parent_name: str, fields: dict) -> dict:
+        """Cast fields in place; value ∈ {"number", "string"} per field
+        (reference: data_type_handler_image/utils.py:87-102)."""
+        meta = self.ctx.require_existing(parent_name)
+        known = meta.get("fields") or []
+        for field, kind in fields.items():
+            if kind not in ("number", "string"):
+                raise ValidationError(
+                    f"field {field!r}: type must be 'number' or 'string'"
+                )
+            if known and field not in known:
+                raise ValidationError(f"no such field: {field!r}")
+        # Re-flag unfinished while the cast runs (reference:
+        # data_type_update.py:47-59), then restore.
+        self.ctx.artifacts.metadata.restart(parent_name)
+
+        def cast():
+            docs = self.ctx.documents.find(
+                parent_name,
+                query={"_id": {"$gte": 1}, "docType": {"$ne": "execution"}},
+            )
+            for doc in docs:
+                updates = {}
+                for field, kind in fields.items():
+                    val = doc.get(field)
+                    if val is None:
+                        continue
+                    if kind == "number":
+                        try:
+                            updates[field] = float(val)
+                        except (TypeError, ValueError):
+                            updates[field] = None
+                    else:
+                        updates[field] = str(val)
+                if updates:
+                    self.ctx.documents.update_one(
+                        parent_name, doc["_id"], updates
+                    )
+            return {"cast": list(fields)}
+
+        self.ctx.engine.submit(
+            parent_name, cast, description=f"dtype cast {fields}",
+            on_success=lambda r: r,
+        )
+        return self.ctx.artifacts.metadata.read(parent_name)
+
+    # -- generic transform (registry class + method) --------------------------
+
+    def create_generic(
+        self,
+        name: str,
+        *,
+        module_path: str,
+        class_name: str,
+        class_parameters: dict | None = None,
+        method: str | None = None,
+        method_parameters: dict | None = None,
+        artifact_type: str = "transform/tensorflow",
+        description: str = "",
+    ) -> dict:
+        self.ctx.require_new_name(name)
+        factory = registry.resolve(module_path, class_name)  # 406 if unknown
+        bad = registry.validate_init_params(
+            module_path, class_name, class_parameters or {}
+        )
+        if bad:
+            raise ValidationError(f"invalid classParameters: {bad}")
+        if method is not None:
+            if not registry.validate_method(factory, method):
+                raise ValidationError(f"no such method: {method!r}")
+            bad = registry.validate_method_params(
+                factory, method, method_parameters or {}
+            )
+            if bad:
+                raise ValidationError(f"invalid methodParameters: {bad}")
+        meta = self.ctx.artifacts.metadata.create(
+            name,
+            artifact_type,
+            module_path=module_path,
+            class_name=class_name,
+            method=method,
+        )
+
+        def run():
+            cls_params = dsl.resolve_params(
+                class_parameters, self.ctx.loader
+            )
+            instance = factory(**cls_params)
+            result = instance
+            if method is not None:
+                m_params = dsl.resolve_params(
+                    method_parameters, self.ctx.loader
+                )
+                result = getattr(instance, method)(**m_params)
+            self.ctx.volumes.save_object(artifact_type, name, result)
+            return result
+
+        self.ctx.engine.submit(
+            name, run, description=description or f"{class_name}.{method}",
+            method=method, parameters=method_parameters,
+        )
+        return meta

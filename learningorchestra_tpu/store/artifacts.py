@@ -62,8 +62,6 @@ class Metadata:
         extra: dict | None = None,
         overwrite: bool = False,
     ) -> dict:
-        if not overwrite and self.exists(name):
-            raise DuplicateArtifact(name)
         doc = {
             "name": name,
             "type": artifact_type,
@@ -81,7 +79,19 @@ class Metadata:
             doc["method"] = method
         if extra:
             doc.update(extra)
-        self.store.insert_one(name, doc, _id=METADATA_ID)
+        if overwrite:
+            self.store.insert_one(name, doc, _id=METADATA_ID)
+        else:
+            # Atomic check-and-insert: concurrent creates with the same
+            # name race to one winner, the loser gets DuplicateArtifact.
+            from learningorchestra_tpu.store.document_store import (
+                DuplicateKey,
+            )
+
+            try:
+                self.store.insert_unique(name, doc, _id=METADATA_ID)
+            except DuplicateKey as exc:
+                raise DuplicateArtifact(name) from exc
         return doc
 
     def read(self, name: str) -> dict | None:
@@ -180,6 +190,11 @@ class ExecutionLedger:
         doc: dict = {
             "executionTime": _now(),
             "state": state,
+            # Execution records share the artifact's collection (the
+            # reference's contract — clients see them in GET results), but
+            # are tagged so data reads (DataFrames, histograms, projections)
+            # can exclude them.
+            "docType": "execution",
         }
         if description is not None:
             doc["description"] = description
@@ -196,7 +211,7 @@ class ExecutionLedger:
         return self.store.insert_one(name, doc)
 
     def history(self, name: str) -> list[dict]:
-        return self.store.find(name, query={"_id": {"$gte": 1}})
+        return self.store.find(name, query={"docType": "execution"})
 
 
 class ArtifactStore:

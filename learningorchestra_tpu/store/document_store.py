@@ -38,6 +38,10 @@ class CollectionExists(Exception):
     pass
 
 
+class DuplicateKey(Exception):
+    """insert_unique target _id already present."""
+
+
 class NoSuchCollection(Exception):
     pass
 
@@ -195,6 +199,22 @@ class DocumentStore:
             coll._append({"op": "i", "d": doc})
             return _id
 
+    def insert_unique(self, name: str, doc: dict, _id: int) -> int:
+        """Insert at an explicit ``_id``, failing atomically if present —
+        the duplicate-name gate must be check-and-insert under one lock,
+        not check-then-insert (two concurrent POSTs with the same name
+        must not both succeed)."""
+        coll = self._get(name, create=True)
+        with coll.lock:
+            if _id in coll.docs:
+                raise DuplicateKey(f"{name}[{_id}]")
+            doc = dict(doc)
+            doc["_id"] = _id
+            coll.next_id = max(coll.next_id, _id + 1)
+            coll.docs[_id] = doc
+            coll._append({"op": "i", "d": doc})
+            return _id
+
     def insert_many(self, name: str, docs: Iterable[dict]) -> int:
         """Batched insert (the reference ingests CSV with per-row
         ``insert_one`` — its known bottleneck, database_api_image/
@@ -285,7 +305,7 @@ class DocumentStore:
         counts: dict[Any, int] = {}
         with coll.lock:
             for _id, doc in coll.docs.items():
-                if _id in exclude_ids:
+                if _id in exclude_ids or doc.get("docType") == "execution":
                     continue
                 val = doc.get(field)
                 if isinstance(val, (list, dict)):

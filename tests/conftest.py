@@ -8,12 +8,33 @@ Must run before the first `import jax` anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even if the environment pins JAX_PLATFORMS to a hardware
+# backend: tests must be hermetic and multi-device (8 virtual CPUs).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# Some environments site-register extra PJRT plugins (e.g. a tunneled TPU
+# backend) at interpreter boot; jax's backends() initializes every
+# registered plugin regardless of JAX_PLATFORMS, which would make tests
+# depend on (and possibly hang on) remote hardware.  Drop any non-CPU
+# factory before the first backend init.
+try:
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    # Only the site-registered remote plugin is removed: stripping the
+    # stock "tpu" factory breaks MLIR rule registration for platform
+    # "tpu" (flax/chex register tpu lowerings at import).
+    _xb._backend_factories.pop("axon", None)
+    # jax.config snapshots JAX_PLATFORMS at first import, which may have
+    # happened at interpreter boot (sitecustomize) with a hardware value.
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
 
 import pytest  # noqa: E402
 

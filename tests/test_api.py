@@ -1,0 +1,394 @@
+"""REST contract tests over the real HTTP server (SURVEY §2.2 route table,
+§3 call stacks).  Drives the same flow the reference's Python client does:
+POST → 201 + URI → poll GET until finished → downstream steps."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+import requests
+
+from learningorchestra_tpu.api import APIServer
+from learningorchestra_tpu.config import Config
+
+PREFIX = "/api/learningOrchestra/v1"
+
+
+@pytest.fixture(scope="module")
+def api(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("api")
+    cfg = Config()
+    cfg.store.root = str(tmp / "store")
+    cfg.store.volume_root = str(tmp / "volumes")
+    server = APIServer(cfg)
+    port = server.start_background()
+    base = f"http://127.0.0.1:{port}{PREFIX}"
+    yield base, tmp
+    server.shutdown()
+
+
+def poll(base, path, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        docs = requests.get(f"{base}{path}", timeout=10).json()
+        meta = docs[0] if isinstance(docs, list) and docs else {}
+        if meta.get("finished"):
+            return meta
+        if meta.get("jobState") == "failed":
+            raise AssertionError(f"job failed: {meta.get('exception')}")
+        time.sleep(0.05)
+    raise AssertionError(f"timeout polling {path}")
+
+
+@pytest.fixture(scope="module")
+def csv_file(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("data")
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(240, 3))
+    y = (x @ [1.0, -1.0, 0.5] > 0).astype(int)
+    path = tmp / "mini.csv"
+    with open(path, "w") as fh:
+        fh.write("f one,f-two,f.three,label\n")  # dirty headers
+        for row, label in zip(x, y):
+            fh.write(",".join(f"{v:.5f}" for v in row) + f",{label}\n")
+    return str(path)
+
+
+def test_health_and_registry(api):
+    base, _ = api
+    assert requests.get(f"{base}/health").json() == {"status": "ok"}
+    reg = requests.get(f"{base}/registry").json()
+    assert {"modulePath": "learningorchestra_tpu.toolkit.estimators.linear",
+            "class": "LogisticRegression"} in reg
+
+
+def test_csv_ingest_and_poll(api, csv_file):
+    base, _ = api
+    resp = requests.post(
+        f"{base}/dataset/csv",
+        json={"datasetName": "mini", "url": csv_file},
+    )
+    assert resp.status_code == 201, resp.text
+    assert resp.json()["result"] == f"{PREFIX}/dataset/csv/mini"
+    meta = poll(base, "/dataset/csv/mini")
+    assert meta["rows"] == 240
+    # Dirty headers cleaned like the reference's regex pass.
+    assert meta["fields"] == ["f_one", "f_two", "f_three", "label"]
+    page = requests.get(
+        f"{base}/dataset/csv/mini", params={"limit": 5, "skip": 1}
+    ).json()
+    assert len(page) == 5
+    assert all("f_one" in d for d in page)
+
+
+def test_duplicate_dataset_409(api, csv_file):
+    base, _ = api
+    resp = requests.post(
+        f"{base}/dataset/csv", json={"datasetName": "mini", "url": csv_file}
+    )
+    assert resp.status_code == 409
+
+
+def test_missing_artifact_404_and_bad_route(api):
+    base, _ = api
+    assert requests.get(f"{base}/dataset/csv/ghost").status_code == 404
+    assert requests.get(f"{base}/nope/nope").status_code == 404
+    # wrong verb on a known path → 405
+    assert requests.delete(f"{base}/transform/dataType").status_code == 405
+
+
+def test_projection_and_histogram(api, csv_file):
+    base, _ = api
+    resp = requests.post(
+        f"{base}/transform/projection",
+        json={
+            "projectionName": "mini_proj",
+            "datasetName": "mini",
+            "fields": ["f_one", "label"],
+        },
+    )
+    assert resp.status_code == 201
+    poll(base, "/transform/projection/mini_proj")
+    page = requests.get(
+        f"{base}/transform/projection/mini_proj", params={"limit": 3}
+    ).json()
+    row_keys = set(page[1].keys())
+    assert row_keys == {"_id", "f_one", "label"}
+
+    # unknown field → 406
+    resp = requests.post(
+        f"{base}/transform/projection",
+        json={
+            "projectionName": "bad_proj",
+            "datasetName": "mini",
+            "fields": ["nope"],
+        },
+    )
+    assert resp.status_code == 406
+
+    resp = requests.post(
+        f"{base}/explore/histogram",
+        json={
+            "histogramName": "mini_hist",
+            "datasetName": "mini",
+            "fields": ["label"],
+        },
+    )
+    assert resp.status_code == 201
+    poll(base, "/explore/histogram/mini_hist")
+    docs = requests.get(f"{base}/explore/histogram/mini_hist").json()
+    hist = [d for d in docs if d.get("field") == "label"][0]
+    assert sum(hist["counts"].values()) == 240
+
+
+def test_model_train_predict_evaluate_flow(api, csv_file):
+    base, _ = api
+    # model
+    resp = requests.post(
+        f"{base}/model/scikitlearn",
+        json={
+            "modelName": "mini_lr",
+            "modulePath": "sklearn.linear_model",
+            "class": "LogisticRegression",
+            "classParameters": {"max_iter": 120},
+        },
+    )
+    assert resp.status_code == 201, resp.text
+    poll(base, "/model/scikitlearn/mini_lr")
+
+    # train with DSL $refs
+    resp = requests.post(
+        f"{base}/train/scikitlearn",
+        json={
+            "name": "mini_train",
+            "parentName": "mini_lr",
+            "method": "fit",
+            "methodParameters": {
+                "x": "$mini_proj.f_one",
+                "y": "$mini.label",
+            },
+        },
+    )
+    # x needs 2D; use full dataset columns via function-style params instead
+    assert resp.status_code == 201
+    # This train will fail (1-D x) — that's fine, it exercises the failure
+    # ledger; verify and then re-run properly via PATCH.
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        meta = requests.get(f"{base}/train/scikitlearn/mini_train").json()[0]
+        if meta.get("finished") or meta.get("jobState") == "failed":
+            break
+        time.sleep(0.05)
+
+    # proper train on a fresh artifact
+    resp = requests.post(
+        f"{base}/train/scikitlearn",
+        json={
+            "name": "mini_train2",
+            "parentName": "mini_lr",
+            "method": "fit",
+            "methodParameters": {"x": "$mini_X", "y": "$mini.label"},
+        },
+    )
+    # mini_X doesn't exist yet → job would fail; create it first via
+    # function service (arbitrary host code building a feature matrix).
+    resp_fn = requests.post(
+        f"{base}/function/python",
+        json={
+            "name": "mini_X",
+            "function": (
+                "import numpy as np\n"
+                "response = df[['f_one', 'f_two', 'f_three']]"
+                ".to_numpy(dtype='float32')\n"
+            ),
+            "functionParameters": {"df": "$mini"},
+        },
+    )
+    assert resp_fn.status_code == 201, resp_fn.text
+    poll(base, "/function/python/mini_X")
+
+    resp = requests.post(
+        f"{base}/train/scikitlearn",
+        json={
+            "name": "mini_train3",
+            "parentName": "mini_lr",
+            "method": "fit",
+            "methodParameters": {"x": "$mini_X", "y": "$mini.label"},
+        },
+    )
+    assert resp.status_code == 201, resp.text
+    meta = poll(base, "/train/scikitlearn/mini_train3")
+    assert meta["fitTime"] > 0
+
+    # predict from the trained artifact (lineage walk to the model)
+    resp = requests.post(
+        f"{base}/predict/scikitlearn",
+        json={
+            "name": "mini_preds",
+            "parentName": "mini_train3",
+            "method": "predict",
+            "methodParameters": {"x": "$mini_X"},
+        },
+    )
+    assert resp.status_code == 201, resp.text
+    poll(base, "/predict/scikitlearn/mini_preds")
+    preds = requests.get(
+        f"{base}/predict/scikitlearn/mini_preds", params={"limit": 100}
+    ).json()
+    assert len(preds) == 100  # page cap: metadata doc + 99 rows
+    assert all("result" in d for d in preds[1:])
+
+    # evaluate: score method
+    resp = requests.post(
+        f"{base}/evaluate/scikitlearn",
+        json={
+            "name": "mini_eval",
+            "parentName": "mini_train3",
+            "method": "score",
+            "methodParameters": {"x": "$mini_X", "y": "$mini.label"},
+        },
+    )
+    assert resp.status_code == 201
+    poll(base, "/evaluate/scikitlearn/mini_eval")
+    docs = requests.get(f"{base}/evaluate/scikitlearn/mini_eval").json()
+    score = [d for d in docs if "result" in d][0]["result"]
+    assert score > 0.9
+
+    # bad method → 406
+    resp = requests.post(
+        f"{base}/train/scikitlearn",
+        json={
+            "name": "x1", "parentName": "mini_lr", "method": "levitate",
+        },
+    )
+    assert resp.status_code == 406
+    # bad kwargs → 406
+    resp = requests.post(
+        f"{base}/train/scikitlearn",
+        json={
+            "name": "x2", "parentName": "mini_lr", "method": "fit",
+            "methodParameters": {"bogus": 1},
+        },
+    )
+    assert resp.status_code == 406
+
+
+def test_tune_grid_search(api):
+    base, _ = api
+    resp = requests.post(
+        f"{base}/tune/scikitlearn",
+        json={
+            "name": "mini_tune",
+            "parentName": "mini_lr",
+            "paramGrid": {"max_iter": [20, 60], "learning_rate": [0.1, 0.3]},
+            "methodParameters": {"x": "$mini_X", "y": "$mini.label"},
+        },
+    )
+    assert resp.status_code == 201, resp.text
+    meta = poll(base, "/tune/scikitlearn/mini_tune", timeout=120)
+    assert meta["bestScore"] > 0.8
+    docs = requests.get(
+        f"{base}/tune/scikitlearn/mini_tune", params={"limit": 100}
+    ).json()
+    trials = [d for d in docs if "score" in d and d["_id"] >= 1]
+    assert len(trials) == 4
+
+
+def test_builder(api):
+    base, _ = api
+    resp = requests.post(
+        f"{base}/builder/sparkml",
+        json={
+            "trainDatasetName": "mini",
+            "testDatasetName": "mini",
+            "classifiersList": ["LogisticRegression", "NaiveBayes"],
+            "labelField": "label",
+            "featureFields": ["f_one", "f_two", "f_three"],
+        },
+    )
+    assert resp.status_code == 201, resp.text
+    meta = poll(base, "/builder/sparkml/miniLogisticRegression", timeout=120)
+    assert meta["accuracy"] > 0.8
+    assert meta["F1"] > 0.8
+    assert meta["fitTime"] > 0
+    poll(base, "/builder/sparkml/miniNaiveBayes", timeout=120)
+    # unknown classifier → 406
+    resp = requests.post(
+        f"{base}/builder/sparkml",
+        json={
+            "trainDatasetName": "mini",
+            "testDatasetName": "mini",
+            "classifiersList": ["QuantumForest"],
+        },
+    )
+    assert resp.status_code == 406
+
+
+def test_explore_plot_png(api):
+    base, _ = api
+    resp = requests.post(
+        f"{base}/explore/scikitlearn",
+        json={
+            "name": "mini_pca_plot",
+            "modulePath": "sklearn.decomposition",
+            "class": "PCA",
+            "classParameters": {"n_components": 2},
+            "method": "fit_transform",
+            "methodParameters": {"x": "$mini_X"},
+            "colorBy": "$mini.label",
+        },
+    )
+    assert resp.status_code == 201, resp.text
+    poll(base, "/explore/scikitlearn/mini_pca_plot/metadata")
+    img = requests.get(f"{base}/explore/scikitlearn/mini_pca_plot")
+    assert img.status_code == 200
+    assert img.headers["Content-Type"] == "image/png"
+    assert img.content[:8] == b"\x89PNG\r\n\x1a\n"
+
+
+def test_observe_blocks_until_finished(api):
+    base, _ = api
+    resp = requests.post(
+        f"{base}/function/python",
+        json={
+            "name": "slowfn",
+            "function": "import time\ntime.sleep(0.5)\nresponse = 7\n",
+        },
+    )
+    assert resp.status_code == 201
+    t0 = time.time()
+    resp = requests.get(f"{base}/observe/slowfn", params={"timeout": 30})
+    meta = resp.json()["metadata"]
+    assert meta["finished"] is True
+    assert time.time() - t0 < 30
+
+
+def test_datatype_cast(api):
+    base, _ = api
+    resp = requests.patch(
+        f"{base}/transform/dataType",
+        json={"datasetName": "mini", "types": {"label": "string"}},
+    )
+    assert resp.status_code == 200
+    poll(base, "/dataset/csv/mini")
+    page = requests.get(
+        f"{base}/dataset/csv/mini", params={"limit": 2, "skip": 1}
+    ).json()
+    assert isinstance(page[0]["label"], str)
+    # cast back to number for any later tests
+    requests.patch(
+        f"{base}/transform/dataType",
+        json={"datasetName": "mini", "types": {"label": "number"}},
+    )
+    poll(base, "/dataset/csv/mini")
+
+
+def test_delete_artifact(api, csv_file):
+    base, _ = api
+    requests.post(
+        f"{base}/dataset/csv", json={"datasetName": "todel", "url": csv_file}
+    )
+    poll(base, "/dataset/csv/todel")
+    assert requests.delete(f"{base}/dataset/csv/todel").status_code == 200
+    assert requests.get(f"{base}/dataset/csv/todel").status_code == 404
