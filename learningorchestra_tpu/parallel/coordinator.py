@@ -1,0 +1,445 @@
+"""Multi-host control plane: coordinator + host agents.
+
+Replaces the reference's Ray stack — Ray client (`ray://`, port 10001),
+GCS (6379), placement groups with a 120 s timeout, and `runtime_env`
+function shipping (reference: microservices/binary_executor_image/
+server.py:13-17, start.sh:7, docker-compose.yml:329-347) — with the
+framework's own minimal control plane:
+
+- **data plane is NOT here.** Gradients/activations move as XLA
+  collectives over ICI/DCN compiled into the jitted step (SURVEY §5.8);
+  the control plane only carries job specs and status JSON.
+- ``init_multihost`` bootstraps JAX's own multi-process runtime
+  (``jax.distributed.initialize``) so every host joins one global device
+  mesh — the TPU-pod analogue of workers joining the Gloo ring.
+- ``Coordinator`` (HTTP, stdlib-only) tracks registered ``HostAgent``s,
+  leases work, and records heartbeats; agents poll for jobs, run a
+  registered callable, and report results.  Functions are *named registry
+  entries*, never pickled code over the wire (the reference ships raw
+  source and ``exec``s it — binary_execution.py:328-348).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+
+DEFAULT_PLACEMENT_TIMEOUT_S = 120.0  # reference parity: server.py:16
+HEARTBEAT_INTERVAL_S = 5.0
+AGENT_DEAD_AFTER_S = 30.0
+
+
+def init_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Join this host to the global JAX runtime (ICI within a slice, DCN
+    across slices).  Arguments default from env so a launcher can export
+    ``LO_COORDINATOR``/``LO_NUM_PROCESSES``/``LO_PROCESS_ID`` and run the
+    same command on every host."""
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "LO_COORDINATOR"
+    )
+    if coordinator_address is None:
+        return  # single-host: nothing to do
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes
+        or int(os.environ["LO_NUM_PROCESSES"]),
+        process_id=process_id
+        if process_id is not None
+        else int(os.environ["LO_PROCESS_ID"]),
+    )
+
+
+# -- function registry (the anti-`exec` boundary) ---------------------------
+
+_functions: dict[str, Callable] = {}
+_functions_lock = threading.Lock()
+
+
+def register_function(name: str, fn: Callable | None = None):
+    """Register a callable agents may run. Usable as a decorator."""
+
+    def deco(f):
+        with _functions_lock:
+            _functions[name] = f
+        return f
+
+    return deco(fn) if fn is not None else deco
+
+
+def get_function(name: str) -> Callable:
+    with _functions_lock:
+        fn = _functions.get(name)
+    if fn is None:
+        raise KeyError(f"no registered distributed function {name!r}")
+    return fn
+
+
+# -- coordinator ------------------------------------------------------------
+
+
+class Coordinator:
+    """Cluster-side registry + job queue, served over HTTP (stdlib only).
+
+    Endpoints (all JSON):
+      POST /agents/register   {agent_id, capacity}    → {ok}
+      POST /agents/heartbeat  {agent_id}              → {ok}
+      GET  /agents                                    → {agents: {...}}
+      POST /jobs              {function, kwargs, n_agents?} → {job_id}
+      GET  /jobs/{id}                                 → job record
+      POST /jobs/{id}/lease   {agent_id}              → {task} | 204
+      POST /jobs/{id}/result  {agent_id, result|error} → {ok}
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._lock = threading.Lock()
+        self._agents: dict[str, dict] = {}
+        self._jobs: dict[str, dict] = {}
+        self._next_job = 0
+        coord = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _json(self, code: int, payload: dict | None):
+                body = json.dumps(payload or {}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def do_POST(self):
+                try:
+                    code, payload = coord._route(
+                        "POST", self.path, self._body()
+                    )
+                except Exception as exc:  # noqa: BLE001
+                    code, payload = 500, {"error": repr(exc)}
+                self._json(code, payload)
+
+            def do_GET(self):
+                try:
+                    code, payload = coord._route("GET", self.path, {})
+                except Exception as exc:  # noqa: BLE001
+                    code, payload = 500, {"error": repr(exc)}
+                self._json(code, payload)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.address = (
+            f"{host}:{self._server.server_address[1]}"
+        )
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+
+    # route dispatch -------------------------------------------------------
+
+    def _route(self, verb: str, path: str, body: dict):
+        parts = [p for p in path.split("/") if p]
+        if verb == "POST" and parts == ["agents", "register"]:
+            return 200, self.register_agent(
+                body["agent_id"], int(body.get("capacity", 1))
+            )
+        if verb == "POST" and parts == ["agents", "heartbeat"]:
+            return 200, self.heartbeat(body["agent_id"])
+        if verb == "GET" and parts == ["agents"]:
+            return 200, {"agents": self.agents()}
+        if verb == "POST" and parts == ["jobs"]:
+            return 201, {
+                "job_id": self.submit(
+                    body["function"],
+                    body.get("kwargs", {}),
+                    int(body.get("n_agents", 1)),
+                )
+            }
+        if verb == "GET" and parts == ["jobs"]:
+            return 200, {"queued": self.open_jobs()}
+        if verb == "GET" and len(parts) == 2 and parts[0] == "jobs":
+            job = self.job(parts[1])
+            return (200, job) if job else (404, {"error": "no such job"})
+        if (
+            verb == "POST"
+            and len(parts) == 3
+            and parts[0] == "jobs"
+            and parts[2] == "lease"
+        ):
+            task = self.lease(parts[1], body["agent_id"])
+            return (200, {"task": task}) if task else (204, {})
+        if (
+            verb == "POST"
+            and len(parts) == 3
+            and parts[0] == "jobs"
+            and parts[2] == "result"
+        ):
+            return 200, self.report(
+                parts[1],
+                body["agent_id"],
+                body.get("result"),
+                body.get("error"),
+            )
+        return 404, {"error": f"no route {verb} {path}"}
+
+    # core ops -------------------------------------------------------------
+
+    def register_agent(self, agent_id: str, capacity: int = 1) -> dict:
+        with self._lock:
+            self._agents[agent_id] = {
+                "capacity": capacity,
+                "last_seen": time.time(),
+            }
+        return {"ok": True}
+
+    def heartbeat(self, agent_id: str) -> dict:
+        with self._lock:
+            if agent_id in self._agents:
+                self._agents[agent_id]["last_seen"] = time.time()
+        return {"ok": True}
+
+    def agents(self) -> dict:
+        now = time.time()
+        with self._lock:
+            return {
+                aid: {**rec, "alive": now - rec["last_seen"]
+                      < AGENT_DEAD_AFTER_S}
+                for aid, rec in self._agents.items()
+            }
+
+    def submit(
+        self, function: str, kwargs: dict, n_agents: int = 1
+    ) -> str:
+        with self._lock:
+            job_id = f"job-{self._next_job}"
+            self._next_job += 1
+            self._jobs[job_id] = {
+                "job_id": job_id,
+                "function": function,
+                "kwargs": kwargs,
+                "n_agents": n_agents,
+                "leased": [],
+                "ranks": {},  # agent_id → rank, stable across reclaims
+                "results": {},
+                "errors": {},
+                "state": "queued",
+                "submitted": time.time(),
+            }
+        return job_id
+
+    def open_jobs(self) -> list[str]:
+        """Jobs still needing agents (queued or under-leased)."""
+        with self._lock:
+            return [
+                jid
+                for jid, job in self._jobs.items()
+                if len(job["leased"]) < job["n_agents"]
+            ]
+
+    def job(self, job_id: str) -> dict | None:
+        with self._lock:
+            job = self._jobs.get(job_id)
+            return dict(job) if job else None
+
+    def lease(self, job_id: str, agent_id: str) -> dict | None:
+        now = time.time()
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is None:
+                return None
+            # Reclaim leases held by agents that stopped heartbeating and
+            # never reported — the preemption-as-first-class-retry path
+            # the reference lacks (SURVEY §5.3: a dead worker's job was
+            # simply lost).
+            for holder in list(job["leased"]):
+                rec = self._agents.get(holder)
+                dead = rec is None or (
+                    now - rec["last_seen"] > AGENT_DEAD_AFTER_S
+                )
+                reported = (
+                    holder in job["results"] or holder in job["errors"]
+                )
+                if dead and not reported:
+                    job["leased"].remove(holder)
+                    job["ranks"].pop(holder, None)
+            if len(job["leased"]) >= job["n_agents"]:
+                return None
+            if agent_id in job["leased"]:
+                return None
+            # Lowest free rank — a reclaimed lease re-issues the dead
+            # agent's rank so the data partition is covered exactly once.
+            taken = set(job["ranks"].values())
+            rank = next(
+                r for r in range(job["n_agents"]) if r not in taken
+            )
+            job["leased"].append(agent_id)
+            job["ranks"][agent_id] = rank
+            job["state"] = "running"
+            return {
+                "function": job["function"],
+                "kwargs": job["kwargs"],
+                "rank": rank,
+                "world_size": job["n_agents"],
+            }
+
+    def report(
+        self, job_id: str, agent_id: str, result, error
+    ) -> dict:
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is None:
+                # Stale report (e.g. coordinator restarted): acknowledge
+                # without retry-able failure, nothing to record it against.
+                return {"ok": False, "error": f"unknown job {job_id}"}
+            if error is not None:
+                job["errors"][agent_id] = error
+            else:
+                job["results"][agent_id] = result
+            done = len(job["results"]) + len(job["errors"])
+            if done >= job["n_agents"]:
+                job["state"] = "failed" if job["errors"] else "finished"
+        return {"ok": True}
+
+    def wait(
+        self, job_id: str, timeout: float = DEFAULT_PLACEMENT_TIMEOUT_S
+    ) -> dict:
+        """Block until the job finishes/fails — reference parity with the
+        120 s Ray placement timeout."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            job = self.job(job_id)
+            if job and job["state"] in ("finished", "failed"):
+                return job
+            time.sleep(0.05)
+        raise TimeoutError(f"job {job_id} timed out after {timeout}s")
+
+    def start(self) -> "Coordinator":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+# -- host agent -------------------------------------------------------------
+
+
+def _http(url: str, payload: dict | None = None) -> tuple[int, dict]:
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        url,
+        data=data,
+        headers={"Content-Type": "application/json"},
+        method="POST" if data is not None else "GET",
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        body = resp.read()
+        return resp.status, json.loads(body) if body else {}
+
+
+class HostAgent:
+    """Per-host worker: registers, heartbeats, leases tasks, runs
+    registry functions, reports results.  The function gets
+    ``rank``/``world_size`` kwargs — the ``hvd.rank()`` analogue
+    (reference: train_function.py:55-61) without a Horovod runtime."""
+
+    def __init__(self, coordinator_address: str, agent_id: str,
+                 capacity: int = 1):
+        self.base = f"http://{coordinator_address}"
+        self.agent_id = agent_id
+        self.capacity = capacity
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def register(self) -> None:
+        _http(
+            f"{self.base}/agents/register",
+            {"agent_id": self.agent_id, "capacity": self.capacity},
+        )
+
+    def run_job(self, job_id: str) -> bool:
+        """Try to lease + run one task of ``job_id``; True if ran."""
+        status, payload = _http(
+            f"{self.base}/jobs/{job_id}/lease", {"agent_id": self.agent_id}
+        )
+        if status != 200 or not payload.get("task"):
+            return False
+        task = payload["task"]
+        try:
+            fn = get_function(task["function"])
+            result = fn(
+                rank=task["rank"],
+                world_size=task["world_size"],
+                **task["kwargs"],
+            )
+            report = {"agent_id": self.agent_id, "result": result}
+        except Exception as exc:  # noqa: BLE001 — ledger contract §5.3
+            report = {"agent_id": self.agent_id, "error": repr(exc)}
+        # Report delivery is retried separately from task execution: a
+        # transient POST failure must not turn a successful run into a
+        # recorded task failure.
+        for attempt in range(3):
+            try:
+                _http(f"{self.base}/jobs/{job_id}/result", report)
+                break
+            except OSError:
+                if attempt == 2:
+                    raise
+                time.sleep(0.2 * (attempt + 1))
+        return True
+
+    def serve(self, poll_interval: float = 0.05) -> None:
+        """Background loop: heartbeat + lease any queued/running job."""
+        self.register()
+
+        def loop():
+            last_beat = 0.0
+            while not self._stop.is_set():
+                now = time.time()
+                if now - last_beat > HEARTBEAT_INTERVAL_S:
+                    try:
+                        _http(
+                            f"{self.base}/agents/heartbeat",
+                            {"agent_id": self.agent_id},
+                        )
+                    except OSError:
+                        pass
+                    last_beat = now
+                # Lease scan by polling: keeps the agent dependency-free
+                # and tolerant of coordinator restarts (push would need a
+                # persistent channel).
+                for job_id in self._visible_jobs():
+                    try:
+                        self.run_job(job_id)
+                    except OSError:
+                        break  # coordinator unreachable; retry next tick
+                self._stop.wait(poll_interval)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def _visible_jobs(self) -> list[str]:
+        try:
+            _, payload = _http(f"{self.base}/jobs")
+        except (OSError, ValueError):
+            return []
+        return payload.get("queued", [])
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)

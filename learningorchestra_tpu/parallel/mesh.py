@@ -1,0 +1,104 @@
+"""Named device meshes.
+
+The reference's unit of scale is a Ray worker process joined to a Gloo
+ring (reference: microservices/binary_executor_image/server.py:16-17 —
+``num_workers=1, cpus_per_worker=2``; docker-compose.yml:329-347 scales
+``ray-worker`` replicas).  The TPU-native unit of scale is a **mesh axis**:
+
+- ``dp``   — data parallelism: batch split, gradients psum'd over ICI;
+- ``fsdp`` — data parallelism with parameters sharded along it (ZeRO-3
+  style), all-gathered per layer by XLA when used;
+- ``tp``   — tensor parallelism: feature-dim matmul sharding;
+- ``sp``   — sequence/context parallelism: ring attention over this axis.
+
+All four axes always exist (size 1 when unused) so any strategy is a
+sharding annotation, never a rewrite — SURVEY §2.4's design requirement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("dp", "fsdp", "tp", "sp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape. Total size must divide the device count."""
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.fsdp * self.tp * self.sp
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {a: getattr(self, a) for a in AXES}
+
+    @staticmethod
+    def from_dict(d: dict) -> "MeshSpec":
+        return MeshSpec(**{a: int(d.get(a, 1)) for a in AXES})
+
+
+def default_spec(n_devices: int | None = None) -> MeshSpec:
+    """Pure data parallelism over every device — the reference's only
+    gradient-parallel strategy (SURVEY §2.4), here the safe default."""
+    n = n_devices if n_devices is not None else jax.device_count()
+    return MeshSpec(dp=n)
+
+
+def build_mesh(
+    spec: MeshSpec | None = None, devices: list | None = None
+) -> Mesh:
+    """Arrange devices into a 4-axis named mesh.
+
+    Axis order is (dp, fsdp, tp, sp) from outermost to innermost:
+    ``jax.devices()`` enumerates devices in ICI-neighbor order, so inner
+    axes (tp/sp — latency-sensitive, per-layer collectives) land on
+    ICI-adjacent chips, while dp (one psum per step, bandwidth-tolerant)
+    spans the outer dimension and, multi-slice, the DCN boundary.
+    """
+    spec = spec or default_spec()
+    validate_spec(spec)
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    if spec.size > devs.size or devs.size % spec.size:
+        raise ValueError(
+            f"mesh spec {spec} (size {spec.size}) does not fit "
+            f"{devs.size} devices"
+        )
+    if spec.size < devs.size:
+        # Fold spare devices into dp — scale-out without re-speccing.
+        spec = dataclasses.replace(spec, dp=spec.dp * (devs.size // spec.size))
+    shape = tuple(getattr(spec, a) for a in AXES)
+    return Mesh(devs[: spec.size].reshape(shape), AXES)
+
+
+def spec_for_devices(n_devices: int, *, model_parallel: int = 1,
+                     sequence_parallel: int = 1) -> MeshSpec:
+    """Split ``n_devices`` into dp × tp × sp with dp taking the rest."""
+    inner = model_parallel * sequence_parallel
+    if n_devices % inner:
+        raise ValueError(
+            f"{n_devices} devices not divisible by tp*sp={inner}"
+        )
+    return MeshSpec(
+        dp=n_devices // inner, tp=model_parallel, sp=sequence_parallel
+    )
+
+
+def validate_spec(spec: MeshSpec) -> None:
+    for axis in AXES:
+        size = getattr(spec, axis)
+        if size < 1 or size != int(size):
+            raise ValueError(f"mesh axis {axis} must be a positive int")
+    # Ring attention rotates sp blocks; power-of-two keeps the ring
+    # permutation balanced on physical ICI tori.
+    if spec.sp > 1 and spec.sp & (spec.sp - 1):
+        raise ValueError("sp axis should be a power of two")

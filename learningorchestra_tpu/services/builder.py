@@ -74,6 +74,11 @@ class BuilderService:
     ) -> list[dict]:
         self.ctx.require_finished_parent(training_dataset)
         self.ctx.require_finished_parent(test_dataset)
+        if not classifiers:
+            raise ValidationError(
+                f"classifiersList must name at least one of "
+                f"{sorted(CLASSIFIERS)}"
+            )
         unknown = [c for c in classifiers if c not in CLASSIFIERS]
         if unknown:
             raise ValidationError(
@@ -96,7 +101,7 @@ class BuilderService:
                 )
             )
 
-        def run_all():
+        def prepare():
             train_df = self.ctx.loader.load_dataframe(training_dataset)
             test_df = self.ctx.loader.load_dataframe(test_dataset)
             if modeling_code:
@@ -108,8 +113,15 @@ class BuilderService:
                 exec(modeling_code, globs)  # noqa: S102 — builder parity
                 feats_train = np.asarray(globs["features_training"])
                 feats_test = np.asarray(globs["features_testing"])
-                y_train = np.asarray(globs["labels_training"]).reshape(-1)
-                y_test = np.asarray(globs["labels_testing"]).reshape(-1)
+                # Labels may come from the modeling code or (the
+                # reference-parity shape, which only sets features_*) from
+                # the datasets' label column.
+                y_train = np.asarray(
+                    globs.get("labels_training", train_df[label_field])
+                ).reshape(-1)
+                y_test = np.asarray(
+                    globs.get("labels_testing", test_df[label_field])
+                ).reshape(-1)
             else:
                 cols = feature_fields or [
                     c for c in train_df.columns if c != label_field
@@ -118,6 +130,24 @@ class BuilderService:
                 y_train = train_df[label_field].to_numpy()
                 feats_test = test_df[cols].to_numpy(dtype=np.float32)
                 y_test = test_df[label_field].to_numpy()
+            return feats_train, y_train, feats_test, y_test
+
+        def run_all():
+            try:
+                feats_train, y_train, feats_test, y_test = prepare()
+            except BaseException as exc:
+                # A pre-loop failure (dataset load, modeling code) must
+                # surface on every visible result artifact — clients poll
+                # those, not the hidden coordinator.
+                for clf in classifiers:
+                    result_name = f"{test_dataset}{clf}"
+                    self.ctx.artifacts.metadata.mark_failed(
+                        result_name, repr(exc)
+                    )
+                    self.ctx.artifacts.ledger.record(
+                        result_name, state="failed", exception=repr(exc)
+                    )
+                raise
 
             def run_one(clf: str):
                 result_name = f"{test_dataset}{clf}"

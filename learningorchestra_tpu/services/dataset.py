@@ -16,8 +16,8 @@ volume in chunks (database.py:61-83).
 
 from __future__ import annotations
 
+import contextlib
 import csv
-import io
 import re
 from typing import Iterable
 
@@ -50,17 +50,36 @@ def _infer(value: str):
         return value
 
 
-def _open_url(url: str) -> io.TextIOBase:
-    """Stream a CSV source: http(s) URL, file:// URL, or local path."""
+@contextlib.contextmanager
+def _open_url(url: str):
+    """Stream a CSV source as an iterable of text lines: http(s) URL,
+    file:// URL, or local path.
+
+    The HTTP path uses ``iter_lines`` rather than wrapping ``resp.raw`` in
+    a TextIOWrapper: urllib3 closes the underlying connection the moment
+    the body hits EOF, after which the io wrapper's own buffering read
+    raises "I/O operation on closed file".  ``csv.reader`` accepts any
+    iterable of strings, so no file object is needed.
+    """
     if url.startswith(("http://", "https://")):
         import requests
 
         resp = requests.get(url, stream=True, timeout=60)
         resp.raise_for_status()
-        resp.raw.decode_content = True
-        return io.TextIOWrapper(resp.raw, encoding="utf-8", errors="replace")
-    path = url[len("file://"):] if url.startswith("file://") else url
-    return open(path, "r", encoding="utf-8", errors="replace")
+        resp.encoding = resp.encoding or "utf-8"
+        try:
+            # Re-append the newline iter_lines strips: csv.reader needs it
+            # to parse quoted fields that span physical lines.
+            yield (
+                line + "\n"
+                for line in resp.iter_lines(decode_unicode=True)
+            )
+        finally:
+            resp.close()
+    else:
+        path = url[len("file://"):] if url.startswith("file://") else url
+        with open(path, "r", encoding="utf-8", errors="replace") as fh:
+            yield fh
 
 
 class DatasetService:

@@ -93,7 +93,12 @@ class ExecutorService:
         """PATCH re-run with new parameters (reference:
         server.py:110-156)."""
         meta = self.ctx.require_existing(name)
-        parent_meta = self.ctx.require_finished_parent(meta["parentName"])
+        parent = meta.get("parentName")
+        if not parent:
+            raise ValidationError(
+                f"artifact {name!r} has no parent — not an executor result"
+            )
+        parent_meta = self.ctx.require_finished_parent(parent)
         self.ctx.artifacts.metadata.restart(name)
         self._submit(
             name, parent_meta, meta.get("method"), method_parameters,
