@@ -1,0 +1,72 @@
+"""Headline benchmark — MNIST-CNN training throughput, samples/sec/chip.
+
+BASELINE.md config 2 (MNIST CNN on a single TPU chip) is the primary
+headline metric recorded by the driver each round.  The reference trains
+the equivalent keras model on CPU workers via Horovod-on-Ray
+(reference: microservices/binary_executor_image/server.py:16-17 —
+``num_workers=1, cpus_per_worker=2``) and publishes no numbers
+(SURVEY §6), so ``vs_baseline`` compares against the best previously
+recorded round (``BENCH_r*.json``) when present, else 1.0.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import time
+
+
+def _prior_best() -> float | None:
+    best = None
+    for path in glob.glob(os.path.join(os.path.dirname(__file__) or ".",
+                                       "BENCH_r*.json")):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            val = float(rec.get("value"))
+        except Exception:
+            continue
+        if val > 0 and (best is None or val > best):
+            best = val
+    return best
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from learningorchestra_tpu.models.vision import MnistCNN
+
+    platform = jax.devices()[0].platform
+    n_samples = 16384 if platform == "tpu" else 4096
+    batch_size = 256
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n_samples, 28, 28, 1), dtype=np.float32)
+    y = rng.integers(0, 10, (n_samples,), dtype=np.int32)
+
+    est = MnistCNN()
+    est._init_params(jnp.asarray(x[:1]))
+    # Epoch 1 pays compile; measure steady-state epochs only.
+    est.fit(x, y, epochs=4, batch_size=batch_size, shuffle=True)
+    epoch_times = est.history["epoch_time"][1:]
+    best_epoch = min(epoch_times)
+    throughput = n_samples / best_epoch
+
+    prior = _prior_best()
+    vs_baseline = throughput / prior if prior else 1.0
+    print(json.dumps({
+        "metric": f"mnist_cnn_train_samples_per_sec_per_chip_{platform}",
+        "value": round(throughput, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(vs_baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

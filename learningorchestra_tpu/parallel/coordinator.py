@@ -269,8 +269,9 @@ class Coordinator:
                 dead = rec is None or (
                     now - rec["last_seen"] > AGENT_DEAD_AFTER_S
                 )
-                reported = (
-                    holder in job["results"] or holder in job["errors"]
+                hrank = job["ranks"].get(holder)
+                reported = hrank is not None and (
+                    hrank in job["results"] or hrank in job["errors"]
                 )
                 if dead and not reported:
                     job["leased"].remove(holder)
@@ -304,12 +305,22 @@ class Coordinator:
                 # Stale report (e.g. coordinator restarted): acknowledge
                 # without retry-able failure, nothing to record it against.
                 return {"ok": False, "error": f"unknown job {job_id}"}
+            # Results are keyed by RANK, not agent: completion means every
+            # data partition 0..n-1 is covered exactly once, even when a
+            # reclaimed lease re-issued a rank to a second agent.
+            rank = job["ranks"].get(agent_id)
+            if rank is None:
+                # Lease was reclaimed (agent went dead, rank re-issued);
+                # its partition is another agent's responsibility now.
+                return {"ok": False, "error": "stale lease"}
             if error is not None:
-                job["errors"][agent_id] = error
+                if rank not in job["results"]:
+                    job["errors"][rank] = error
             else:
-                job["results"][agent_id] = result
-            done = len(job["results"]) + len(job["errors"])
-            if done >= job["n_agents"]:
+                job["results"][rank] = result
+                job["errors"].pop(rank, None)
+            covered = set(job["results"]) | set(job["errors"])
+            if len(covered) >= job["n_agents"]:
                 job["state"] = "failed" if job["errors"] else "finished"
         return {"ok": True}
 

@@ -27,7 +27,6 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from learningorchestra_tpu.parallel.mesh import MeshSpec, build_mesh
@@ -38,6 +37,7 @@ from learningorchestra_tpu.train.neural import (
     TrainHistory,
     _batch_data,
     _NoShuffle,
+    build_epoch_fns,
 )
 
 
@@ -91,65 +91,45 @@ class DistributedTrainer:
         psh = param_shardings(est.params, self.mesh)
         params = jax.device_put(est.params, psh)
         # Optimizer state inherits param shardings through propagation.
-        opt_state = jax.jit(est.optimizer.init)(params)
+        fresh = jax.jit(est.optimizer.init)(params)
+        if est.opt_state is not None and jax.tree_util.tree_structure(
+            est.opt_state
+        ) == jax.tree_util.tree_structure(fresh):
+            # Resume accumulated moments (continuation training / PATCH
+            # re-run) instead of zeroing them — same contract as the
+            # single-device fit (neural.py fit resumes self.opt_state).
+            mesh_devices = set(self.mesh.devices.flat)
+
+            def _sh(leaf):
+                sh = getattr(leaf, "sharding", None)
+                if sh is not None and set(sh.device_set) == mesh_devices:
+                    return sh
+                # Scalar leaves (e.g. adam's step count) come off the init
+                # jit on one device; they must be replicated on the mesh.
+                return NamedSharding(self.mesh, P())
+
+            opt_sh = jax.tree_util.tree_map(_sh, fresh)
+            opt_state = jax.device_put(
+                jax.device_get(est.opt_state), opt_sh
+            )
+        else:
+            opt_state = fresh
         return params, opt_state
 
     # -- step construction --------------------------------------------------
 
     def _build(self, loss_kind: str):
         est = self.estimator
-        module, optimizer = est.module, est.optimizer
-        loss_fn = est._loss_and_metrics(loss_kind)
-        dtype = (
-            jnp.bfloat16 if est.compute_dtype == "bfloat16" else None
-        )
-
-        def step(params, opt_state, xb, yb, mb):
-            def objective(p):
-                xin = (
-                    xb.astype(dtype)
-                    if dtype and jnp.issubdtype(xb.dtype, jnp.floating)
-                    else xb
-                )
-                logits = module.apply(p, xin).astype(jnp.float32)
-                return loss_fn(logits, yb, mb)
-
-            grads, metrics = jax.grad(objective, has_aux=True)(params)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, metrics
-
-        def epoch(params, opt_state, xs, ys, ms):
-            def body(carry, batch):
-                params, opt_state = carry
-                params, opt_state, metrics = step(params, opt_state, *batch)
-                return (params, opt_state), metrics
-
-            (params, opt_state), metrics = jax.lax.scan(
-                body, (params, opt_state), (xs, ys, ms)
-            )
-            return params, opt_state, jax.tree_util.tree_map(
-                jnp.mean, metrics
-            )
-
-        def evaluate(params, xs, ys, ms):
-            def body(_, batch):
-                xb, yb, mb = batch
-                xin = (
-                    xb.astype(dtype)
-                    if dtype and jnp.issubdtype(xb.dtype, jnp.floating)
-                    else xb
-                )
-                logits = module.apply(params, xin).astype(jnp.float32)
-                return None, loss_fn(logits, yb, mb)[1]
-
-            _, metrics = jax.lax.scan(body, None, (xs, ys, ms))
-            return jax.tree_util.tree_map(jnp.mean, metrics)
-
-        # donate carry state: params/opt_state update in place in HBM.
-        return (
-            jax.jit(epoch, donate_argnums=(0, 1)),
-            jax.jit(evaluate),
+        dtype = jnp.bfloat16 if est.compute_dtype == "bfloat16" else None
+        # Same jitted loss/grad/update math as the single-device path
+        # (train/neural.py), with the carry donated so params/opt_state
+        # update in place in HBM.
+        return build_epoch_fns(
+            est.module,
+            est.optimizer,
+            est._loss_and_metrics(loss_kind),
+            dtype,
+            donate=True,
         )
 
     # -- public surface -----------------------------------------------------
@@ -168,7 +148,8 @@ class DistributedTrainer:
         est = self.estimator
         x = np.asarray(as_array(x))
         y_arr = np.asarray(y if not hasattr(y, "to_numpy") else y.to_numpy())
-        y_arr = y_arr.reshape(-1)
+        if y_arr.ndim == 2 and y_arr.shape[1] == 1:
+            y_arr = y_arr.reshape(-1)
         loss_kind = est._resolve_loss(y_arr)
         y_arr = y_arr.astype(
             np.int32 if loss_kind == "softmax_ce" else np.float32
@@ -235,7 +216,8 @@ class DistributedTrainer:
         est = self.estimator
         x = np.asarray(as_array(x))
         y_arr = np.asarray(y if not hasattr(y, "to_numpy") else y.to_numpy())
-        y_arr = y_arr.reshape(-1)
+        if y_arr.ndim == 2 and y_arr.shape[1] == 1:
+            y_arr = y_arr.reshape(-1)
         loss_kind = self._loss_kind or est._resolve_loss(y_arr)
         y_arr = y_arr.astype(
             np.int32 if loss_kind == "softmax_ce" else np.float32

@@ -65,9 +65,9 @@ def build_mesh(
     ICI-adjacent chips, while dp (one psum per step, bandwidth-tolerant)
     spans the outer dimension and, multi-slice, the DCN boundary.
     """
-    spec = spec or default_spec()
-    validate_spec(spec)
     devs = np.asarray(devices if devices is not None else jax.devices())
+    spec = spec or default_spec(devs.size)
+    validate_spec(spec)
     if spec.size > devs.size or devs.size % spec.size:
         raise ValueError(
             f"mesh spec {spec} (size {spec.size}) does not fit "

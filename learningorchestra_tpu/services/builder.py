@@ -117,10 +117,14 @@ class BuilderService:
                 # reference-parity shape, which only sets features_*) from
                 # the datasets' label column.
                 y_train = np.asarray(
-                    globs.get("labels_training", train_df[label_field])
+                    globs["labels_training"]
+                    if "labels_training" in globs
+                    else train_df[label_field]
                 ).reshape(-1)
                 y_test = np.asarray(
-                    globs.get("labels_testing", test_df[label_field])
+                    globs["labels_testing"]
+                    if "labels_testing" in globs
+                    else test_df[label_field]
                 ).reshape(-1)
             else:
                 cols = feature_fields or [

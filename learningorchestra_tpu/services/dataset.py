@@ -50,35 +50,56 @@ def _infer(value: str):
         return value
 
 
+def _decode_lines(byte_chunks):
+    """Incrementally decode byte chunks into lines split ONLY on ``\\n``,
+    terminators preserved.  ``str.splitlines`` semantics (which
+    ``iter_lines(decode_unicode=True)`` uses) would split on \\x85/\\u2028
+    and collapse \\r\\n — corrupting quoted CSV fields that contain them;
+    ``csv.reader`` needs the raw terminators to parse multi-line quoted
+    fields faithfully."""
+    import codecs
+
+    dec = codecs.getincrementaldecoder("utf-8")("replace")
+    buf = ""
+    for chunk in byte_chunks:
+        buf += dec.decode(chunk)
+        if "\n" in buf:
+            parts = buf.split("\n")
+            buf = parts.pop()
+            for part in parts:
+                yield part + "\n"
+    buf += dec.decode(b"", True)
+    if buf:
+        yield buf
+
+
 @contextlib.contextmanager
 def _open_url(url: str):
     """Stream a CSV source as an iterable of text lines: http(s) URL,
     file:// URL, or local path.
 
-    The HTTP path uses ``iter_lines`` rather than wrapping ``resp.raw`` in
-    a TextIOWrapper: urllib3 closes the underlying connection the moment
-    the body hits EOF, after which the io wrapper's own buffering read
-    raises "I/O operation on closed file".  ``csv.reader`` accepts any
-    iterable of strings, so no file object is needed.
+    The HTTP path decodes raw chunks itself rather than wrapping
+    ``resp.raw`` in a TextIOWrapper: urllib3 closes the underlying
+    connection the moment the body hits EOF, after which the io wrapper's
+    own buffering read raises "I/O operation on closed file".
+    ``csv.reader`` accepts any iterable of strings, so no file object is
+    needed.  Local files open with ``newline=""`` (csv-module contract) so
+    \\r\\n inside quoted fields survives.
     """
     if url.startswith(("http://", "https://")):
         import requests
 
         resp = requests.get(url, stream=True, timeout=60)
         resp.raise_for_status()
-        resp.encoding = resp.encoding or "utf-8"
         try:
-            # Re-append the newline iter_lines strips: csv.reader needs it
-            # to parse quoted fields that span physical lines.
-            yield (
-                line + "\n"
-                for line in resp.iter_lines(decode_unicode=True)
-            )
+            yield _decode_lines(resp.iter_content(chunk_size=65536))
         finally:
             resp.close()
     else:
         path = url[len("file://"):] if url.startswith("file://") else url
-        with open(path, "r", encoding="utf-8", errors="replace") as fh:
+        with open(
+            path, "r", encoding="utf-8", errors="replace", newline=""
+        ) as fh:
             yield fh
 
 

@@ -62,6 +62,57 @@ def _batch_data(x: np.ndarray, y: np.ndarray, batch_size: int, rng):
     return xb, yb, mb
 
 
+def build_epoch_fns(module, optimizer, loss_fn, dtype, *, donate=False):
+    """Jitted (epoch, evaluate) pair shared by the single-device and
+    mesh-sharded training paths — the loss/grad/update math exists once.
+
+    ``donate=True`` donates the (params, opt_state) carry so updates
+    happen in place in HBM (the distributed path's steady state).
+    """
+
+    def _cast(xb):
+        return (
+            xb.astype(dtype)
+            if dtype and jnp.issubdtype(xb.dtype, jnp.floating)
+            else xb
+        )
+
+    def step(params, opt_state, xb, yb, mb):
+        def objective(p):
+            logits = module.apply(p, _cast(xb)).astype(jnp.float32)
+            return loss_fn(logits, yb, mb)
+
+        grads, metrics = jax.grad(objective, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, metrics
+
+    def epoch(params, opt_state, xs, ys, ms):
+        def body(carry, batch):
+            params, opt_state = carry
+            params, opt_state, metrics = step(params, opt_state, *batch)
+            return (params, opt_state), metrics
+
+        (params, opt_state), metrics = jax.lax.scan(
+            body, (params, opt_state), (xs, ys, ms)
+        )
+        return params, opt_state, jax.tree_util.tree_map(jnp.mean, metrics)
+
+    def evaluate(params, xs, ys, ms):
+        def body(_, batch):
+            xb, yb, mb = batch
+            logits = module.apply(params, _cast(xb)).astype(jnp.float32)
+            return None, loss_fn(logits, yb, mb)[1]
+
+        _, metrics = jax.lax.scan(body, None, (xs, ys, ms))
+        return jax.tree_util.tree_map(jnp.mean, metrics)
+
+    return (
+        jax.jit(epoch, donate_argnums=(0, 1)) if donate else jax.jit(epoch),
+        jax.jit(evaluate),
+    )
+
+
 class NeuralEstimator(Estimator):
     """Wraps a Flax module with fit/evaluate/predict/save/load."""
 
@@ -152,54 +203,13 @@ class NeuralEstimator(Estimator):
         self.opt_state = self.optimizer.init(self.params)
 
     def _build_step(self, loss_kind: str):
-        module, optimizer = self.module, self.optimizer
-        loss_fn = self._loss_and_metrics(loss_kind)
         dtype = jnp.bfloat16 if self.compute_dtype == "bfloat16" else None
-
-        def step(params, opt_state, xb, yb, mb):
-            def objective(p):
-                xin = xb.astype(dtype) if dtype and jnp.issubdtype(
-                    xb.dtype, jnp.floating
-                ) else xb
-                logits = module.apply(p, xin).astype(jnp.float32)
-                return loss_fn(logits, yb, mb)
-
-            grads, metrics = jax.grad(
-                lambda p: objective(p), has_aux=True
-            )(params)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, metrics
-
-        def epoch(params, opt_state, xs, ys, ms):
-            def body(carry, batch):
-                params, opt_state = carry
-                xb, yb, mb = batch
-                params, opt_state, metrics = step(
-                    params, opt_state, xb, yb, mb
-                )
-                return (params, opt_state), metrics
-
-            (params, opt_state), metrics = jax.lax.scan(
-                body, (params, opt_state), (xs, ys, ms)
-            )
-            mean_metrics = jax.tree_util.tree_map(jnp.mean, metrics)
-            return params, opt_state, mean_metrics
-
-        def evaluate(params, xs, ys, ms):
-            def body(_, batch):
-                xb, yb, mb = batch
-                xin = xb.astype(dtype) if dtype and jnp.issubdtype(
-                    xb.dtype, jnp.floating
-                ) else xb
-                logits = module.apply(params, xin).astype(jnp.float32)
-                _, metrics = loss_fn(logits, yb, mb)
-                return None, metrics
-
-            _, metrics = jax.lax.scan(body, None, (xs, ys, ms))
-            return jax.tree_util.tree_map(jnp.mean, metrics)
-
-        return jax.jit(epoch), jax.jit(evaluate)
+        return build_epoch_fns(
+            self.module,
+            self.optimizer,
+            self._loss_and_metrics(loss_kind),
+            dtype,
+        )
 
     # -- keras-fit surface ----------------------------------------------------
 
@@ -285,7 +295,10 @@ class NeuralEstimator(Estimator):
     def evaluate(self, x, y, batch_size: int = 128, **_) -> dict:
         x = np.asarray(as_array(x))
         y = np.asarray(y if not hasattr(y, "to_numpy") else y.to_numpy())
-        y = y.reshape(-1)
+        # Only flatten a single-column matrix; multi-output regression
+        # targets (n, k>1) must keep their shape.
+        if y.ndim == 2 and y.shape[1] == 1:
+            y = y.reshape(-1)
         loss_kind = self._resolve_loss(y)
         if self._eval_fn is None:
             if self.params is None:

@@ -73,3 +73,159 @@ def test_job_engine_prunes_completed(artifacts):
     with eng._lock:
         assert len(eng._futures) <= 6  # cap + the in-flight slot
     eng.shutdown()
+
+
+# -- round 1, second review pass ---------------------------------------------
+
+
+def test_decode_lines_preserves_crlf_and_unicode_seps():
+    """CRLF and \\x85/\\u2028 inside quoted fields must survive streaming
+    (iter_lines with decode_unicode would mangle both)."""
+    from learningorchestra_tpu.services.dataset import _decode_lines
+
+    raw = 'a,"line1\r\nline2",b\nc,"u\x85v w",d\n'.encode("utf-8")
+    # Feed in awkward chunk sizes to exercise boundary buffering.
+    chunks = [raw[i:i + 7] for i in range(0, len(raw), 7)]
+    lines = list(_decode_lines(chunks))
+    assert "".join(lines) == raw.decode("utf-8")
+    # Only \n splits lines; the quoted CRLF stays inside a line pair.
+    assert lines[0] == 'a,"line1\r\n'
+    import csv
+
+    rows = list(csv.reader(lines))
+    assert rows[0] == ["a", "line1\r\nline2", "b"]
+    assert rows[1] == ["c", "u\x85v w", "d"]
+
+
+def test_multi_output_regression_targets_not_flattened():
+    """(n, k>1) regression targets must keep their shape in fit/evaluate
+    on both the single-device and distributed paths."""
+    from learningorchestra_tpu.models import MLPRegressor
+    from learningorchestra_tpu.parallel.distributed import DistributedTrainer
+    from learningorchestra_tpu.parallel.mesh import MeshSpec
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 4)).astype(np.float32)
+    w = rng.normal(size=(4, 3)).astype(np.float32)
+    y = x @ w  # (32, 3)
+    m = MLPRegressor(hidden_layer_sizes=(8,), out_dim=3)
+    m.fit(x, y, epochs=2, batch_size=8)
+    metrics = m.evaluate(x, y)
+    assert np.isfinite(metrics["loss"])
+    assert m.predict(x).shape == (32, 3)
+
+    m2 = MLPRegressor(hidden_layer_sizes=(8,), out_dim=3)
+    t = DistributedTrainer(m2, spec=MeshSpec(dp=2))
+    t.fit(x, y, epochs=1, batch_size=8)
+    assert np.isfinite(t.history["loss"][-1])
+
+
+def test_distributed_fit_resumes_opt_state():
+    """Second distributed fit() must resume Adam moments, not zero them."""
+    import jax
+    from learningorchestra_tpu.models import MLPClassifier
+    from learningorchestra_tpu.parallel.distributed import DistributedTrainer
+    from learningorchestra_tpu.parallel.mesh import MeshSpec
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 4)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int64)
+    m = MLPClassifier(hidden_layer_sizes=(8,), num_classes=2)
+    t = DistributedTrainer(m, spec=MeshSpec(dp=2))
+    t.fit(x, y, epochs=1, batch_size=8)
+    moments_after_first = jax.tree_util.tree_leaves(m.opt_state)
+    assert any(np.abs(leaf).sum() > 0 for leaf in moments_after_first
+               if hasattr(leaf, "sum"))
+    placed_params, placed_opt = t._place_state()
+    # Resumed opt_state equals the estimator's saved state, not zeros.
+    saved = jax.tree_util.tree_leaves(m.opt_state)
+    placed = jax.tree_util.tree_leaves(jax.device_get(placed_opt))
+    for a, b in zip(saved, placed):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_coordinator_completion_requires_rank_coverage():
+    """A job is finished only when every RANK reported, even after a
+    reclaimed lease re-issues a rank to a second agent."""
+    import learningorchestra_tpu.parallel.coordinator as coord_mod
+    from learningorchestra_tpu.parallel.coordinator import Coordinator
+
+    import time as _time
+
+    c = Coordinator()
+    try:
+        c._agents["A"] = {"last_seen": 0.0, "capacity": 1}  # long dead
+        c._agents["B"] = {"last_seen": _time.time(), "capacity": 1}  # alive
+        jid = c.submit("noop", {}, n_agents=2)
+        job = c._jobs[jid]
+        job["leased"] = ["A", "B"]
+        job["ranks"] = {"A": 0, "B": 1}
+        # A goes dead; C leases — must be re-issued A's rank 0.
+        import time as _t
+
+        c._agents["C"] = {"last_seen": _t.time(), "capacity": 1}
+        task = c.lease(jid, "C")
+        assert task is not None and task["rank"] == 0
+        # Revived A reports rank 0 → stale (its lease was reclaimed).
+        resp = c.report(jid, "A", result=11, error=None)
+        assert resp["ok"] is False
+        # C reports rank 0: still not finished — rank 1 uncovered.
+        c.report(jid, "C", result=22, error=None)
+        assert c.job(jid)["state"] != "finished"
+        # B reports rank 1: now finished with both partitions covered.
+        c.report(jid, "B", result=33, error=None)
+        done = c.job(jid)
+        assert done["state"] == "finished"
+        assert sorted(done["results"].values()) == [22, 33]
+    finally:
+        pass
+
+
+def test_builder_modeling_code_supplies_labels(tmp_path):
+    """modeling_code that sets labels_* must work on datasets WITHOUT a
+    'label' column (the dict.get eager-default regression)."""
+    from learningorchestra_tpu.config import Config
+    from learningorchestra_tpu.services.context import ServiceContext
+    from learningorchestra_tpu.services.builder import BuilderService
+
+    cfg = Config()
+    cfg.store.root = str(tmp_path / "store")
+    cfg.store.volume_root = str(tmp_path / "volumes")
+    ctx = ServiceContext(cfg)
+    try:
+        rng = np.random.default_rng(0)
+        rows = [
+            {"f1": float(v[0]), "f2": float(v[1])}
+            for v in rng.normal(size=(40, 2))
+        ]
+        for dsname in ("btrain", "btest"):
+            ctx.artifacts.metadata.create(dsname, "dataset/csv")
+            ctx.artifacts.documents.insert_many(dsname, rows)
+            ctx.artifacts.metadata.mark_finished(dsname)
+        svc = BuilderService(ctx)
+        code = (
+            "import numpy as np\n"
+            "features_training = training_df[['f1','f2']].to_numpy()\n"
+            "features_testing = testing_df[['f1','f2']].to_numpy()\n"
+            "labels_training = (features_training[:,0] > 0).astype(int)\n"
+            "labels_testing = (features_testing[:,0] > 0).astype(int)\n"
+        )
+        svc.create(
+            training_dataset="btrain",
+            test_dataset="btest",
+            modeling_code=code,
+            classifiers=["LogisticRegression"],
+        )
+        import time as _t
+
+        name = "btestLogisticRegression"
+        deadline = _t.time() + 60
+        while _t.time() < deadline:
+            meta = ctx.artifacts.metadata.read(name)
+            if meta.get("finished") or meta.get("jobState") == "failed":
+                break
+            _t.sleep(0.05)
+        assert meta.get("jobState") != "failed", meta.get("exception")
+        assert meta.get("finished")
+    finally:
+        ctx.close()
