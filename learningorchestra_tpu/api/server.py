@@ -85,6 +85,21 @@ class APIServer:
         self.executor = ExecutorService(self.ctx)
         self.function = FunctionService(self.ctx)
         self.builder = BuilderService(self.ctx)
+        import os as _os
+
+        from learningorchestra_tpu.services.distributed_exec import (
+            DistributedExecutorService,
+        )
+        from learningorchestra_tpu.services.monitoring import (
+            MonitoringService,
+        )
+
+        self.monitoring = MonitoringService(
+            _os.path.join(self.config.store.volume_path(), "_monitoring")
+        )
+        self.distributed = DistributedExecutorService(
+            self.ctx, self.monitoring
+        )
         self.router = Router(self.config.api.api_prefix)
         self._register_routes()
         self._httpd: ThreadingHTTPServer | None = None
@@ -353,6 +368,52 @@ class APIServer:
             )
             return 200, {"metadata": meta}
 
+        # ---- Distributed training (reference: POST /train/horovod →
+        # /distributedTraining?type=train/tensorflow, SURVEY §2.2) ----
+        def distributed_train_create(m, body, query):
+            meta, extra = self.distributed.create_train(
+                body.get("name"),
+                parent_name=body.get("parentName")
+                or body.get("modelName"),
+                training_parameters=body.get("trainingParameters")
+                or body.get("methodParameters"),
+                compile_spec=body.get("compile"),
+                mesh=body.get("mesh"),
+                monitoring_path=body.get("monitoringPath"),
+                description=body.get("description", ""),
+            )
+            status, payload = self._created("train/horovod", meta)
+            if extra:
+                payload["extra_results"] = extra
+            return status, payload
+
+        add("POST", r"/train/(?:horovod|distributed)",
+            distributed_train_create)
+
+        # ---- Monitoring (reference: GET /monitoring/tensorflow/{name} →
+        # TensorBoard URL lookup, server.py:185-200) ----
+        def monitoring_lookup(m, body, query):
+            from learningorchestra_tpu.services.monitoring import (
+                MonitoringError,
+            )
+
+            try:
+                return 200, self.monitoring.lookup(m.group("name"))
+            except MonitoringError as exc:
+                return 404, {"error": str(exc)}
+
+        add("GET", rf"/monitoring/{TOOL}/{NAME}", monitoring_lookup)
+        add(
+            "GET", rf"/monitoring/{TOOL}",
+            lambda m, b, q: (200, self.monitoring.list_sessions()),
+        )
+        add(
+            "DELETE", rf"/monitoring/{TOOL}/{NAME}",
+            lambda m, b, q: (
+                200, {"stopped": self.monitoring.stop(m.group("name"))},
+            ),
+        )
+
         for service in ("tune", "train", "evaluate", "predict"):
             add("POST", rf"/{service}/{TOOL}", exec_create(service))
             add("PATCH", rf"/{service}/{TOOL}/{NAME}", exec_update)
@@ -375,6 +436,23 @@ class APIServer:
 
         # ---- Builder ----
         def builder_create(m, body, query):
+            tool = m.group("tool")
+            if tool in ("tensorflow", "pytorch", "horovod"):
+                # Distributed builder: one user function on every rank
+                # (reference: POST /builder/tensorflow|pytorch →
+                # /builderHorovod?type=builder/horovod, SURVEY §2.2).
+                n_workers = body.get("nWorkers")
+                if n_workers is None:  # explicit: 0 must reach validation
+                    n_workers = body.get("n_workers")
+                meta = self.distributed.create_builder(
+                    body.get("name"),
+                    function=body.get("function")
+                    or body.get("modelingCode"),
+                    function_parameters=body.get("functionParameters"),
+                    n_workers=n_workers,
+                    description=body.get("description", ""),
+                )
+                return self._created(f"builder/{tool}", meta)
             metas = self.builder.create(
                 training_dataset=body.get("trainDatasetName"),
                 test_dataset=body.get("testDatasetName"),
@@ -580,6 +658,7 @@ class APIServer:
     def shutdown(self) -> None:
         if self._httpd is not None:
             self._httpd.shutdown()
+        self.monitoring.close()
         self.ctx.close()
 
 

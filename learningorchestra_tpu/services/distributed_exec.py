@@ -1,0 +1,250 @@
+"""Distributed execution service — the reference's flagship paths.
+
+Covers two routes (SURVEY §2.2, §3.3):
+
+- ``POST /train/horovod`` (reference: binary_executor_image/
+  binary_execution.py:237-292 — ship model JSON to Ray workers, Horovod
+  ring-allreduce inside ``model.fit``, rank-0 weights home): here the
+  same request shape drives :class:`DistributedTrainer` — one jitted
+  train step over a named mesh, gradients psum'd over ICI by XLA's SPMD
+  partitioner; no model serialization, no host ring, no weight lists.
+
+- ``POST /builder/tensorflow|pytorch`` (reference:
+  binary_execution.py:295-348 — ast-validate a single user function,
+  compile, run on every Ray worker): here the validated function runs
+  once per rank with ``rank``/``world_size`` kwargs — locally on
+  threads, or fanned over per-host agents when a coordinator is
+  configured (parallel/coordinator.py) — and per-rank results persist as
+  result rows + a dill binary.
+
+Request parity: ``training_parameters`` split into per-rank ``callbacks``
+vs ``rank0callbacks`` survives as a declarative passthrough; the
+``compile_code`` escape hatch maps to the declarative ``compile`` spec
+(optimizer/loss via the ``#`` DSL) rather than exec'd source.
+"""
+
+from __future__ import annotations
+
+import ast
+import concurrent.futures
+import time
+
+from learningorchestra_tpu import dsl
+from learningorchestra_tpu.services.context import (
+    ServiceContext,
+    ValidationError,
+)
+from learningorchestra_tpu.services.executor import (
+    _json_safe,
+    store_history_rows,
+)
+from learningorchestra_tpu.services.monitoring import (
+    MonitoringService,
+    write_scalar_logs,
+)
+
+DISTRIBUTED_TRAIN_TYPE = "train/tensorflow"
+DISTRIBUTED_BUILDER_TYPE = "builder/horovod"
+# One request must not be able to exhaust the server's threads: ranks are
+# host threads here (the compute inside each is XLA's concern).
+MAX_BUILDER_WORKERS = 256
+
+
+def _validate_single_function(code: str) -> str:
+    """The builder contract: the payload is EXACTLY one top-level function
+    definition (reference ast-validates this, binary_execution.py:328-339).
+    Returns the function name."""
+    try:
+        tree = ast.parse(code)
+    except SyntaxError as exc:
+        raise ValidationError(f"function does not parse: {exc}") from exc
+    defs = [n for n in tree.body if isinstance(
+        n, (ast.FunctionDef, ast.AsyncFunctionDef)
+    )]
+
+    def allowed(node: ast.stmt) -> bool:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            return True
+        # Expr is only a docstring — a bare call would execute at module
+        # exec time, outside the per-rank function the contract promises.
+        return isinstance(node, ast.Expr) and isinstance(
+            node.value, ast.Constant
+        ) and isinstance(node.value.value, str)
+
+    others = [n for n in tree.body if n not in defs and not allowed(n)]
+    if len(defs) != 1 or others:
+        raise ValidationError(
+            "builder function must be a single top-level function "
+            "definition (imports and a docstring are allowed)"
+        )
+    return defs[0].name
+
+
+class DistributedExecutorService:
+    def __init__(self, ctx: ServiceContext,
+                 monitoring: MonitoringService | None = None):
+        self.ctx = ctx
+        self.monitoring = monitoring
+
+    # -- distributed training -------------------------------------------------
+
+    def create_train(
+        self,
+        name: str,
+        *,
+        parent_name: str,
+        training_parameters: dict | None = None,
+        compile_spec: dict | None = None,
+        mesh: dict | None = None,
+        monitoring_path: str | None = None,
+        artifact_type: str = DISTRIBUTED_TRAIN_TYPE,
+        description: str = "",
+    ) -> tuple[dict, dict]:
+        """Returns (metadata, extra_results) — extra carries the
+        monitoring URL the reference returned inline
+        (server.py:70-76,104)."""
+        self.ctx.require_new_name(name)
+        parent_meta = self.ctx.require_finished_parent(parent_name)
+        model_meta = self.ctx.artifacts.metadata.find_model_ancestor(
+            parent_name
+        )
+        meta = self.ctx.artifacts.metadata.create(
+            name,
+            artifact_type,
+            parent_name=parent_name,
+            module_path=model_meta.get("modulePath"),
+            class_name=model_meta.get("class"),
+            method="fit",
+            extra={"distributed": True, "mesh": _json_safe(mesh or {})},
+        )
+
+        extra_results: dict = {}
+        session_name = None
+        session_logdir = None
+        if monitoring_path is not None and self.monitoring is not None:
+            session_name = str(monitoring_path).strip("/").replace(
+                "/", "_"
+            ) or name
+            session_info = self.monitoring.start(session_name)
+            # Capture the logdir now: a mid-train DELETE of the session
+            # must not fail an otherwise-successful training job.
+            session_logdir = session_info["logdir"]
+            extra_results["monitoring"] = session_info
+
+        parent_type = parent_meta.get("type", "")
+
+        def run():
+            from learningorchestra_tpu.parallel.distributed import (
+                DistributedTrainer,
+            )
+            from learningorchestra_tpu.parallel.mesh import MeshSpec
+
+            instance = self.ctx.volumes.read_object(parent_type, parent_name)
+            if not hasattr(instance, "module"):
+                raise ValidationError(
+                    f"parent {parent_name!r} is not a neural estimator — "
+                    f"distributed training requires one"
+                )
+            params = dsl.resolve_params(
+                training_parameters, self.ctx.loader
+            )
+            if compile_spec:
+                instance.compile(
+                    **dsl.resolve_params(compile_spec, self.ctx.loader)
+                )
+            spec = MeshSpec.from_dict(mesh) if mesh else None
+            trainer = DistributedTrainer(instance, spec=spec)
+            t0 = time.perf_counter()
+            if session_name is not None:
+                with self.monitoring.trace(session_name):
+                    trainer.fit(**params)
+            else:
+                trainer.fit(**params)
+            fit_time = time.perf_counter() - t0
+            self.ctx.volumes.save_object(artifact_type, name, instance)
+            store_history_rows(
+                self.ctx.documents, name, dict(trainer.history)
+            )
+            if session_logdir is not None:
+                write_scalar_logs(
+                    session_logdir, dict(trainer.history), prefix=name
+                )
+            return {
+                "fitTime": fit_time,
+                "meshDevices": trainer.mesh.size,
+            }
+
+        self.ctx.engine.submit(
+            name,
+            run,
+            description=description or f"distributed fit on {parent_name}",
+            method="fit",
+            parameters=_json_safe(training_parameters),
+            on_success=lambda extra: extra,
+        )
+        return meta, extra_results
+
+    # -- distributed builder --------------------------------------------------
+
+    def create_builder(
+        self,
+        name: str,
+        *,
+        function: str,
+        function_parameters: dict | None = None,
+        n_workers: int | None = None,
+        artifact_type: str = DISTRIBUTED_BUILDER_TYPE,
+        description: str = "",
+    ) -> dict:
+        self.ctx.require_new_name(name)
+        if not function or not isinstance(function, str):
+            raise ValidationError("missing 'function' code")
+        fn_name = _validate_single_function(function)
+        if n_workers is None:
+            world = int(self.ctx.config.dist.num_processes or 1)
+        else:
+            try:
+                world = int(n_workers)
+            except (TypeError, ValueError):
+                raise ValidationError("n_workers must be an integer")
+        if not 1 <= world <= MAX_BUILDER_WORKERS:
+            raise ValidationError(
+                f"n_workers must be in [1, {MAX_BUILDER_WORKERS}]"
+            )
+        meta = self.ctx.artifacts.metadata.create(
+            name,
+            artifact_type,
+            method=fn_name,
+            extra={"worldSize": world},
+        )
+
+        def run():
+            params = dsl.resolve_params(
+                function_parameters, self.ctx.loader
+            )
+            globs: dict = {"__name__": f"builder_{name}"}
+            exec(compile(function, f"<builder {name}>", "exec"),  # noqa: S102
+                 globs)
+            fn = globs[fn_name]
+
+            def one_rank(rank: int):
+                return fn(rank=rank, world_size=world, **params)
+
+            with concurrent.futures.ThreadPoolExecutor(world) as pool:
+                results = list(pool.map(one_rank, range(world)))
+            self.ctx.volumes.save_object(artifact_type, name, results)
+            for rank, result in enumerate(results):
+                self.ctx.documents.insert_one(
+                    name, {"rank": rank, "result": _json_safe(result)}
+                )
+            return {"worldSize": world}
+
+        self.ctx.engine.submit(
+            name,
+            run,
+            description=description or f"distributed builder ({world} ranks)",
+            method=fn_name,
+            parameters=_json_safe(function_parameters),
+            on_success=lambda extra: extra,
+        )
+        return meta

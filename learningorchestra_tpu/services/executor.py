@@ -30,6 +30,25 @@ from learningorchestra_tpu.toolkit import registry
 TRAIN_KINDS = ("train", "tune")
 
 
+def store_history_rows(documents, name: str, history: dict) -> int:
+    """Persist a TrainHistory-shaped dict ({metric: [per-epoch...]}) as one
+    pollable row per epoch — the durable metrics contract (SURVEY §5.5).
+    Shared by the single-device and distributed train paths."""
+    keys = list(history)
+    n = max((len(history[k]) for k in keys), default=0)
+    for i in range(n):
+        documents.insert_one(
+            name,
+            {
+                "epoch": i,
+                **{
+                    k: history[k][i] for k in keys if len(history[k]) > i
+                },
+            },
+        )
+    return n
+
+
 class ExecutorService:
     def __init__(self, ctx: ServiceContext):
         self.ctx = ctx
@@ -125,16 +144,7 @@ class ExecutorService:
                 extra = {"fitTime": fit_time}
                 hist = getattr(instance, "history", None)
                 if hist:
-                    for row_i in range(
-                        len(next(iter(hist.values()), []))
-                    ):
-                        self.ctx.documents.insert_one(
-                            name,
-                            {
-                                "epoch": row_i,
-                                **{k: v[row_i] for k, v in hist.items()},
-                            },
-                        )
+                    store_history_rows(self.ctx.documents, name, hist)
                 return extra
             # Evaluate/predict semantics: persist result rows + binary.
             self.ctx.volumes.save_object(artifact_type, name, result)

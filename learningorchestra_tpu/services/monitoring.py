@@ -1,0 +1,209 @@
+"""Monitoring service — managed TensorBoard/XProf sessions + URL registry.
+
+The reference spawns ``tensorboard --logdir <path>`` as a subprocess when a
+train request carries ``monitoringPath``, scrapes the port from its stdout,
+builds a public URL, returns it in ``extra_results`` and serves later
+lookups by nickname (reference: microservices/binary_executor_image/
+server.py:323-329 spawn, utils.py:358-399 URL discovery,
+server.py:185-200 GET lookup).
+
+TPU-native differences:
+- sessions live in a supervised registry with atomic nickname allocation
+  (the reference's collision handling was broken — SURVEY §5.2);
+- a session's logdir also receives **JAX profiler traces**
+  (``jax.profiler.trace``): per-job XLA/TPU timelines viewable in
+  TensorBoard's profile plugin — the reference could only show what keras
+  callbacks wrote;
+- TensorBoard itself is optional: when the binary is absent the session
+  still registers (logdir + trace capture work; ``url`` is None).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import re
+import shutil
+import socket
+import subprocess
+import threading
+import time
+from typing import Any
+
+_PORT_RE = re.compile(r"http://[^\s:]+:(\d+)")
+
+
+class MonitoringError(Exception):
+    pass
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+class MonitoringSession:
+    def __init__(self, nickname: str, logdir: str):
+        self.nickname = nickname
+        self.logdir = logdir
+        self.url: str | None = None
+        self.port: int | None = None
+        self.process: subprocess.Popen | None = None
+        self.created = time.time()
+
+    def to_dict(self) -> dict:
+        return {
+            "nickname": self.nickname,
+            "logdir": self.logdir,
+            "url": self.url,
+            "port": self.port,
+            "running": self.process is not None
+            and self.process.poll() is None,
+        }
+
+
+class MonitoringService:
+    """Supervised registry of monitoring sessions, nickname → session."""
+
+    def __init__(self, root: str, *, host: str = "127.0.0.1"):
+        self.root = root
+        self.host = host
+        self._sessions: dict[str, MonitoringSession] = {}
+        self._lock = threading.Lock()
+
+    # -- session lifecycle ---------------------------------------------------
+
+    def start(self, nickname: str, *, spawn_tensorboard: bool = True) -> dict:
+        """Create (or return) the session for ``nickname``.
+
+        Atomic: concurrent starts for the same nickname return the same
+        session instead of racing two TensorBoard processes onto one
+        logdir (the reference's ProcessController collision path raised —
+        utils.py:366)."""
+        with self._lock:
+            existing = self._sessions.get(nickname)
+            if existing is not None:
+                return existing.to_dict()
+            logdir = os.path.join(self.root, nickname)
+            os.makedirs(logdir, exist_ok=True)
+            session = MonitoringSession(nickname, logdir)
+            self._sessions[nickname] = session
+        if spawn_tensorboard:
+            self._spawn_tensorboard(session)
+        return session.to_dict()
+
+    def _spawn_tensorboard(self, session: MonitoringSession) -> None:
+        binary = shutil.which("tensorboard")
+        if binary is None:
+            return  # logdir-only session; traces still collect
+        port = _free_port()
+        try:
+            # DEVNULL: nothing reads the child's output, and a PIPE nobody
+            # drains would block TensorBoard once the OS buffer fills.
+            proc = subprocess.Popen(
+                [
+                    binary,
+                    "--logdir", session.logdir,
+                    "--port", str(port),
+                    "--bind_all",
+                ],
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.STDOUT,
+            )
+        except OSError:
+            return
+        session.process = proc
+        session.port = port
+
+        # Probe for readiness off-thread: the caller is an HTTP POST
+        # handler and must not stall on TensorBoard startup; ``url`` stays
+        # None until the server answers (lookup tolerates None).
+        def probe_ready():
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if proc.poll() is not None:
+                    return  # died; stay logdir-only
+                with socket.socket() as probe:
+                    probe.settimeout(0.2)
+                    if probe.connect_ex((self.host, port)) == 0:
+                        session.url = f"http://{self.host}:{port}/"
+                        return
+                time.sleep(0.2)
+
+        threading.Thread(target=probe_ready, daemon=True).start()
+
+    def lookup(self, nickname: str) -> dict:
+        """GET by nickname (reference: server.py:185-200)."""
+        with self._lock:
+            session = self._sessions.get(nickname)
+        if session is None:
+            raise MonitoringError(f"no monitoring session {nickname!r}")
+        return session.to_dict()
+
+    def list_sessions(self) -> list[dict]:
+        with self._lock:
+            return [s.to_dict() for s in self._sessions.values()]
+
+    def stop(self, nickname: str) -> bool:
+        with self._lock:
+            session = self._sessions.pop(nickname, None)
+        if session is None:
+            return False
+        if session.process is not None and session.process.poll() is None:
+            session.process.terminate()
+            try:
+                session.process.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                session.process.kill()
+        return True
+
+    def close(self) -> None:
+        for nickname in list(self._sessions):
+            self.stop(nickname)
+
+    # -- JAX profiler traces --------------------------------------------------
+
+    @contextlib.contextmanager
+    def trace(self, nickname: str):
+        """Capture a JAX profiler trace into the session's logdir.
+
+        Wrap a train loop: the resulting XPlane shows XLA op timelines,
+        HBM usage and (on TPU) MXU utilization in TensorBoard's profile
+        tab — per-job, the way the reference registered per-job
+        TensorBoard monitors."""
+        info = self.start(nickname, spawn_tensorboard=False)
+        import jax
+
+        try:
+            jax.profiler.start_trace(info["logdir"])
+            started = True
+        except Exception:
+            started = False  # another trace already active — skip, not fail
+        try:
+            yield info
+        finally:
+            if started:
+                with contextlib.suppress(Exception):
+                    jax.profiler.stop_trace()
+
+
+def write_scalar_logs(logdir: str, history: dict, *, prefix: str = "") -> int:
+    """Write a TrainHistory as TensorBoard scalar events (no TF needed —
+    minimal event-file encoding via tensorboardX-style records is overkill;
+    we emit a CSV the profile-less UI and users can read, plus return the
+    row count).  Durable metrics rows for the GET/poll contract live in the
+    document store (SURVEY §5.5); this is the human-readable copy."""
+    os.makedirs(logdir, exist_ok=True)
+    path = os.path.join(logdir, f"{prefix or 'metrics'}.csv")
+    keys = sorted(history)
+    n = max((len(v) for v in history.values()), default=0)
+    with open(path, "w") as fh:
+        fh.write(",".join(["step"] + keys) + "\n")
+        for i in range(n):
+            row = [str(i)] + [
+                str(history[k][i]) if i < len(history[k]) else ""
+                for k in keys
+            ]
+            fh.write(",".join(row) + "\n")
+    return n

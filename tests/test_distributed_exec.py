@@ -1,0 +1,244 @@
+"""Distributed-train / distributed-builder / monitoring route contracts
+(reference: POST /train/horovod, POST /builder/tensorflow,
+GET /monitoring/tensorflow/{name} — SURVEY §2.2, §3.3)."""
+
+import time
+
+import numpy as np
+import pytest
+import requests
+
+from learningorchestra_tpu.api import APIServer
+from learningorchestra_tpu.config import Config
+
+PREFIX = "/api/learningOrchestra/v1"
+
+
+@pytest.fixture(scope="module")
+def api(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("distapi")
+    cfg = Config()
+    cfg.store.root = str(tmp / "store")
+    cfg.store.volume_root = str(tmp / "volumes")
+    server = APIServer(cfg)
+    port = server.start_background()
+    base = f"http://127.0.0.1:{port}{PREFIX}"
+    yield base, tmp
+    server.shutdown()
+
+
+def poll(base, path, timeout=120):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        docs = requests.get(f"{base}{path}", timeout=10).json()
+        meta = docs[0] if isinstance(docs, list) and docs else {}
+        if meta.get("finished"):
+            return meta
+        if meta.get("jobState") == "failed":
+            raise AssertionError(f"job failed: {meta.get('exception')}")
+        time.sleep(0.05)
+    raise AssertionError(f"timeout polling {path}")
+
+
+@pytest.fixture(scope="module")
+def dataset(api, tmp_path_factory):
+    base, _ = api
+    tmp = tmp_path_factory.mktemp("distdata")
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(128, 4))
+    y = (x[:, 0] - x[:, 1] > 0).astype(int)
+    path = tmp / "dd.csv"
+    with open(path, "w") as fh:
+        fh.write("a,b,c,d,label\n")
+        for row, label in zip(x, y):
+            fh.write(",".join(f"{v:.5f}" for v in row) + f",{label}\n")
+    resp = requests.post(
+        f"{base}/dataset/csv",
+        json={"datasetName": "dd", "url": f"file://{path}"},
+    )
+    assert resp.status_code == 201, resp.text
+    poll(base, "/dataset/csv/dd")
+    # Feature projection (labels excluded).
+    resp = requests.post(
+        f"{base}/transform/projection",
+        json={"datasetName": "dd", "projectionName": "dd_X",
+              "fields": ["a", "b", "c", "d"]},
+    )
+    assert resp.status_code == 201, resp.text
+    poll(base, "/dataset/csv/dd_X")
+    return "dd"
+
+
+def test_distributed_train_route(api, dataset):
+    base, _ = api
+    resp = requests.post(
+        f"{base}/model/tensorflow",
+        json={
+            "name": "dmlp",
+            "modulePath": "learningorchestra_tpu.models.mlp",
+            "class": "MLPClassifier",
+            "classParameters": {
+                "hidden_layer_sizes": [8], "num_classes": 2,
+            },
+        },
+    )
+    assert resp.status_code == 201, resp.text
+    poll(base, "/model/tensorflow/dmlp")
+    resp = requests.post(
+        f"{base}/train/horovod",
+        json={
+            "name": "dtrain",
+            "parentName": "dmlp",
+            "trainingParameters": {
+                "x": "$dd_X",
+                "y": "$dd.label",
+                "epochs": 2,
+                "batch_size": 16,
+            },
+            "mesh": {"dp": 2},
+            "monitoringPath": "dtrain_logs",
+        },
+    )
+    assert resp.status_code == 201, resp.text
+    body = resp.json()
+    assert "extra_results" in body  # monitoring session registered inline
+    meta = poll(base, "/train/horovod/dtrain")
+    assert meta["distributed"] is True
+    assert meta["meshDevices"] == 8  # spec dp=2 folds spare devices into dp
+    # History rows are pollable (epoch metrics as result rows).
+    docs = requests.get(f"{base}/train/horovod/dtrain?limit=10").json()
+    epochs = [d for d in docs if "epoch" in d]
+    assert len(epochs) == 2
+    assert all("samples_per_sec" in d for d in epochs)
+
+    # Monitoring lookup by nickname.
+    resp = requests.get(f"{base}/monitoring/tensorflow/dtrain_logs")
+    assert resp.status_code == 200
+    assert resp.json()["logdir"]
+    # Unknown nickname → 404.
+    assert requests.get(
+        f"{base}/monitoring/tensorflow/nope"
+    ).status_code == 404
+
+    # Predict from the distributed-trained artifact (lineage walk).
+    resp = requests.post(
+        f"{base}/predict/tensorflow",
+        json={
+            "name": "dpreds",
+            "parentName": "dtrain",
+            "method": "predict_classes",
+            "methodParameters": {"x": "$dd_X"},
+        },
+    )
+    assert resp.status_code == 201, resp.text
+    poll(base, "/predict/tensorflow/dpreds")
+    docs = requests.get(f"{base}/predict/tensorflow/dpreds?limit=100").json()
+    preds = [d["result"] for d in docs if "result" in d]
+    assert len(preds) > 0 and set(preds) <= {0, 1}
+
+
+def test_distributed_builder_route(api, dataset):
+    base, _ = api
+    code = (
+        "def builder(rank, world_size, xs):\n"
+        "    total = sum(xs)\n"
+        "    return {'rank': rank, 'world': world_size,"
+        " 'share': total / world_size}\n"
+    )
+    resp = requests.post(
+        f"{base}/builder/tensorflow",
+        json={
+            "name": "dbuild",
+            "function": code,
+            "functionParameters": {"xs": [1, 2, 3]},
+            "nWorkers": 3,
+        },
+    )
+    assert resp.status_code == 201, resp.text
+    meta = poll(base, "/builder/tensorflow/dbuild")
+    assert meta["worldSize"] == 3
+    docs = requests.get(f"{base}/builder/tensorflow/dbuild?limit=10").json()
+    ranks = sorted(
+        d["result"]["rank"] for d in docs if "result" in d
+    )
+    assert ranks == [0, 1, 2]
+
+
+def test_distributed_builder_rejects_non_function(api):
+    base, _ = api
+    resp = requests.post(
+        f"{base}/builder/pytorch",
+        json={"name": "dbad", "function": "x = 1\ny = 2\n"},
+    )
+    assert resp.status_code == 406
+
+
+def test_monitoring_service_atomic_and_trace(tmp_path):
+    from learningorchestra_tpu.services.monitoring import (
+        MonitoringService,
+        write_scalar_logs,
+    )
+    import concurrent.futures
+    import os
+
+    svc = MonitoringService(str(tmp_path / "mon"))
+    try:
+        # Concurrent starts for one nickname must converge on one session.
+        with concurrent.futures.ThreadPoolExecutor(8) as pool:
+            infos = list(pool.map(
+                lambda _: svc.start("nick", spawn_tensorboard=False),
+                range(8),
+            ))
+        assert len({i["logdir"] for i in infos}) == 1
+        assert len(svc.list_sessions()) == 1
+
+        with svc.trace("nick") as info:
+            import jax.numpy as jnp
+
+            (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
+        # Trace wrote something into the logdir (plugins/profile/...).
+        assert any(os.scandir(info["logdir"]))
+
+        n = write_scalar_logs(
+            info["logdir"], {"loss": [1.0, 0.5], "acc": [0.4, 0.9]},
+            prefix="job",
+        )
+        assert n == 2
+        with open(os.path.join(info["logdir"], "job.csv")) as fh:
+            assert fh.readline().strip() == "step,acc,loss"
+        assert svc.stop("nick") and not svc.stop("nick")
+    finally:
+        svc.close()
+
+
+def test_builder_worker_count_validation(api):
+    base, _ = api
+    fn = "def f(rank, world_size):\n    return rank\n"
+    # 0 workers must be rejected, not silently defaulted.
+    assert requests.post(
+        f"{base}/builder/tensorflow",
+        json={"name": "w0", "function": fn, "nWorkers": 0},
+    ).status_code == 406
+    # Absurd counts are capped at validation time.
+    assert requests.post(
+        f"{base}/builder/tensorflow",
+        json={"name": "wbig", "function": fn, "nWorkers": 10_000_000},
+    ).status_code == 406
+
+
+def test_builder_rejects_toplevel_side_effects(api):
+    base, _ = api
+    code = (
+        "def f(rank, world_size):\n    return rank\n"
+        "print('side effect at exec time')\n"
+    )
+    assert requests.post(
+        f"{base}/builder/tensorflow",
+        json={"name": "wside", "function": code},
+    ).status_code == 406
+    # A docstring stays allowed.
+    code_ok = '"""doc"""\ndef f(rank, world_size):\n    return rank\n'
+    assert requests.post(
+        f"{base}/builder/tensorflow",
+        json={"name": "wdoc", "function": code_ok, "nWorkers": 1},
+    ).status_code == 201
