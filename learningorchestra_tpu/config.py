@@ -28,6 +28,8 @@ class StoreConfig:
     volume_root: str = "~/.learningorchestra_tpu/volumes"
     # fsync appends on every write (durable) vs. rely on OS flush (fast).
     durable_writes: bool = False
+    # Document-store engine: "auto" | "native" (C++ liblodstore) | "python".
+    backend: str = "auto"
 
     def store_path(self) -> Path:
         return Path(os.path.expanduser(self.root))
@@ -131,6 +133,8 @@ class Config:
             cfg.store.root = env["LO_TPU_STORE_ROOT"]
         if "LO_TPU_VOLUME_ROOT" in env:
             cfg.store.volume_root = env["LO_TPU_VOLUME_ROOT"]
+        if "LO_TPU_STORE_BACKEND" in env:
+            cfg.store.backend = env["LO_TPU_STORE_BACKEND"]
         if "LO_TPU_API_PORT" in env:
             cfg.api.port = int(env["LO_TPU_API_PORT"])
         if "LO_TPU_MAX_WORKERS" in env:

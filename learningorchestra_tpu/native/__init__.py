@@ -1,0 +1,351 @@
+"""ctypes binding for the native (C++) runtime — see ``native/src``.
+
+``liblodstore.so`` is the native document-store + CSV-ingest engine: the
+system-of-record role MongoDB (a C++ server) plays in the reference
+deployment (reference: docker-compose.yml:42-90), built first-party.  The
+WAL format is byte-compatible with the pure-Python ``DocumentStore``, so
+either backend can open the other's data directory.
+
+``ensure_built()`` compiles the library on demand (g++, see
+``native/Makefile``); when no toolchain is available everything falls
+back to the Python backend — the native layer is an accelerator, not a
+dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import subprocess
+import threading
+from pathlib import Path
+from typing import Any, Iterable
+
+from learningorchestra_tpu.store.document_store import (
+    DuplicateKey,
+    NoSuchCollection,
+    _match,
+)
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+_NATIVE_DIR = _REPO_ROOT / "native"
+_LIB_PATH = _NATIVE_DIR / "build" / "liblodstore.so"
+
+_build_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_build_failed = False
+
+
+def ensure_built() -> Path | None:
+    """Build (if stale/missing) and return the shared library path."""
+    global _build_failed
+    with _build_lock:
+        if _build_failed:
+            return None
+        src = _NATIVE_DIR / "src" / "docstore.cpp"
+        if not src.exists():
+            _build_failed = True
+            return None
+        if (
+            not _LIB_PATH.exists()
+            or _LIB_PATH.stat().st_mtime < src.stat().st_mtime
+        ):
+            try:
+                subprocess.run(
+                    ["make", "-C", str(_NATIVE_DIR)],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except Exception:
+                _build_failed = True
+                return None
+        return _LIB_PATH if _LIB_PATH.exists() else None
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c_char_p = ctypes.c_char_p
+    i64 = ctypes.c_int64
+    ll = ctypes.c_longlong
+    p_i64 = ctypes.POINTER(ctypes.c_int64)
+    p_ll = ctypes.POINTER(ctypes.c_longlong)
+    # Returned buffers are malloc'd char*; keep them as void* so ctypes
+    # doesn't copy-and-lose the pointer we must pass to lods_free.
+    buf_t = ctypes.c_void_p
+
+    lib.lods_last_error.restype = c_char_p
+    lib.lods_free.argtypes = [buf_t]
+    lib.lods_open.argtypes = [c_char_p, ctypes.c_int]
+    lib.lods_open.restype = i64
+    lib.lods_close.argtypes = [i64]
+    lib.lods_has_collection.argtypes = [i64, c_char_p]
+    lib.lods_list_collections.argtypes = [i64, p_i64]
+    lib.lods_list_collections.restype = buf_t
+    lib.lods_insert_many.argtypes = [i64, c_char_p, c_char_p, i64, p_ll]
+    lib.lods_insert_many.restype = i64
+    lib.lods_insert_at.argtypes = [i64, c_char_p, c_char_p, ll, ctypes.c_int]
+    lib.lods_update.argtypes = [i64, c_char_p, ll, c_char_p]
+    lib.lods_delete.argtypes = [i64, c_char_p, ll]
+    lib.lods_find_one.argtypes = [i64, c_char_p, ll, p_i64]
+    lib.lods_find_one.restype = buf_t
+    lib.lods_scan.argtypes = [i64, c_char_p, i64, i64, p_i64]
+    lib.lods_scan.restype = buf_t
+    lib.lods_count.argtypes = [i64, c_char_p]
+    lib.lods_count.restype = i64
+    lib.lods_next_id.argtypes = [i64, c_char_p]
+    lib.lods_next_id.restype = ll
+    lib.lods_value_counts.argtypes = [i64, c_char_p, c_char_p, p_i64]
+    lib.lods_value_counts.restype = buf_t
+    lib.lods_drop.argtypes = [i64, c_char_p]
+    lib.lods_compact.argtypes = [i64, c_char_p]
+    lib.lods_csv_parse.argtypes = [c_char_p, i64, ctypes.c_int, p_i64]
+    lib.lods_csv_parse.restype = buf_t
+    return lib
+
+
+def load_library() -> ctypes.CDLL | None:
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = ensure_built()
+    if path is None:
+        return None
+    with _build_lock:
+        if _lib is None:
+            _lib = _bind(ctypes.CDLL(str(path)))
+    return _lib
+
+
+def native_available() -> bool:
+    return load_library() is not None
+
+
+def _raise_native(lib: ctypes.CDLL):
+    msg = lib.lods_last_error().decode()
+    if "invalid collection name" in msg:
+        raise ValueError(msg)  # match DocumentStore._validate_name
+    raise RuntimeError(msg)
+
+
+def _take(lib: ctypes.CDLL, ptr: int, length: int) -> bytes:
+    """Copy a returned buffer and free the native allocation."""
+    if not ptr:
+        return b""
+    try:
+        return ctypes.string_at(ptr, length)
+    finally:
+        lib.lods_free(ptr)
+
+
+def _dumps(doc: dict) -> bytes:
+    d = {k: v for k, v in doc.items() if k != "_id"}
+    return json.dumps(d, default=str).encode()
+
+
+def csv_parse(data: bytes, infer_types: bool = True):
+    """CSV bytes → (fields, jsonl doc lines) via the native parser."""
+    lib = load_library()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    out_len = ctypes.c_int64()
+    ptr = lib.lods_csv_parse(
+        data, len(data), 1 if infer_types else 0, ctypes.byref(out_len)
+    )
+    if not ptr:
+        raise ValueError(lib.lods_last_error().decode())
+    payload = _take(lib, ptr, out_len.value)
+    head, _, rest = payload.partition(b"\n")
+    return json.loads(head), rest
+
+
+class NativeDocumentStore:
+    """Drop-in replacement for ``DocumentStore`` backed by liblodstore.
+
+    Documents live in native memory as raw JSON; Python materialises them
+    only on read.  Query filtering beyond id-ordered paging reuses the
+    Python ``_match`` operator set over a native scan.
+    """
+
+    def __init__(self, root: str | Path, durable_writes: bool = False):
+        self._lib = load_library()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self.root = Path(root).expanduser()
+        self.root.mkdir(parents=True, exist_ok=True)
+        self._h = self._lib.lods_open(
+            str(self.root).encode(), 1 if durable_writes else 0
+        )
+        if self._h < 0:
+            _raise_native(self._lib)
+        self._closed = False
+
+    # -- collection lifecycle ----------------------------------------------
+
+    def collection_exists(self, name: str) -> bool:
+        return self._lib.lods_has_collection(self._h, name.encode()) == 1
+
+    def list_collections(self) -> list[str]:
+        n = ctypes.c_int64()
+        ptr = self._lib.lods_list_collections(self._h, ctypes.byref(n))
+        data = _take(self._lib, ptr, n.value)
+        return [ln for ln in data.decode().splitlines() if ln]
+
+    def drop(self, name: str) -> bool:
+        return self._lib.lods_drop(self._h, name.encode()) == 1
+
+    # -- writes -------------------------------------------------------------
+
+    def insert_one(self, name: str, doc: dict, _id: int | None = None) -> int:
+        if _id is None:
+            first = ctypes.c_longlong()
+            payload = _dumps(doc) + b"\n"
+            n = self._lib.lods_insert_many(
+                self._h, name.encode(), payload, len(payload),
+                ctypes.byref(first),
+            )
+            if n < 0:
+                _raise_native(self._lib)
+            return int(first.value)
+        rc = self._lib.lods_insert_at(
+            self._h, name.encode(), _dumps(doc), _id, 0
+        )
+        if rc < 0:
+            _raise_native(self._lib)
+        return _id
+
+    def insert_unique(self, name: str, doc: dict, _id: int) -> int:
+        rc = self._lib.lods_insert_at(
+            self._h, name.encode(), _dumps(doc), _id, 1
+        )
+        if rc == -2:
+            raise DuplicateKey(f"{name}[{_id}]")
+        if rc < 0:
+            _raise_native(self._lib)
+        return _id
+
+    def insert_many(self, name: str, docs: Iterable[dict]) -> int:
+        payload = b"\n".join(_dumps(d) for d in docs)
+        if not payload:
+            return 0
+        return self.insert_jsonl(name, payload + b"\n")
+
+    def insert_jsonl(self, name: str, jsonl: bytes) -> int:
+        """Fast path: pre-serialized JSONL docs (no ``_id`` fields) go
+        straight into the native engine — paired with ``csv_parse`` this
+        makes CSV ingest bypass Python object materialisation entirely
+        (the reference's per-row hot loop, database_api_image/
+        database.py:139-151)."""
+        first = ctypes.c_longlong()
+        n = self._lib.lods_insert_many(
+            self._h, name.encode(), jsonl, len(jsonl), ctypes.byref(first)
+        )
+        if n < 0:
+            _raise_native(self._lib)
+        return int(n)
+
+    def update_one(self, name: str, _id: int, fields: dict) -> bool:
+        rc = self._lib.lods_update(
+            self._h, name.encode(), _id, _dumps(fields)
+        )
+        if rc < 0:
+            raise NoSuchCollection(name)
+        return rc == 1
+
+    def delete_one(self, name: str, _id: int) -> bool:
+        rc = self._lib.lods_delete(self._h, name.encode(), _id)
+        if rc < 0:
+            raise NoSuchCollection(name)
+        return rc == 1
+
+    # -- reads --------------------------------------------------------------
+
+    def _scan(self, name: str, skip: int = 0, limit: int = -1) -> list[dict]:
+        n = ctypes.c_int64()
+        ptr = self._lib.lods_scan(
+            self._h, name.encode(), skip, limit, ctypes.byref(n)
+        )
+        if not ptr and not self.collection_exists(name):
+            raise NoSuchCollection(name)
+        data = _take(self._lib, ptr, n.value)
+        return [json.loads(ln) for ln in data.splitlines() if ln]
+
+    def find(
+        self,
+        name: str,
+        query: dict | None = None,
+        sort_key: str = "_id",
+        skip: int = 0,
+        limit: int | None = None,
+    ) -> list[dict]:
+        if not query and sort_key == "_id":
+            return self._scan(name, skip, -1 if limit is None else limit)
+        docs = [d for d in self._scan(name) if _match(d, query)]
+        if sort_key != "_id":
+            docs.sort(
+                key=lambda d: (d.get(sort_key) is None, d.get(sort_key))
+            )
+        if skip:
+            docs = docs[skip:]
+        if limit is not None:
+            docs = docs[:limit]
+        return docs
+
+    def find_one(self, name: str, _id: int) -> dict | None:
+        n = ctypes.c_int64()
+        ptr = self._lib.lods_find_one(
+            self._h, name.encode(), _id, ctypes.byref(n)
+        )
+        if not ptr:
+            return None
+        return json.loads(_take(self._lib, ptr, n.value))
+
+    def count(self, name: str, query: dict | None = None) -> int:
+        if query is None:
+            n = self._lib.lods_count(self._h, name.encode())
+            if n < 0:
+                raise NoSuchCollection(name)
+            return int(n)
+        return sum(1 for d in self._scan(name) if _match(d, query))
+
+    def aggregate_counts(
+        self, name: str, field: str, exclude_ids: tuple = (0,)
+    ) -> dict[Any, int]:
+        if tuple(exclude_ids) != (0,):
+            counts: dict[Any, int] = {}
+            for doc in self._scan(name):
+                if doc.get("_id") in exclude_ids \
+                        or doc.get("docType") == "execution":
+                    continue
+                val = doc.get(field)
+                if isinstance(val, (list, dict)):
+                    val = json.dumps(val, default=str)
+                counts[val] = counts.get(val, 0) + 1
+            return counts
+        n = ctypes.c_int64()
+        ptr = self._lib.lods_value_counts(
+            self._h, name.encode(), field.encode(), ctypes.byref(n)
+        )
+        if not ptr and not self.collection_exists(name):
+            raise NoSuchCollection(name)
+        data = _take(self._lib, ptr, n.value)
+        counts = {}
+        for ln in data.splitlines():
+            if not ln:
+                continue
+            rec = json.loads(ln)
+            key = rec["k"]
+            if isinstance(key, (list, dict)):
+                key = json.dumps(key, default=str)
+            counts[key] = counts.get(key, 0) + rec["n"]
+        return counts
+
+    # -- maintenance --------------------------------------------------------
+
+    def compact(self, name: str) -> None:
+        if self._lib.lods_compact(self._h, name.encode()) < 0:
+            raise NoSuchCollection(name)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._lib.lods_close(self._h)
+            self._closed = True

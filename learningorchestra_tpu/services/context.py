@@ -15,8 +15,8 @@ from learningorchestra_tpu.config import Config, get_config
 from learningorchestra_tpu.jobs import JobEngine
 from learningorchestra_tpu.store import (
     ArtifactStore,
-    DocumentStore,
     VolumeStorage,
+    open_document_store,
 )
 
 
@@ -35,9 +35,10 @@ class ConflictError(Exception):
 class ServiceContext:
     def __init__(self, config: Config | None = None):
         self.config = config or get_config()
-        self.documents = DocumentStore(
+        self.documents = open_document_store(
             self.config.store.store_path(),
             durable_writes=self.config.store.durable_writes,
+            backend=self.config.store.backend,
         )
         self.artifacts = ArtifactStore(self.documents)
         self.volumes = VolumeStorage(self.config.store.volume_path())

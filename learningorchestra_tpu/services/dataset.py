@@ -18,6 +18,9 @@ from __future__ import annotations
 
 import contextlib
 import csv
+import json
+import math
+import os
 import re
 from typing import Iterable
 
@@ -37,17 +40,32 @@ def _clean_header(header: list[str]) -> list[str]:
     return out
 
 
+_INT_RE = re.compile(r"[+-]?[0-9]+")
+
+
 def _infer(value: str):
+    """Type inference matching the native CSV engine exactly (native/src/
+    docstore.cpp infer_value): the two ingest paths must store identical
+    values or the backends aren't interchangeable.  Deliberately stricter
+    than Python's int()/float(): no '_' separators, no inf/nan spellings,
+    no hex; ints beyond int64 degrade to float like strtoll/ERANGE."""
     if value == "":
         return None
+    v = value.strip()
+    if _INT_RE.fullmatch(v):
+        iv = int(v)
+        if -(2 ** 63) <= iv < 2 ** 63:
+            return iv
+        return float(v)
+    if any(c in "_xX" for c in v):
+        return value
     try:
-        return int(value)
-    except ValueError:
-        pass
-    try:
-        return float(value)
+        f = float(v)
     except ValueError:
         return value
+    if math.isnan(f) or math.isinf(f):
+        return value
+    return f
 
 
 def _decode_lines(byte_chunks):
@@ -123,6 +141,9 @@ class DatasetService:
         )
 
         def ingest():
+            native = self._ingest_native(name, url, infer_types)
+            if native is not None:
+                return native
             n_rows = 0
             fields: list[str] = []
             with _open_url(url) as fh:
@@ -131,6 +152,8 @@ class DatasetService:
                 for row in reader:
                     if not fields:
                         fields = _clean_header(row)
+                        continue
+                    if not row:
                         continue
                     doc = {
                         fields[i]: (_infer(v) if infer_types else v)
@@ -153,6 +176,62 @@ class DatasetService:
             on_success=lambda r: r,
         )
         return meta
+
+    # Above this size the whole-buffer native path would hold ~2.5x the
+    # file resident (download + JSONL + store copy); stream instead.
+    NATIVE_MAX_BYTES = 256 * 1024 * 1024
+
+    def _ingest_native(self, name: str, url: str, infer_types: bool):
+        """Fully-native ingest: C++ CSV parse → C++ store insert, no
+        per-row Python objects (vs. the reference's per-row insert_one,
+        database_api_image/database.py:139-151).  Returns None (before
+        touching the store) when the native engine is unavailable, the
+        file is too big to buffer, or the parse fails — the streaming
+        Python path then takes over."""
+        try:
+            from learningorchestra_tpu import native
+
+            if not native.native_available():
+                return None
+            if url.startswith(("http://", "https://")):
+                import requests
+
+                # Stream with a byte cap — Content-Length may be absent
+                # (chunked responses), so the guard must be on actual
+                # bytes received, not on a header.
+                resp = requests.get(url, stream=True, timeout=60)
+                resp.raise_for_status()
+                chunks, total = [], 0
+                for chunk in resp.iter_content(chunk_size=1 << 20):
+                    total += len(chunk)
+                    if total > self.NATIVE_MAX_BYTES:
+                        resp.close()
+                        return None  # too big to buffer: stream instead
+                    chunks.append(chunk)
+                data = b"".join(chunks)
+            else:
+                path = url[len("file://"):] if url.startswith("file://") \
+                    else url
+                if os.path.getsize(path) > self.NATIVE_MAX_BYTES:
+                    return None
+                with open(path, "rb") as fh:
+                    data = fh.read()
+            # Normalize to valid UTF-8 the way the streaming path's
+            # errors="replace" decoder does — the store holds JSON text.
+            try:
+                data.decode("utf-8")
+            except UnicodeDecodeError:
+                data = data.decode("utf-8", errors="replace").encode("utf-8")
+            fields, jsonl = native.csv_parse(data, infer_types)
+        except Exception:
+            return None  # nothing inserted yet — safe to re-ingest
+        if hasattr(self.ctx.documents, "insert_jsonl"):
+            n = self.ctx.documents.insert_jsonl(name, jsonl)
+        else:
+            n = self.ctx.documents.insert_many(
+                name, (json.loads(ln) for ln in jsonl.splitlines() if ln)
+            )
+        return {"fields": fields, "rows": n}
 
     # -- generic binary -------------------------------------------------------
 

@@ -58,9 +58,11 @@ def _validate_single_function(code: str) -> str:
         tree = ast.parse(code)
     except SyntaxError as exc:
         raise ValidationError(f"function does not parse: {exc}") from exc
-    defs = [n for n in tree.body if isinstance(
-        n, (ast.FunctionDef, ast.AsyncFunctionDef)
-    )]
+    if any(isinstance(n, ast.AsyncFunctionDef) for n in tree.body):
+        # run() calls the function synchronously per rank; an async def
+        # would return an un-awaitable coroutine instead of results.
+        raise ValidationError("builder function must not be async")
+    defs = [n for n in tree.body if isinstance(n, ast.FunctionDef)]
 
     def allowed(node: ast.stmt) -> bool:
         if isinstance(node, (ast.Import, ast.ImportFrom)):
@@ -105,6 +107,18 @@ class DistributedExecutorService:
         (server.py:70-76,104)."""
         self.ctx.require_new_name(name)
         parent_meta = self.ctx.require_finished_parent(parent_name)
+        # Resolve + validate the monitoring nickname BEFORE creating the
+        # artifact: a bad monitoringPath must 406, not burn the name on a
+        # metadata doc whose job never got submitted.
+        session_name = None
+        if monitoring_path is not None and self.monitoring is not None:
+            session_name = str(monitoring_path).strip("/").replace(
+                "/", "_"
+            ) or name
+            if not self.monitoring.valid_nickname(session_name):
+                raise ValidationError(
+                    f"invalid monitoringPath {monitoring_path!r}"
+                )
         model_meta = self.ctx.artifacts.metadata.find_model_ancestor(
             parent_name
         )
@@ -119,12 +133,8 @@ class DistributedExecutorService:
         )
 
         extra_results: dict = {}
-        session_name = None
         session_logdir = None
-        if monitoring_path is not None and self.monitoring is not None:
-            session_name = str(monitoring_path).strip("/").replace(
-                "/", "_"
-            ) or name
+        if session_name is not None:
             session_info = self.monitoring.start(session_name)
             # Capture the logdir now: a mid-train DELETE of the session
             # must not fail an otherwise-successful training job.

@@ -31,6 +31,8 @@ import time
 from typing import Any
 
 _PORT_RE = re.compile(r"http://[^\s:]+:(\d+)")
+# First char alphanumeric/underscore: forbids '.', '..' and path escapes.
+_NICK_RE = re.compile(r"[A-Za-z0-9_][A-Za-z0-9_.\-]*")
 
 
 class MonitoringError(Exception):
@@ -50,6 +52,7 @@ class MonitoringSession:
         self.url: str | None = None
         self.port: int | None = None
         self.process: subprocess.Popen | None = None
+        self.stopped = False  # set by stop(); guards the spawn race
         self.created = time.time()
 
     def to_dict(self) -> dict:
@@ -74,6 +77,10 @@ class MonitoringService:
 
     # -- session lifecycle ---------------------------------------------------
 
+    @staticmethod
+    def valid_nickname(nickname: str) -> bool:
+        return bool(_NICK_RE.fullmatch(nickname or ""))
+
     def start(self, nickname: str, *, spawn_tensorboard: bool = True) -> dict:
         """Create (or return) the session for ``nickname``.
 
@@ -81,6 +88,10 @@ class MonitoringService:
         session instead of racing two TensorBoard processes onto one
         logdir (the reference's ProcessController collision path raised —
         utils.py:366)."""
+        if not _NICK_RE.fullmatch(nickname or ""):
+            # Nicknames become directory names under root; '..' or
+            # separators would escape the monitoring tree.
+            raise MonitoringError(f"invalid monitoring nickname {nickname!r}")
         with self._lock:
             existing = self._sessions.get(nickname)
             if existing is not None:
@@ -101,20 +112,25 @@ class MonitoringService:
         try:
             # DEVNULL: nothing reads the child's output, and a PIPE nobody
             # drains would block TensorBoard once the OS buffer fills.
+            cmd = [binary, "--logdir", session.logdir, "--port", str(port)]
+            # Bind only where the advertised URL points; --bind_all would
+            # expose an unauthenticated TB on every interface.
+            cmd += ["--host", self.host] if self.host != "0.0.0.0" \
+                else ["--bind_all"]
             proc = subprocess.Popen(
-                [
-                    binary,
-                    "--logdir", session.logdir,
-                    "--port", str(port),
-                    "--bind_all",
-                ],
+                cmd,
                 stdout=subprocess.DEVNULL,
                 stderr=subprocess.STDOUT,
             )
         except OSError:
             return
-        session.process = proc
-        session.port = port
+        with self._lock:
+            if session.stopped:
+                # stop() won the race before the process existed — reap it.
+                proc.terminate()
+                return
+            session.process = proc
+            session.port = port
 
         # Probe for readiness off-thread: the caller is an HTTP POST
         # handler and must not stall on TensorBoard startup; ``url`` stays
@@ -148,6 +164,8 @@ class MonitoringService:
     def stop(self, nickname: str) -> bool:
         with self._lock:
             session = self._sessions.pop(nickname, None)
+            if session is not None:
+                session.stopped = True
         if session is None:
             return False
         if session.process is not None and session.process.poll() is None:

@@ -19,8 +19,32 @@ from learningorchestra_tpu.store.artifacts import (
 )
 from learningorchestra_tpu.store.volumes import VolumeStorage
 
+
+def open_document_store(root, durable_writes: bool = False,
+                        backend: str = "auto"):
+    """Open the system-of-record at ``root``.
+
+    ``backend``: ``"native"`` (C++ liblodstore), ``"python"`` (embedded
+    WAL store), or ``"auto"`` — native when the library builds, Python
+    otherwise.  Both backends share one WAL format, so a directory
+    written by either opens under the other.
+    """
+    if backend not in ("auto", "native", "python"):
+        raise ValueError(f"unknown store backend: {backend!r}")
+    if backend in ("auto", "native"):
+        try:
+            from learningorchestra_tpu.native import NativeDocumentStore
+
+            return NativeDocumentStore(root, durable_writes=durable_writes)
+        except Exception:
+            if backend == "native":
+                raise
+    return DocumentStore(root, durable_writes=durable_writes)
+
+
 __all__ = [
     "DocumentStore",
+    "open_document_store",
     "ArtifactStore",
     "Metadata",
     "LineageError",

@@ -1,0 +1,867 @@
+// lodstore — embedded WAL-backed document store + CSV ingest engine.
+//
+// Native system-of-record for learningorchestra_tpu, playing the role
+// MongoDB (a C++ server) plays in the reference deployment
+// (reference: docker-compose.yml:42-90): every artifact is a collection
+// of JSON documents whose _id=0 document is the metadata record.
+//
+// On-disk format is IDENTICAL to the pure-Python DocumentStore
+// (learningorchestra_tpu/store/document_store.py): one JSONL write-ahead
+// log per collection, each line one of
+//   {"op":"i","d":{...,"_id":N}}     insert
+//   {"op":"u","id":N,"d":{...}}      top-level field merge
+//   {"op":"d","id":N}                delete
+//   {"op":"n","v":N}                 next-id watermark (compaction)
+// so the two backends are interchangeable on the same directory.
+//
+// Exposed as a C ABI consumed via ctypes (no pybind11 in this image).
+// All returned buffers are malloc'd and must be released with lods_free.
+
+#include <algorithm>
+#include <cctype>
+#include <cerrno>
+#include <cinttypes>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <dirent.h>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_error;
+
+void set_error(const std::string &msg) { g_error = msg; }
+
+// ---------------------------------------------------------------------------
+// Minimal JSON span scanner: enough to find top-level keys/values of an
+// object, merge two objects at the top level, and validate value spans.
+// Documents are stored as raw JSON text; we never build a DOM.
+// ---------------------------------------------------------------------------
+
+size_t skip_ws(const char *s, size_t i, size_t n) {
+  while (i < n && (s[i] == ' ' || s[i] == '\t' || s[i] == '\n' || s[i] == '\r'))
+    i++;
+  return i;
+}
+
+// Returns index one past the end of the JSON value starting at i, or
+// std::string::npos on malformed input.
+size_t skip_value(const char *s, size_t i, size_t n) {
+  i = skip_ws(s, i, n);
+  if (i >= n) return std::string::npos;
+  char c = s[i];
+  if (c == '"') {
+    i++;
+    while (i < n) {
+      if (s[i] == '\\') {
+        i += 2;
+      } else if (s[i] == '"') {
+        return i + 1;
+      } else {
+        i++;
+      }
+    }
+    return std::string::npos;
+  }
+  if (c == '{' || c == '[') {
+    char open = c, close = (c == '{') ? '}' : ']';
+    int depth = 0;
+    while (i < n) {
+      if (s[i] == '"') {
+        size_t end = skip_value(s, i, n);
+        if (end == std::string::npos) return std::string::npos;
+        i = end;
+        continue;
+      }
+      if (s[i] == open) depth++;
+      if (s[i] == close) {
+        depth--;
+        if (depth == 0) return i + 1;
+      }
+      i++;
+    }
+    return std::string::npos;
+  }
+  // number / true / false / null
+  size_t start = i;
+  while (i < n && s[i] != ',' && s[i] != '}' && s[i] != ']' && s[i] != ' ' &&
+         s[i] != '\t' && s[i] != '\n' && s[i] != '\r')
+    i++;
+  return (i > start) ? i : std::string::npos;
+}
+
+struct KV {
+  std::string key;      // decoded enough for comparison (raw inner text)
+  std::string raw_val;  // raw JSON value text
+};
+
+// Parse the top-level pairs of a JSON object into (key, raw value) pairs.
+// Keys are returned as their raw string contents (escapes left intact —
+// both sides of any comparison come through this same function).
+bool parse_object(const std::string &text, std::vector<KV> &out) {
+  const char *s = text.data();
+  size_t n = text.size();
+  size_t i = skip_ws(s, 0, n);
+  if (i >= n || s[i] != '{') return false;
+  i = skip_ws(s, i + 1, n);
+  if (i < n && s[i] == '}') return true;  // empty object
+  while (i < n) {
+    if (s[i] != '"') return false;
+    size_t key_end = skip_value(s, i, n);
+    if (key_end == std::string::npos) return false;
+    std::string key = text.substr(i + 1, key_end - i - 2);
+    i = skip_ws(s, key_end, n);
+    if (i >= n || s[i] != ':') return false;
+    i = skip_ws(s, i + 1, n);
+    size_t val_end = skip_value(s, i, n);
+    if (val_end == std::string::npos) return false;
+    out.push_back({std::move(key), text.substr(i, val_end - i)});
+    i = skip_ws(s, val_end, n);
+    if (i < n && s[i] == ',') {
+      i = skip_ws(s, i + 1, n);
+      continue;
+    }
+    if (i < n && s[i] == '}') return true;
+    return false;
+  }
+  return false;
+}
+
+std::string build_object(const std::vector<KV> &pairs) {
+  std::string out = "{";
+  for (size_t i = 0; i < pairs.size(); i++) {
+    if (i) out += ",";
+    out += '"';
+    out += pairs[i].key;
+    out += "\":";
+    out += pairs[i].raw_val;
+  }
+  out += "}";
+  return out;
+}
+
+// doc.update(fields) at the top level, Python-dict style; "_id" in fields
+// is ignored (the store owns identity).
+std::string merge_objects(const std::string &base, const std::string &fields) {
+  std::vector<KV> b, f;
+  if (!parse_object(base, b)) return base;
+  if (!parse_object(fields, f)) return base;
+  for (auto &kv : f) {
+    if (kv.key == "_id") continue;
+    bool replaced = false;
+    for (auto &existing : b) {
+      if (existing.key == kv.key) {
+        existing.raw_val = kv.raw_val;
+        replaced = true;
+        break;
+      }
+    }
+    if (!replaced) b.push_back(kv);
+  }
+  return build_object(b);
+}
+
+// Find a top-level field's raw value; returns false if absent.
+bool get_field(const std::string &doc, const char *field, std::string &out) {
+  std::vector<KV> pairs;
+  if (!parse_object(doc, pairs)) return false;
+  for (auto &kv : pairs) {
+    if (kv.key == field) {
+      out = kv.raw_val;
+      return true;
+    }
+  }
+  return false;
+}
+
+// Inject "_id":N into a doc that does not carry one (replace if present).
+std::string with_id(const std::string &doc, long long id) {
+  std::vector<KV> pairs;
+  char idbuf[32];
+  snprintf(idbuf, sizeof idbuf, "%lld", id);
+  if (!parse_object(doc, pairs)) return doc;
+  for (auto &kv : pairs) {
+    if (kv.key == "_id") {
+      kv.raw_val = idbuf;
+      return build_object(pairs);
+    }
+  }
+  pairs.push_back({"_id", idbuf});
+  return build_object(pairs);
+}
+
+// ---------------------------------------------------------------------------
+// Collection + store
+// ---------------------------------------------------------------------------
+
+bool valid_name(const std::string &name) {
+  if (name.empty()) return false;
+  auto word = [](char c) {
+    return std::isalnum(static_cast<unsigned char>(c)) || c == '_';
+  };
+  if (!word(name[0])) return false;
+  for (char c : name)
+    if (!word(c) && c != '.' && c != '-') return false;
+  return true;
+}
+
+struct Collection {
+  std::string path;
+  bool durable;
+  std::mutex mu;
+  std::map<long long, std::string> docs;  // id -> raw JSON doc (with _id)
+  long long next_id = 0;
+  FILE *fh = nullptr;
+
+  ~Collection() {
+    if (fh) fclose(fh);
+  }
+
+  bool replay() {
+    FILE *in = fopen(path.c_str(), "r");
+    if (!in) return true;  // nothing to replay
+    long long max_seen = -1;
+    std::string line;
+    char buf[1 << 16];
+    std::string pending;
+    while (fgets(buf, sizeof buf, in)) {
+      pending += buf;
+      if (pending.empty() || pending.back() != '\n') continue;  // long line
+      line.swap(pending);
+      pending.clear();
+      while (!line.empty() && (line.back() == '\n' || line.back() == '\r'))
+        line.pop_back();
+      if (line.empty()) continue;
+      std::vector<KV> op;
+      if (!parse_object(line, op)) continue;
+      std::string kind, d, idv, v;
+      for (auto &kv : op) {
+        if (kv.key == "op") kind = kv.raw_val;
+        else if (kv.key == "d") d = kv.raw_val;
+        else if (kv.key == "id") idv = kv.raw_val;
+        else if (kv.key == "v") v = kv.raw_val;
+      }
+      if (kind == "\"i\"") {
+        std::string idraw;
+        if (!get_field(d, "_id", idraw)) continue;
+        long long id = strtoll(idraw.c_str(), nullptr, 10);
+        docs[id] = d;
+        if (id > max_seen) max_seen = id;
+      } else if (kind == "\"u\"") {
+        long long id = strtoll(idv.c_str(), nullptr, 10);
+        auto it = docs.find(id);
+        if (it != docs.end()) it->second = merge_objects(it->second, d);
+      } else if (kind == "\"d\"") {
+        docs.erase(strtoll(idv.c_str(), nullptr, 10));
+      } else if (kind == "\"n\"") {
+        long long nv = strtoll(v.c_str(), nullptr, 10);
+        if (nv - 1 > max_seen) max_seen = nv - 1;
+      }
+    }
+    fclose(in);
+    next_id = max_seen + 1;
+    return true;
+  }
+
+  bool open_log() {
+    fh = fopen(path.c_str(), "a");
+    if (!fh) {
+      set_error("cannot open WAL " + path + ": " + strerror(errno));
+      return false;
+    }
+    return true;
+  }
+
+  void append(const std::string &line) {
+    if (!fh) return;  // collection dropped while an op held its pointer
+    fwrite(line.data(), 1, line.size(), fh);
+    fputc('\n', fh);
+    fflush(fh);
+    if (durable) fsync(fileno(fh));
+  }
+};
+
+struct Store {
+  std::string root;
+  bool durable;
+  std::mutex mu;
+  // shared_ptr: lods_drop may race an op that already fetched the
+  // collection — it must stay alive until the last holder releases it.
+  std::unordered_map<std::string, std::shared_ptr<Collection>> colls;
+
+  std::shared_ptr<Collection> get(const std::string &name, bool create) {
+    std::lock_guard<std::mutex> lock(mu);
+    auto it = colls.find(name);
+    if (it != colls.end()) return it->second;
+    if (!create) {
+      set_error("no such collection: " + name);
+      return nullptr;
+    }
+    if (!valid_name(name)) {
+      set_error("invalid collection name: " + name);
+      return nullptr;
+    }
+    auto coll = std::make_shared<Collection>();
+    coll->path = root + "/" + name + ".wal";
+    coll->durable = durable;
+    coll->replay();
+    if (!coll->open_log()) return nullptr;
+    colls.emplace(name, coll);
+    return coll;
+  }
+};
+
+std::mutex g_handles_mu;
+std::vector<std::unique_ptr<Store>> g_handles;
+
+Store *store_for(int64_t h) {
+  std::lock_guard<std::mutex> lock(g_handles_mu);
+  if (h < 0 || h >= (int64_t)g_handles.size() || !g_handles[h]) {
+    set_error("invalid store handle");
+    return nullptr;
+  }
+  return g_handles[h].get();
+}
+
+char *dup_buffer(const std::string &s, int64_t *out_len) {
+  char *buf = (char *)malloc(s.size() + 1);
+  memcpy(buf, s.data(), s.size());
+  buf[s.size()] = 0;
+  if (out_len) *out_len = (int64_t)s.size();
+  return buf;
+}
+
+// ---------------------------------------------------------------------------
+// CSV parsing (RFC 4180: quoted fields, "" escapes, embedded newlines)
+// ---------------------------------------------------------------------------
+
+void json_escape(const std::string &in, std::string &out) {
+  out += '"';
+  for (unsigned char c : in) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char esc[8];
+          snprintf(esc, sizeof esc, "\\u%04x", c);
+          out += esc;
+        } else {
+          out += (char)c;
+        }
+    }
+  }
+  out += '"';
+}
+
+// Shortest float formatting that round-trips (json.dumps parity-ish).
+void format_double(double v, std::string &out) {
+  char buf[40];
+  for (int prec = 15; prec <= 17; prec++) {
+    snprintf(buf, sizeof buf, "%.*g", prec, v);
+    if (strtod(buf, nullptr) == v) break;
+  }
+  out += buf;
+}
+
+// Append the inferred-JSON form of a CSV cell.
+void infer_value(const std::string &cell, std::string &out) {
+  if (cell.empty()) {
+    out += "null";
+    return;
+  }
+  const char *s = cell.c_str();
+  char *end = nullptr;
+  errno = 0;
+  long long iv = strtoll(s, &end, 10);
+  if (errno == 0 && end != s) {
+    const char *p = end;
+    while (*p == ' ' || *p == '\t') p++;
+    if (*p == 0) {  // fully consumed (allowing trailing whitespace)
+      char buf[32];
+      snprintf(buf, sizeof buf, "%lld", iv);
+      out += buf;
+      return;
+    }
+  }
+  errno = 0;
+  end = nullptr;
+  double dv = strtod(s, &end);
+  bool consumed = end && (end != s);
+  if (consumed) {
+    while (*end == ' ' || *end == '\t') end++;
+    consumed = (*end == 0);
+  }
+  // Reject inf/nan spellings (not valid JSON) and partial parses.
+  if (consumed && errno == 0 && dv == dv && dv <= 1.7976931348623157e308 &&
+      dv >= -1.7976931348623157e308) {
+    // Only treat as a number if it LOOKS numeric (strtod accepts "0x...",
+    // "inf", "nan" — Python float() accepts inf/nan but those aren't JSON).
+    char c0 = s[0] == '+' || s[0] == '-' ? s[1] : s[0];
+    if ((c0 >= '0' && c0 <= '9') || c0 == '.') {
+      bool hexish = c0 == '0' && (s[1] == 'x' || s[1] == 'X');
+      if (!hexish) {
+        format_double(dv, out);
+        return;
+      }
+    }
+  }
+  json_escape(cell, out);
+}
+
+void clean_header(std::vector<std::string> &header) {
+  for (size_t i = 0; i < header.size(); i++) {
+    std::string &h = header[i];
+    // strip
+    size_t a = 0, b = h.size();
+    while (a < b && std::isspace((unsigned char)h[a])) a++;
+    while (b > a && std::isspace((unsigned char)h[b - 1])) b--;
+    std::string cleaned;
+    bool in_run = false;
+    for (size_t j = a; j < b; j++) {
+      unsigned char c = h[j];
+      if (std::isalnum(c) || c == '_') {
+        cleaned += (char)c;
+        in_run = false;
+      } else if (!in_run) {
+        cleaned += '_';
+        in_run = true;
+      }
+    }
+    // strip leading/trailing underscores
+    size_t s0 = cleaned.find_first_not_of('_');
+    size_t s1 = cleaned.find_last_not_of('_');
+    cleaned = (s0 == std::string::npos)
+                  ? ""
+                  : cleaned.substr(s0, s1 - s0 + 1);
+    if (cleaned.empty()) {
+      char buf[24];
+      snprintf(buf, sizeof buf, "col%zu", i);
+      cleaned = buf;
+    }
+    h = cleaned;
+  }
+}
+
+// Parse one CSV record starting at *pos; returns false at EOF.
+bool next_record(const char *s, size_t n, size_t *pos,
+                 std::vector<std::string> &fields) {
+  fields.clear();
+  size_t i = *pos;
+  if (i >= n) return false;
+  std::string cur;
+  bool in_quotes = false, any = false;
+  while (i < n) {
+    char c = s[i];
+    if (in_quotes) {
+      if (c == '"') {
+        if (i + 1 < n && s[i + 1] == '"') {
+          cur += '"';
+          i += 2;
+        } else {
+          in_quotes = false;
+          i++;
+        }
+      } else {
+        cur += c;
+        i++;
+      }
+      continue;
+    }
+    if (c == '"') {
+      in_quotes = true;
+      any = true;
+      i++;
+    } else if (c == ',') {
+      fields.push_back(cur);
+      cur.clear();
+      any = true;
+      i++;
+    } else if (c == '\n' || c == '\r') {
+      if (c == '\r' && i + 1 < n && s[i + 1] == '\n') i++;
+      i++;
+      break;
+    } else {
+      cur += c;
+      any = true;
+      i++;
+    }
+  }
+  *pos = i;
+  if (!any && cur.empty() && fields.empty()) {
+    // blank line: report as empty record (caller skips)
+    return true;
+  }
+  fields.push_back(cur);
+  return true;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+const char *lods_last_error(void) { return g_error.c_str(); }
+
+void lods_free(char *p) { free(p); }
+
+int64_t lods_open(const char *root, int durable) {
+  struct stat st;
+  if (stat(root, &st) != 0) {
+    if (mkdir(root, 0777) != 0 && errno != EEXIST) {
+      set_error(std::string("cannot create root: ") + strerror(errno));
+      return -1;
+    }
+  }
+  auto store = std::make_unique<Store>();
+  store->root = root;
+  store->durable = durable != 0;
+  // Open existing collections eagerly (mirrors DocumentStore.__init__).
+  DIR *dir = opendir(root);
+  if (dir) {
+    struct dirent *ent;
+    std::vector<std::string> names;
+    while ((ent = readdir(dir)) != nullptr) {
+      std::string fn = ent->d_name;
+      if (fn.size() > 4 && fn.substr(fn.size() - 4) == ".wal")
+        names.push_back(fn.substr(0, fn.size() - 4));
+    }
+    closedir(dir);
+    for (auto &nm : names) store->get(nm, true);
+  }
+  std::lock_guard<std::mutex> lock(g_handles_mu);
+  g_handles.push_back(std::move(store));
+  return (int64_t)g_handles.size() - 1;
+}
+
+int lods_close(int64_t h) {
+  std::lock_guard<std::mutex> lock(g_handles_mu);
+  if (h < 0 || h >= (int64_t)g_handles.size() || !g_handles[h]) return -1;
+  g_handles[h].reset();
+  return 0;
+}
+
+int lods_has_collection(int64_t h, const char *name) {
+  Store *st = store_for(h);
+  if (!st) return -1;
+  std::lock_guard<std::mutex> lock(st->mu);
+  return st->colls.count(name) ? 1 : 0;
+}
+
+char *lods_list_collections(int64_t h, int64_t *out_len) {
+  Store *st = store_for(h);
+  if (!st) return nullptr;
+  std::vector<std::string> names;
+  {
+    std::lock_guard<std::mutex> lock(st->mu);
+    for (auto &kv : st->colls) names.push_back(kv.first);
+  }
+  std::sort(names.begin(), names.end());
+  std::string out;
+  for (auto &nm : names) {
+    out += nm;
+    out += '\n';
+  }
+  return dup_buffer(out, out_len);
+}
+
+// Insert JSONL docs (no _id fields); returns count, sets *first_id.
+int64_t lods_insert_many(int64_t h, const char *name, const char *jsonl,
+                         int64_t len, long long *first_id) {
+  Store *st = store_for(h);
+  if (!st) return -1;
+  std::shared_ptr<Collection> coll = st->get(name, true);
+  if (!coll) return -1;
+  std::lock_guard<std::mutex> lock(coll->mu);
+  std::string batch;
+  batch.reserve((size_t)len + 64);
+  int64_t count = 0;
+  size_t i = 0, n = (size_t)len;
+  if (first_id) *first_id = coll->next_id;
+  while (i < n) {
+    size_t j = i;
+    while (j < n && jsonl[j] != '\n') j++;
+    if (j > i) {
+      std::string doc(jsonl + i, j - i);
+      long long id = coll->next_id++;
+      doc = with_id(doc, id);
+      coll->docs[id] = doc;
+      batch += "{\"op\":\"i\",\"d\":";
+      batch += doc;
+      batch += "}\n";
+      count++;
+    }
+    i = j + 1;
+  }
+  if (!batch.empty() && coll->fh) {
+    fwrite(batch.data(), 1, batch.size(), coll->fh);
+    fflush(coll->fh);
+    if (coll->durable) fsync(fileno(coll->fh));
+  }
+  return count;
+}
+
+// Insert a single doc at an explicit id.  unique=1 -> fail if id exists
+// (returns -2, the DuplicateKey signal).
+int lods_insert_at(int64_t h, const char *name, const char *json,
+                   long long id, int unique) {
+  Store *st = store_for(h);
+  if (!st) return -1;
+  std::shared_ptr<Collection> coll = st->get(name, true);
+  if (!coll) return -1;
+  std::lock_guard<std::mutex> lock(coll->mu);
+  if (unique && coll->docs.count(id)) {
+    set_error("duplicate _id");
+    return -2;
+  }
+  std::string doc = with_id(json, id);
+  coll->docs[id] = doc;
+  if (id + 1 > coll->next_id) coll->next_id = id + 1;
+  coll->append("{\"op\":\"i\",\"d\":" + doc + "}");
+  return 0;
+}
+
+int lods_update(int64_t h, const char *name, long long id,
+                const char *fields_json) {
+  Store *st = store_for(h);
+  if (!st) return -1;
+  std::shared_ptr<Collection> coll = st->get(name, false);
+  if (!coll) return -1;
+  std::lock_guard<std::mutex> lock(coll->mu);
+  auto it = coll->docs.find(id);
+  if (it == coll->docs.end()) return 0;
+  it->second = merge_objects(it->second, fields_json);
+  char idbuf[32];
+  snprintf(idbuf, sizeof idbuf, "%lld", id);
+  coll->append(std::string("{\"op\":\"u\",\"id\":") + idbuf + ",\"d\":" +
+               fields_json + "}");
+  return 1;
+}
+
+int lods_delete(int64_t h, const char *name, long long id) {
+  Store *st = store_for(h);
+  if (!st) return -1;
+  std::shared_ptr<Collection> coll = st->get(name, false);
+  if (!coll) return -1;
+  std::lock_guard<std::mutex> lock(coll->mu);
+  if (!coll->docs.erase(id)) return 0;
+  char idbuf[32];
+  snprintf(idbuf, sizeof idbuf, "%lld", id);
+  coll->append(std::string("{\"op\":\"d\",\"id\":") + idbuf + "}");
+  return 1;
+}
+
+char *lods_find_one(int64_t h, const char *name, long long id,
+                    int64_t *out_len) {
+  Store *st = store_for(h);
+  if (!st) return nullptr;
+  std::shared_ptr<Collection> coll = st->get(name, false);
+  if (!coll) return nullptr;
+  std::lock_guard<std::mutex> lock(coll->mu);
+  auto it = coll->docs.find(id);
+  if (it == coll->docs.end()) {
+    if (out_len) *out_len = 0;
+    return nullptr;
+  }
+  return dup_buffer(it->second, out_len);
+}
+
+// All docs in _id order as JSONL, with skip/limit (-1 = no limit).
+char *lods_scan(int64_t h, const char *name, int64_t skip, int64_t limit,
+                int64_t *out_len) {
+  Store *st = store_for(h);
+  if (!st) return nullptr;
+  std::shared_ptr<Collection> coll = st->get(name, false);
+  if (!coll) return nullptr;
+  std::lock_guard<std::mutex> lock(coll->mu);
+  std::string out;
+  int64_t seen = 0, emitted = 0;
+  for (auto &kv : coll->docs) {
+    if (seen++ < skip) continue;
+    if (limit >= 0 && emitted >= limit) break;
+    out += kv.second;
+    out += '\n';
+    emitted++;
+  }
+  return dup_buffer(out, out_len);
+}
+
+int64_t lods_count(int64_t h, const char *name) {
+  Store *st = store_for(h);
+  if (!st) return -1;
+  std::shared_ptr<Collection> coll = st->get(name, false);
+  if (!coll) return -1;
+  std::lock_guard<std::mutex> lock(coll->mu);
+  return (int64_t)coll->docs.size();
+}
+
+long long lods_next_id(int64_t h, const char *name) {
+  Store *st = store_for(h);
+  if (!st) return -1;
+  std::shared_ptr<Collection> coll = st->get(name, false);
+  if (!coll) return -1;
+  std::lock_guard<std::mutex> lock(coll->mu);
+  return coll->next_id;
+}
+
+// Value-count aggregation over a top-level field (histogram service's
+// $group/$sum).  Output: JSONL lines {"k":<raw value>,"n":<count>}.
+// Skips _id=0 (metadata) and docs with docType=="execution", matching
+// DocumentStore.aggregate_counts.
+char *lods_value_counts(int64_t h, const char *name, const char *field,
+                        int64_t *out_len) {
+  Store *st = store_for(h);
+  if (!st) return nullptr;
+  std::shared_ptr<Collection> coll = st->get(name, false);
+  if (!coll) return nullptr;
+  std::lock_guard<std::mutex> lock(coll->mu);
+  std::map<std::string, int64_t> counts;
+  std::vector<std::string> order;  // first-seen order for stable output
+  for (auto &kv : coll->docs) {
+    if (kv.first == 0) continue;
+    std::string dt;
+    if (get_field(kv.second, "docType", dt) && dt == "\"execution\"")
+      continue;
+    std::string val;
+    if (!get_field(kv.second, field, val)) val = "null";
+    auto it = counts.find(val);
+    if (it == counts.end()) {
+      counts.emplace(val, 1);
+      order.push_back(val);
+    } else {
+      it->second++;
+    }
+  }
+  std::string out;
+  for (auto &key : order) {
+    out += "{\"k\":";
+    out += key;
+    out += ",\"n\":";
+    char buf[32];
+    snprintf(buf, sizeof buf, "%" PRId64, counts[key]);
+    out += buf;
+    out += "}\n";
+  }
+  return dup_buffer(out, out_len);
+}
+
+int lods_drop(int64_t h, const char *name) {
+  Store *st = store_for(h);
+  if (!st) return -1;
+  std::shared_ptr<Collection> coll;
+  {
+    std::lock_guard<std::mutex> lock(st->mu);
+    auto it = st->colls.find(name);
+    if (it == st->colls.end()) return 0;
+    coll = it->second;
+    st->colls.erase(it);
+  }
+  // In-flight ops still holding the shared_ptr serialize on mu; after
+  // this, their writes hit the fh==nullptr guard and become no-ops.
+  std::lock_guard<std::mutex> lock(coll->mu);
+  if (coll->fh) {
+    fclose(coll->fh);
+    coll->fh = nullptr;
+  }
+  unlink(coll->path.c_str());
+  return 1;
+}
+
+int lods_compact(int64_t h, const char *name) {
+  Store *st = store_for(h);
+  if (!st) return -1;
+  std::shared_ptr<Collection> coll = st->get(name, false);
+  if (!coll) return -1;
+  std::lock_guard<std::mutex> lock(coll->mu);
+  if (!coll->fh) {
+    set_error("collection dropped");
+    return -1;
+  }
+  std::string tmp_path = coll->path + ".tmp";
+  FILE *tmp = fopen(tmp_path.c_str(), "w");
+  if (!tmp) {
+    set_error(std::string("cannot open tmp: ") + strerror(errno));
+    return -1;
+  }
+  char head[64];
+  snprintf(head, sizeof head, "{\"op\": \"n\", \"v\": %lld}\n", coll->next_id);
+  fwrite(head, 1, strlen(head), tmp);
+  for (auto &kv : coll->docs) {
+    std::string line = "{\"op\":\"i\",\"d\":" + kv.second + "}\n";
+    fwrite(line.data(), 1, line.size(), tmp);
+  }
+  fclose(tmp);
+  fclose(coll->fh);
+  coll->fh = nullptr;
+  if (rename(tmp_path.c_str(), coll->path.c_str()) != 0) {
+    set_error(std::string("rename failed: ") + strerror(errno));
+    coll->open_log();
+    return -1;
+  }
+  return coll->open_log() ? 0 : -1;
+}
+
+// ---------------------------------------------------------------------------
+// CSV → JSONL docs.  Output: first line is the cleaned header as a JSON
+// array; each following line is a document object (no _id) ready for
+// lods_insert_many.  infer=1 applies int/float/null inference (the
+// dataset service's default); infer=0 keeps every value a string (the
+// reference's raw behavior, database_api_image/database.py:124-137).
+// ---------------------------------------------------------------------------
+
+char *lods_csv_parse(const char *buf, int64_t len, int infer,
+                     int64_t *out_len) {
+  std::vector<std::string> header, row;
+  size_t pos = 0;
+  size_t n = (size_t)len;
+  // Skip UTF-8 BOM.
+  if (n >= 3 && (unsigned char)buf[0] == 0xEF && (unsigned char)buf[1] == 0xBB &&
+      (unsigned char)buf[2] == 0xBF)
+    pos = 3;
+  if (!next_record(buf, n, &pos, header) || header.empty()) {
+    set_error("empty CSV input");
+    return nullptr;
+  }
+  clean_header(header);
+  std::string out;
+  out.reserve((size_t)len + (size_t)len / 2);
+  out += '[';
+  for (size_t i = 0; i < header.size(); i++) {
+    if (i) out += ',';
+    json_escape(header[i], out);
+  }
+  out += "]\n";
+  while (next_record(buf, n, &pos, row)) {
+    if (row.empty()) continue;  // blank line
+    out += '{';
+    size_t cols = row.size() < header.size() ? row.size() : header.size();
+    for (size_t i = 0; i < cols; i++) {
+      if (i) out += ',';
+      json_escape(header[i], out);
+      out += ':';
+      if (infer)
+        infer_value(row[i], out);
+      else
+        json_escape(row[i], out);
+    }
+    out += "}\n";
+  }
+  return dup_buffer(out, out_len);
+}
+
+}  // extern "C"

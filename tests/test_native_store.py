@@ -1,0 +1,250 @@
+"""Native (C++) document store: parity with the Python backend, shared
+WAL format, CSV ingest engine."""
+
+import json
+import threading
+
+import pytest
+
+from learningorchestra_tpu import native
+from learningorchestra_tpu.store.document_store import (
+    DocumentStore,
+    DuplicateKey,
+    NoSuchCollection,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="native library not built"
+)
+
+
+@pytest.fixture
+def store(tmp_path):
+    st = native.NativeDocumentStore(tmp_path / "store")
+    yield st
+    st.close()
+
+
+class TestNativeStoreBasics:
+    def test_insert_and_find_one(self, store):
+        _id = store.insert_one("c", {"a": 1, "b": "x"})
+        assert _id == 0
+        doc = store.find_one("c", 0)
+        assert doc == {"a": 1, "b": "x", "_id": 0}
+
+    def test_auto_increment_ids(self, store):
+        ids = [store.insert_one("c", {"i": i}) for i in range(5)]
+        assert ids == [0, 1, 2, 3, 4]
+
+    def test_insert_many_and_count(self, store):
+        n = store.insert_many("c", [{"i": i} for i in range(100)])
+        assert n == 100
+        assert store.count("c") == 100
+
+    def test_insert_unique_conflict(self, store):
+        store.insert_unique("c", {"meta": True}, 0)
+        with pytest.raises(DuplicateKey):
+            store.insert_unique("c", {"meta": 2}, 0)
+
+    def test_update_merges_top_level(self, store):
+        store.insert_one("c", {"a": 1, "nested": {"x": 1}})
+        assert store.update_one("c", 0, {"a": 2, "new": [1, 2]})
+        doc = store.find_one("c", 0)
+        assert doc["a"] == 2
+        assert doc["new"] == [1, 2]
+        assert doc["nested"] == {"x": 1}
+
+    def test_update_missing(self, store):
+        store.insert_one("c", {})
+        assert not store.update_one("c", 99, {"a": 1})
+
+    def test_delete(self, store):
+        store.insert_one("c", {"a": 1})
+        assert store.delete_one("c", 0)
+        assert store.find_one("c", 0) is None
+        assert not store.delete_one("c", 0)
+
+    def test_find_sorted_skip_limit(self, store):
+        store.insert_many("c", [{"i": i} for i in range(10)])
+        docs = store.find("c", skip=3, limit=2)
+        assert [d["_id"] for d in docs] == [3, 4]
+
+    def test_find_with_query_operators(self, store):
+        store.insert_many("c", [{"i": i} for i in range(10)])
+        docs = store.find("c", query={"i": {"$gte": 8}})
+        assert [d["i"] for d in docs] == [8, 9]
+        docs = store.find("c", query={"i": 4})
+        assert len(docs) == 1
+
+    def test_missing_collection_raises(self, store):
+        with pytest.raises(NoSuchCollection):
+            store.find("nope")
+        assert store.find_one("nope", 0) is None
+
+    def test_unicode_and_specials_roundtrip(self, store):
+        doc = {"s": 'quote " backslash \\ newline \n tab \t héllo ünïcode',
+               "f": 1.5, "n": None, "b": True, "neg": -7}
+        store.insert_one("c", doc)
+        got = store.find_one("c", 0)
+        for k, v in doc.items():
+            assert got[k] == v
+
+    def test_value_counts(self, store):
+        store.insert_unique("c", {"meta": True}, 0)  # excluded (_id=0)
+        store.insert_many("c", [{"color": "red"}, {"color": "red"},
+                                {"color": "blue"}, {"other": 1}])
+        store.insert_one("c", {"color": "x", "docType": "execution"})
+        counts = store.aggregate_counts("c", "color")
+        assert counts == {"red": 2, "blue": 1, None: 1}
+
+    def test_drop_and_list(self, store):
+        store.insert_one("a1", {})
+        store.insert_one("b1", {})
+        assert store.list_collections() == ["a1", "b1"]
+        assert store.drop("a1")
+        assert store.list_collections() == ["b1"]
+        assert not store.drop("a1")
+
+    def test_compact_preserves_state(self, tmp_path):
+        st = native.NativeDocumentStore(tmp_path / "s")
+        st.insert_many("c", [{"i": i} for i in range(10)])
+        for i in range(5):
+            st.delete_one("c", i)
+        st.update_one("c", 7, {"i": 70})
+        st.compact("c")
+        st.close()
+        st2 = native.NativeDocumentStore(tmp_path / "s")
+        docs = st2.find("c")
+        assert [d["_id"] for d in docs] == [5, 6, 7, 8, 9]
+        assert st2.find_one("c", 7)["i"] == 70
+        # next_id watermark survives compaction
+        assert st2.insert_one("c", {}) == 10
+        st2.close()
+
+
+class TestWALInterchange:
+    """Both backends share one on-disk format."""
+
+    def test_python_write_native_read(self, tmp_path):
+        py = DocumentStore(tmp_path / "s")
+        py.insert_unique("c", {"name": "ds", "finished": False}, 0)
+        py.insert_many("c", [{"i": i, "tag": "t"} for i in range(20)])
+        py.update_one("c", 0, {"finished": True})
+        py.delete_one("c", 3)
+        py.close()
+
+        nt = native.NativeDocumentStore(tmp_path / "s")
+        assert nt.count("c") == 20  # 21 inserted - 1 deleted
+        assert nt.find_one("c", 0)["finished"] is True
+        assert nt.find_one("c", 3) is None
+        assert nt.insert_one("c", {}) == 21
+        nt.close()
+
+    def test_native_write_python_read(self, tmp_path):
+        nt = native.NativeDocumentStore(tmp_path / "s")
+        nt.insert_unique("c", {"name": "ds", "finished": False}, 0)
+        nt.insert_many("c", [{"i": i, "x": i * 0.5} for i in range(20)])
+        nt.update_one("c", 0, {"finished": True, "rows": 20})
+        nt.delete_one("c", 5)
+        nt.close()
+
+        py = DocumentStore(tmp_path / "s")
+        assert py.count("c") == 20
+        meta = py.find_one("c", 0)
+        assert meta["finished"] is True and meta["rows"] == 20
+        assert py.find_one("c", 5) is None
+        assert py.find_one("c", 2)["x"] == 0.5  # _id=2 is row i=1
+        py.close()
+
+
+class TestNativeCSV:
+    def test_parse_with_inference(self):
+        data = b"Name,Age!,Score\nalice,30,1.5\nbob,,x\n"
+        fields, jsonl = native.csv_parse(data)
+        assert fields == ["Name", "Age", "Score"]
+        docs = [json.loads(ln) for ln in jsonl.splitlines()]
+        assert docs[0] == {"Name": "alice", "Age": 30, "Score": 1.5}
+        assert docs[1] == {"Name": "bob", "Age": None, "Score": "x"}
+
+    def test_parse_no_inference(self):
+        data = b"a,b\n1,2.5\n"
+        _, jsonl = native.csv_parse(data, infer_types=False)
+        assert json.loads(jsonl.splitlines()[0]) == {"a": "1", "b": "2.5"}
+
+    def test_quoted_fields_with_commas_newlines(self):
+        data = b'a,b\n"x,y","line1\nline2"\n"he said ""hi""",2\n'
+        _, jsonl = native.csv_parse(data)
+        docs = [json.loads(ln) for ln in jsonl.splitlines()]
+        assert docs[0] == {"a": "x,y", "b": "line1\nline2"}
+        assert docs[1] == {"a": 'he said "hi"', "b": 2}
+
+    def test_crlf_and_bom(self):
+        data = b"\xef\xbb\xbfa,b\r\n1,2\r\n3,4\r\n"
+        fields, jsonl = native.csv_parse(data)
+        assert fields == ["a", "b"]
+        docs = [json.loads(ln) for ln in jsonl.splitlines()]
+        assert docs == [{"a": 1, "b": 2}, {"a": 3, "b": 4}]
+
+    def test_header_cleaning_matches_python(self):
+        from learningorchestra_tpu.services.dataset import _clean_header
+
+        raw = ["First Name", "a.b(c)", "  ", "ok_1", "%%%"]
+        fields, _ = native.csv_parse(
+            (",".join(raw) + "\n" + ",".join("12345")).encode()
+        )
+        assert fields == _clean_header(list(raw))
+
+    def test_short_rows_and_floats_roundtrip(self):
+        data = b"a,b,c\n0.1,-3e7,\n7,,\n"
+        _, jsonl = native.csv_parse(data)
+        docs = [json.loads(ln) for ln in jsonl.splitlines()]
+        assert docs[0] == {"a": 0.1, "b": -3e7, "c": None}
+        assert docs[1] == {"a": 7, "b": None, "c": None}
+
+    def test_inference_parity_with_python(self):
+        """Both ingest paths must store identical values (backends are
+        interchangeable) — including the awkward cells."""
+        from learningorchestra_tpu.services.dataset import _infer
+
+        cells = ["7", "-3", "+5", "007", " 12 ", "0.5", ".5", "5.", "1e5",
+                 "-2.5E-3", "9223372036854775808", "1_000", "0x10", "NaN",
+                 "Infinity", "-inf", "abc", "", "true", "12abc", "3.14.15"]
+        # "" must be written quoted: a bare empty line is a blank ROW
+        # (skipped by both paths), not a row with one empty cell.
+        data = ("c\n" + "\n".join(c if c else '""' for c in cells)
+                + "\n").encode()
+        _, jsonl = native.csv_parse(data)
+        native_vals = [json.loads(ln)["c"] for ln in jsonl.splitlines()]
+        python_vals = [_infer(c) for c in cells]
+        assert native_vals == python_vals, list(
+            zip(cells, native_vals, python_vals)
+        )
+
+    def test_ingest_jsonl_into_store(self, store):
+        data = b"x,y\n1,2\n3,4\n5,6\n"
+        fields, jsonl = native.csv_parse(data)
+        n = store.insert_jsonl("ds", jsonl)
+        assert n == 3
+        assert store.find_one("ds", 1) == {"x": 3, "y": 4, "_id": 1}
+
+
+class TestNativeConcurrency:
+    def test_parallel_inserts_unique_ids(self, store):
+        errs = []
+
+        def worker():
+            try:
+                for _ in range(200):
+                    store.insert_one("c", {"t": threading.get_ident()})
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        docs = store.find("c")
+        assert len(docs) == 1600
+        assert len({d["_id"] for d in docs}) == 1600
