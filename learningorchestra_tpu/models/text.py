@@ -15,6 +15,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 from flax import linen as nn
 
+from learningorchestra_tpu.ops.layers import MultiHeadSelfAttention
 from learningorchestra_tpu.toolkit.registry import register
 from learningorchestra_tpu.train.neural import NeuralEstimator
 
@@ -68,19 +69,26 @@ class LSTMClassifier(NeuralEstimator):
 
 
 class TransformerBlock(nn.Module):
+    """Pre-LN block over the framework's own attention layer: the Pallas
+    flash kernel on TPU (ops/attention.py), jnp reference elsewhere —
+    the reference system materialises full (T, T) scores inside wrapped
+    keras models; this never does."""
+
     hidden_dim: int
     num_heads: int
     mlp_dim: int
     dtype: jnp.dtype = jnp.float32
+    use_flash: bool | None = None  # None = auto by backend
 
     @nn.compact
-    def __call__(self, x, mask=None):
+    def __call__(self, x, key_mask=None):
         y = nn.LayerNorm(dtype=self.dtype)(x)
-        y = nn.MultiHeadDotProductAttention(
+        y = MultiHeadSelfAttention(
             num_heads=self.num_heads,
             qkv_features=self.hidden_dim,
             dtype=self.dtype,
-        )(y, y, mask=mask)
+            use_flash=self.use_flash,
+        )(y, key_mask=key_mask)
         x = x + y
         y = nn.LayerNorm(dtype=self.dtype)(x)
         y = nn.Dense(self.mlp_dim, dtype=self.dtype)(y)
@@ -99,6 +107,7 @@ class BertEncoder(nn.Module):
     mlp_dim: int = 3072
     max_len: int = 512
     dtype: jnp.dtype = jnp.float32
+    use_flash: bool | None = None
 
     @nn.compact
     def __call__(self, tokens):
@@ -111,15 +120,18 @@ class BertEncoder(nn.Module):
             jnp.arange(seq)[None, :]
         )
         x = tok + pos
+        # Key-side padding mask (pad id 0).  Key-side masking is exact
+        # for every non-pad query row; pad query rows produce values no
+        # one reads — the [CLS] head pools position 0 only.
         pad_mask = tokens != 0  # (B, T)
-        attn_mask = pad_mask[:, None, None, :] & pad_mask[:, None, :, None]
         for _ in range(self.num_layers):
             x = TransformerBlock(
                 hidden_dim=self.hidden_dim,
                 num_heads=self.num_heads,
                 mlp_dim=self.mlp_dim,
                 dtype=self.dtype,
-            )(x, mask=attn_mask)
+                use_flash=self.use_flash,
+            )(x, key_mask=pad_mask)
         return nn.LayerNorm(dtype=self.dtype)(x)
 
 

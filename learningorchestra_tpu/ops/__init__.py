@@ -1,0 +1,17 @@
+"""TPU kernel library (Pallas).
+
+Hot ops implemented as Pallas TPU kernels with jnp reference
+implementations for CPU and for numerical testing.  The reference system
+has no first-party kernels (its numerics live in wrapped toolkits,
+SURVEY §2.3); this package is the TPU-native replacement for that layer's
+hot path — attention is the dominant op of the flagship BERT workload
+(BASELINE.md config 4).
+"""
+
+from learningorchestra_tpu.ops.attention import (
+    flash_attention,
+    mha_reference,
+)
+from learningorchestra_tpu.ops.layers import MultiHeadSelfAttention
+
+__all__ = ["flash_attention", "mha_reference", "MultiHeadSelfAttention"]

@@ -1,0 +1,356 @@
+"""Flash attention — blockwise online-softmax attention as a Pallas TPU
+kernel with a custom VJP (forward and backward both Pallas).
+
+The reference system's attention lives inside wrapped keras models and is
+materialised as a full (T, T) score matrix per head; this kernel never
+materialises scores — it streams K/V blocks through VMEM with the online
+softmax (running max / running sum) recurrence, so HBM traffic is O(T·D)
+instead of O(T²) and the MXU sees (block_q × D) @ (D × block_k) matmuls.
+
+Numerical contract (tested against ``mha_reference``):
+- computes in float32 regardless of input dtype (bfloat16 inputs are
+  upcast at the MXU via ``preferred_element_type``);
+- key-side padding mask: masked keys contribute zero probability; rows
+  whose keys are ALL masked output exactly 0 (and get zero gradient).
+
+On non-TPU backends the same kernels run in Pallas interpret mode, which
+is how the unit tests exercise them on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_BIG = -1e30  # additive mask value; exp(_NEG_BIG - lse) == 0 in f32
+_LSE_EMPTY = 1e30  # lse sentinel for fully-masked rows: exp(s - 1e30) == 0
+
+
+def _auto_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Reference implementation (jnp) — ground truth for tests and CPU fallback.
+# ---------------------------------------------------------------------------
+
+
+def mha_reference(q, k, v, key_mask=None):
+    """Plain multi-head attention. q,k,v: (B, H, T, D); key_mask: (B, Tk).
+
+    Fully-masked rows output exactly 0 with exactly-0 gradients.  The
+    masking uses the double-``where`` pattern: masked lanes never touch a
+    live value on either the forward or backward path (a single ``where``
+    after ``exp`` leaves NaN-producing -1e30 arithmetic on the grad path).
+    """
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk",
+        q.astype(jnp.float32), k.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if key_mask is None:
+        p = jax.nn.softmax(s, axis=-1)
+    else:
+        maskb = key_mask.astype(bool)[:, None, None, :]
+        m = jnp.max(jnp.where(maskb, s, _NEG_BIG), axis=-1, keepdims=True)
+        # Fully-masked rows: make the subtraction a no-op so the masked
+        # branch below sees a clean constant, not (-1e30) - (-1e30).
+        m = jnp.where(m > _NEG_BIG / 2, m, 0.0)
+        p = jnp.exp(jnp.where(maskb, s - m, _NEG_BIG))  # exp(-1e30) == 0
+        denom = jnp.sum(p, axis=-1, keepdims=True)
+        p = p / jnp.maximum(denom, 1e-30)  # all-masked rows: 0/1e-30 == 0
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
+    ).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref, *, nk, bk, scale):
+    q = q_ref[0, 0].astype(jnp.float32)  # (bq, D)
+    bq, d = q.shape
+
+    def body(j, carry):
+        m, l, acc = carry
+        kb = k_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)  # (bk, D)
+        vb = v_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        km = km_ref[0, :, pl.ds(j * bk, bk)]  # (1, bk) float32, 1=keep
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (bq, bk)
+        s = s + (km - 1.0) * -_NEG_BIG  # masked keys -> -1e30
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new) * km  # zero masked keys exactly
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l, acc
+
+    m0 = jnp.full((bq, 1), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    a0 = jnp.zeros((bq, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, a0))
+
+    nonempty = l > 0.0
+    out = jnp.where(nonempty, acc / jnp.where(nonempty, l, 1.0), 0.0)
+    lse = jnp.where(
+        nonempty[:, 0], (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0], _LSE_EMPTY
+    )  # (bq,)
+    o_ref[0, 0] = out.astype(o_ref.dtype)
+    lse_ref[0, 0] = lse[:, None]
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    *, nk, bk, scale,
+):
+    q = q_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]  # (bq, 1)
+    delta = delta_ref[0, 0]
+    bq, d = q.shape
+
+    def body(j, dq):
+        kb = k_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        vb = v_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        km = km_ref[0, :, pl.ds(j * bk, bk)]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        s = s + (km - 1.0) * -_NEG_BIG
+        p = jnp.exp(s - lse) * km  # (bq, bk)
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        return dq + jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = jax.lax.fori_loop(0, nk, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref, *, nq, bq, scale,
+):
+    kb = k_ref[0, 0].astype(jnp.float32)  # (bk, D)
+    vb = v_ref[0, 0].astype(jnp.float32)
+    km = km_ref[0]  # (1, bk)
+    bk, d = kb.shape
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        do = do_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * bq, bq), :]  # (bq, 1)
+        delta = delta_ref[0, 0, pl.ds(i * bq, bq), :]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        s = s + (km - 1.0) * -_NEG_BIG
+        p = jnp.exp(s - lse) * km  # (bq, bk)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    z = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, nq, body, (z, z))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas_call plumbing
+# ---------------------------------------------------------------------------
+
+
+def _fwd_call(q, k, v, km, block_q, block_k, interpret):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    nq, nk = tq // block_q, tk // block_k
+    scale = 1.0 / (d ** 0.5)
+    kernel = functools.partial(_fwd_kernel, nk=nk, bk=block_k, scale=scale)
+    return pl.pallas_call(
+        kernel,
+        grid=(b, h, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bb, hh, i: (bb, hh, i, 0)),
+            pl.BlockSpec((1, 1, tk, d), lambda bb, hh, i: (bb, hh, 0, 0)),
+            pl.BlockSpec((1, 1, tk, d), lambda bb, hh, i: (bb, hh, 0, 0)),
+            pl.BlockSpec((1, 1, tk), lambda bb, hh, i: (bb, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bb, hh, i: (bb, hh, i, 0)),
+            pl.BlockSpec(
+                (1, 1, block_q, 1), lambda bb, hh, i: (bb, hh, i, 0)
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, tq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, km)
+
+
+def _bwd_call(q, k, v, km, do, lse, delta, block_q, block_k, interpret):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    nq, nk = tq // block_q, tk // block_k
+    scale = 1.0 / (d ** 0.5)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, nk=nk, bk=block_k, scale=scale),
+        grid=(b, h, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bb, hh, i: (bb, hh, i, 0)),
+            pl.BlockSpec((1, 1, tk, d), lambda bb, hh, i: (bb, hh, 0, 0)),
+            pl.BlockSpec((1, 1, tk, d), lambda bb, hh, i: (bb, hh, 0, 0)),
+            pl.BlockSpec((1, 1, tk), lambda bb, hh, i: (bb, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda bb, hh, i: (bb, hh, i, 0)),
+            pl.BlockSpec(
+                (1, 1, block_q, 1), lambda bb, hh, i: (bb, hh, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q, 1), lambda bb, hh, i: (bb, hh, i, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda bb, hh, i: (bb, hh, i, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v, km, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, nq=nq, bq=block_q, scale=scale),
+        grid=(b, h, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, tq, d), lambda bb, hh, j: (bb, hh, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bb, hh, j: (bb, hh, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bb, hh, j: (bb, hh, j, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda bb, hh, j: (bb, 0, j)),
+            pl.BlockSpec((1, 1, tq, d), lambda bb, hh, j: (bb, hh, 0, 0)),
+            pl.BlockSpec((1, 1, tq, 1), lambda bb, hh, j: (bb, hh, 0, 0)),
+            pl.BlockSpec((1, 1, tq, 1), lambda bb, hh, j: (bb, hh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda bb, hh, j: (bb, hh, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bb, hh, j: (bb, hh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, km, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp core (operates on block-aligned shapes)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_core(q, k, v, km, block_q, block_k, interpret):
+    o, _ = _fwd_call(q, k, v, km, block_q, block_k, interpret)
+    return o
+
+
+def _flash_core_fwd(q, k, v, km, block_q, block_k, interpret):
+    o, lse = _fwd_call(q, k, v, km, block_q, block_k, interpret)
+    return o, (q, k, v, km, o, lse)
+
+
+def _flash_core_bwd(block_q, block_k, interpret, res, g):
+    q, k, v, km, o, lse = res
+    do = g.astype(jnp.float32)
+    # (B, H, Tq, 1) — trailing singleton keeps TPU block shapes legal.
+    delta = jnp.sum(do * o.astype(jnp.float32), axis=-1, keepdims=True)
+    dq, dk, dv = _bwd_call(
+        q, k, v, km, do.astype(q.dtype), lse, delta,
+        block_q, block_k, interpret,
+    )
+    return dq, dk, dv, jnp.zeros_like(km)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Public entry
+# ---------------------------------------------------------------------------
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    key_mask=None,
+    *,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+):
+    """Blockwise attention. q,k,v: (B, H, T, D); key_mask: (B, Tk) bool.
+
+    Sequences are padded to block multiples internally; padded keys are
+    masked out, padded query rows are sliced off the output.
+    """
+    if interpret is None:
+        interpret = _auto_interpret()
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    block_q = min(block_q, max(8, tq))
+    block_k = min(block_k, max(8, tk))
+    pad_q = (-tq) % block_q
+    pad_k = (-tk) % block_k
+
+    if key_mask is None:
+        key_mask = jnp.ones((b, tk), jnp.float32)
+    km = key_mask.astype(jnp.float32)[:, None, :]  # (B, 1, Tk)
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        km = jnp.pad(km, ((0, 0), (0, 0), (0, pad_k)))
+
+    out = _flash_core(q, k, v, km, block_q, block_k, interpret)
+    if pad_q:
+        out = out[:, :, :tq]
+    return out

@@ -1,0 +1,56 @@
+"""Flax layers backed by the Pallas kernel library.
+
+``MultiHeadSelfAttention`` is the transformer models' attention layer:
+QKV/output projections as feature-dim matmuls (shardable on a ``tp``
+mesh axis) around the flash-attention kernel.  Off-TPU it dispatches to
+the jnp reference instead of interpret mode — interpret-mode Pallas is
+orders of magnitude slower and only meant for kernel tests.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from learningorchestra_tpu.ops.attention import (
+    flash_attention,
+    mha_reference,
+)
+
+
+class MultiHeadSelfAttention(nn.Module):
+    """Self-attention with a key-side padding mask (B, T).
+
+    ``use_flash``: None → Pallas kernel on TPU, reference elsewhere;
+    True/False forces a path (tests force both and compare).
+    """
+
+    num_heads: int
+    qkv_features: int
+    dtype: jnp.dtype = jnp.float32
+    use_flash: bool | None = None
+
+    @nn.compact
+    def __call__(self, x, key_mask=None):
+        b, t, _ = x.shape
+        head_dim = self.qkv_features // self.num_heads
+        if head_dim * self.num_heads != self.qkv_features:
+            raise ValueError("qkv_features must be divisible by num_heads")
+
+        def proj(name):
+            y = nn.DenseGeneral(
+                (self.num_heads, head_dim), dtype=self.dtype, name=name
+            )(x)
+            return y.transpose(0, 2, 1, 3)  # (B, H, T, hd)
+
+        q, k, v = proj("query"), proj("key"), proj("value")
+        use_flash = self.use_flash
+        if use_flash is None:
+            use_flash = jax.default_backend() == "tpu"
+        attend = flash_attention if use_flash else mha_reference
+        out = attend(q, k, v, key_mask)  # (B, H, T, hd)
+        out = out.transpose(0, 2, 1, 3).reshape(b, t, self.qkv_features)
+        return nn.DenseGeneral(
+            self.qkv_features, dtype=self.dtype, name="out"
+        )(out)

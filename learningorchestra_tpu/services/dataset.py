@@ -198,9 +198,15 @@ class DatasetService:
 
                 # Stream with a byte cap — Content-Length may be absent
                 # (chunked responses), so the guard must be on actual
-                # bytes received, not on a header.
+                # bytes received; when the header IS present, bail before
+                # downloading anything (the streaming fallback would have
+                # to re-download whatever we buffered here).
                 resp = requests.get(url, stream=True, timeout=60)
                 resp.raise_for_status()
+                declared = int(resp.headers.get("content-length") or 0)
+                if declared > self.NATIVE_MAX_BYTES:
+                    resp.close()
+                    return None
                 chunks, total = [], 0
                 for chunk in resp.iter_content(chunk_size=1 << 20):
                     total += len(chunk)

@@ -124,13 +124,24 @@ class MonitoringService:
             )
         except OSError:
             return
+        doomed = None
         with self._lock:
             if session.stopped:
-                # stop() won the race before the process existed — reap it.
-                proc.terminate()
-                return
-            session.process = proc
-            session.port = port
+                # stop() won the race before the process existed.
+                doomed = proc
+            else:
+                session.process = proc
+                session.port = port
+        if doomed is not None:
+            # Terminate AND reap outside the lock — terminate() alone
+            # would leave a zombie for the server's lifetime.
+            doomed.terminate()
+            try:
+                doomed.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                doomed.kill()
+                doomed.wait()
+            return
 
         # Probe for readiness off-thread: the caller is an HTTP POST
         # handler and must not stall on TensorBoard startup; ``url`` stays

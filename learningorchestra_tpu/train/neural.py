@@ -62,6 +62,67 @@ def _batch_data(x: np.ndarray, y: np.ndarray, batch_size: int, rng):
     return xb, yb, mb
 
 
+def build_device_epoch(
+    module, optimizer, loss_fn, dtype, *, n, batch_size, shuffle
+):
+    """Jitted whole-epoch step over a DEVICE-RESIDENT dataset.
+
+    The dataset is uploaded once; each epoch is one jitted call that
+    permutes indices on device (``jax.random.permutation``), gathers
+    batches in HBM and scans the train step — host traffic per epoch is
+    one PRNG key and the metrics scalars, vs. the host-side reshuffle +
+    full re-upload per epoch of the generic path (the reference pays
+    keras' per-batch Python dispatch on top, train_function.py:84-87).
+    (params, opt_state) are donated so updates happen in place.
+    """
+    n_batches = max(1, -(-n // batch_size))
+    pad = n_batches * batch_size - n
+
+    def _cast(xb):
+        return (
+            xb.astype(dtype)
+            if dtype and jnp.issubdtype(xb.dtype, jnp.floating)
+            else xb
+        )
+
+    def epoch(params, opt_state, x, y, key):
+        order = (
+            jax.random.permutation(key, n) if shuffle else jnp.arange(n)
+        )
+        if pad:
+            # np.resize-style cycling so tiny datasets (pad > n) work.
+            extra = jnp.resize(order, (pad,))
+            idx = jnp.concatenate([order, extra])
+        else:
+            idx = order
+        mask = jnp.concatenate(
+            [jnp.ones(n, jnp.float32), jnp.zeros(pad, jnp.float32)]
+        )
+        xb = x[idx].reshape(n_batches, batch_size, *x.shape[1:])
+        yb = y[idx].reshape(n_batches, batch_size, *y.shape[1:])
+        mb = mask.reshape(n_batches, batch_size)
+
+        def body(carry, batch):
+            params, opt_state = carry
+            bx, by, bm = batch
+
+            def objective(p):
+                logits = module.apply(p, _cast(bx)).astype(jnp.float32)
+                return loss_fn(logits, by, bm)
+
+            grads, metrics = jax.grad(objective, has_aux=True)(params)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), metrics
+
+        (params, opt_state), metrics = jax.lax.scan(
+            body, (params, opt_state), (xb, yb, mb)
+        )
+        return params, opt_state, jax.tree_util.tree_map(jnp.mean, metrics)
+
+    return jax.jit(epoch, donate_argnums=(0, 1))
+
+
 def build_epoch_fns(module, optimizer, loss_fn, dtype, *, donate=False):
     """Jitted (epoch, evaluate) pair shared by the single-device and
     mesh-sharded training paths — the loss/grad/update math exists once.
@@ -138,6 +199,9 @@ class NeuralEstimator(Estimator):
         self._step_fn = None
         self._eval_fn = None
         self._apply_fn = None
+        self._device_epoch = None
+        self._device_epoch_key = None
+        self._eval_loss_kind = None
 
     # -- keras-compile parity -------------------------------------------------
 
@@ -150,6 +214,8 @@ class NeuralEstimator(Estimator):
             self.loss = loss
         self._step_fn = None  # force re-jit with new config
         self._eval_fn = None
+        self._device_epoch = None
+        self._device_epoch_key = None
 
     # -- loss -----------------------------------------------------------------
 
@@ -245,24 +311,45 @@ class NeuralEstimator(Estimator):
                 y_arr, y_val = y_arr[:-n_val], y_arr[-n_val:]
                 validation_data = (x_val, y_val)
 
+        if len(x) == 0:
+            raise ValueError("cannot batch an empty dataset")
         if self.params is None:
             self._init_params(jnp.asarray(x[:1]))
-        if self._step_fn is None:
-            self._step_fn, self._eval_fn = self._build_step(loss_kind)
+        if self._eval_fn is None or self._eval_loss_kind != loss_kind:
+            _, self._eval_fn = self._build_step(loss_kind)
+            self._eval_loss_kind = loss_kind
 
-        rng = np.random.default_rng(self.seed)
+        # Upload the dataset once; each epoch is one jitted call that
+        # shuffles/batches on device (see build_device_epoch).
+        epoch_key = (len(x), batch_size, bool(shuffle), loss_kind)
+        if self._device_epoch_key != epoch_key:
+            dtype = jnp.bfloat16 if self.compute_dtype == "bfloat16" else None
+            self._device_epoch = build_device_epoch(
+                self.module,
+                self.optimizer,
+                self._loss_and_metrics(loss_kind),
+                dtype,
+                n=len(x),
+                batch_size=batch_size,
+                shuffle=bool(shuffle),
+            )
+            self._device_epoch_key = epoch_key
+        xs = jnp.asarray(x)
+        ys = jnp.asarray(y_arr)
+        root_key = jax.random.PRNGKey(self.seed)
+
         params, opt_state = self.params, self.opt_state
         for epoch_i in range(epochs):
             t0 = time.perf_counter()
-            xb, yb, mb = _batch_data(
-                x, y_arr, batch_size, rng if shuffle else _NoShuffle()
+            params, opt_state, metrics = self._device_epoch(
+                params, opt_state, xs, ys,
+                jax.random.fold_in(root_key, epoch_i),
             )
-            xs = jnp.asarray(xb)
-            ys = jnp.asarray(yb)
-            ms = jnp.asarray(mb)
-            params, opt_state, metrics = self._step_fn(
-                params, opt_state, xs, ys, ms
-            )
+            # Re-anchor the estimator each epoch: the epoch call donates
+            # its (params, opt_state) arguments, so a raise from a
+            # callback/validation below must not strand self.params on
+            # deleted buffers.
+            self.params, self.opt_state = params, opt_state
             metrics = {k: float(v) for k, v in metrics.items()}
             metrics["epoch_time"] = time.perf_counter() - t0
             if validation_data is not None:
@@ -300,10 +387,11 @@ class NeuralEstimator(Estimator):
         if y.ndim == 2 and y.shape[1] == 1:
             y = y.reshape(-1)
         loss_kind = self._resolve_loss(y)
-        if self._eval_fn is None:
+        if self._eval_fn is None or self._eval_loss_kind != loss_kind:
             if self.params is None:
                 raise RuntimeError("evaluate() before fit()")
             self._step_fn, self._eval_fn = self._build_step(loss_kind)
+            self._eval_loss_kind = loss_kind
         return self._evaluate_arrays(
             self.params, x, y, batch_size, loss_kind
         )
@@ -346,6 +434,8 @@ class NeuralEstimator(Estimator):
         d["_step_fn"] = None
         d["_eval_fn"] = None
         d["_apply_fn"] = None
+        d["_device_epoch"] = None
+        d["_device_epoch_key"] = None
         d["params"] = jax.device_get(d["params"]) if d["params"] is not None \
             else None
         d["opt_state"] = jax.device_get(d["opt_state"]) \

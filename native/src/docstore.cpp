@@ -409,9 +409,11 @@ void infer_value(const std::string &cell, std::string &out) {
       dv >= -1.7976931348623157e308) {
     // Only treat as a number if it LOOKS numeric (strtod accepts "0x...",
     // "inf", "nan" — Python float() accepts inf/nan but those aren't JSON).
-    char c0 = s[0] == '+' || s[0] == '-' ? s[1] : s[0];
+    const char *digits = (s[0] == '+' || s[0] == '-') ? s + 1 : s;
+    char c0 = digits[0];
     if ((c0 >= '0' && c0 <= '9') || c0 == '.') {
-      bool hexish = c0 == '0' && (s[1] == 'x' || s[1] == 'X');
+      bool hexish =
+          c0 == '0' && (digits[1] == 'x' || digits[1] == 'X');
       if (!hexish) {
         format_double(dv, out);
         return;
