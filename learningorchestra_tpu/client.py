@@ -1,0 +1,420 @@
+"""Python client — the framework's equivalent of the reference's
+``learning-orchestra-client`` pip package (layer L0, SURVEY §1: separate
+``pythonClient`` repo, ``Context(cluster_ip)`` + per-service classes,
+reference: README.md:82-93).
+
+Usage::
+
+    from learningorchestra_tpu.client import Context
+
+    ctx = Context("10.0.0.5")           # or full "http://host:port"
+    ctx.dataset_csv.insert("iris", "https://.../iris.csv")
+    ctx.observe.wait("iris")            # server-side block until finished
+    ctx.projection.create("iris_x", "iris", ["sepal_len", "petal_len"])
+    ctx.model.create("mlp", module_path="learningorchestra_tpu.models.mlp",
+                     class_name="MLPClassifier",
+                     class_parameters={"num_classes": 3})
+    ctx.train.create("fit1", model_name="mlp",
+                     method_parameters={"x": "$iris_x", "y": "$iris.label",
+                                        "epochs": 5})
+    ctx.observe.wait("fit1", timeout=600)
+    ctx.predict.create("pred1", parent_name="fit1",
+                       method_parameters={"x": "$iris_x"})
+
+Only the standard library is used (urllib), so the module is trivially
+vendorable as a standalone client package.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any
+
+
+class ClientError(Exception):
+    """HTTP-level failure; carries the server's status and error payload."""
+
+    def __init__(self, status: int, payload: Any):
+        self.status = status
+        self.payload = payload
+        super().__init__(f"HTTP {status}: {payload}")
+
+
+class Context:
+    """Connection to a learningorchestra_tpu cluster."""
+
+    def __init__(self, cluster: str, port: int = 80,
+                 prefix: str = "/api/learningOrchestra/v1"):
+        if cluster.startswith(("http://", "https://")):
+            base = cluster.rstrip("/")
+        else:
+            base = f"http://{cluster}:{port}"
+        self.base = base + prefix
+
+        self.dataset_csv = _Dataset(self, "csv")
+        self.dataset_generic = _Dataset(self, "generic")
+        self.projection = _Projection(self)
+        self.data_type = _DataType(self)
+        self.histogram = _Histogram(self)
+        self.explore = _Explore(self, "tensorflow")
+        self.model = _Model(self, "tensorflow")
+        self.tune = _Executor(self, "tune", "tensorflow")
+        self.train = _Executor(self, "train", "tensorflow")
+        self.evaluate = _Executor(self, "evaluate", "tensorflow")
+        self.predict = _Executor(self, "predict", "tensorflow")
+        self.train_distributed = _DistributedTrain(self)
+        self.function = _Function(self)
+        self.builder = _Builder(self)
+        self.monitoring = _Monitoring(self)
+        self.observe = _Observe(self)
+
+    # -- transport ----------------------------------------------------------
+
+    def request(self, verb: str, path: str, body: dict | None = None,
+                query: dict | None = None, raw: bool = False):
+        url = self.base + path
+        if query:
+            url += "?" + urllib.parse.urlencode(
+                {k: v if isinstance(v, str) else json.dumps(v)
+                 for k, v in query.items()}
+            )
+        req = urllib.request.Request(
+            url,
+            method=verb,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req) as resp:
+                data = resp.read()
+                if raw:
+                    return data
+                return json.loads(data) if data else {}
+        except urllib.error.HTTPError as exc:
+            data = exc.read()
+            try:
+                payload = json.loads(data)
+            except Exception:
+                payload = data.decode(errors="replace")
+            raise ClientError(exc.code, payload) from None
+
+    # -- conveniences over the universal GET/poll path ----------------------
+
+    def search(self, service_path: str, name: str, *, query: dict | None = None,
+               limit: int = 20, skip: int = 0) -> list[dict]:
+        q: dict = {"limit": limit, "skip": skip}
+        if query:
+            q["query"] = query
+        return self.request("GET", f"/{service_path}/{name}", query=q)
+
+    def metadata(self, service_path: str, name: str) -> dict:
+        docs = self.search(service_path, name, limit=1)
+        return docs[0] if docs else {}
+
+
+class _Service:
+    service_path = ""  # e.g. "dataset/csv"
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+
+    def search(self, name: str, **kw) -> list[dict]:
+        return self.ctx.search(self.service_path, name, **kw)
+
+    def metadata(self, name: str) -> dict:
+        return self.ctx.metadata(self.service_path, name)
+
+    def delete(self, name: str) -> dict:
+        return self.ctx.request(
+            "DELETE", f"/{self.service_path}/{name}"
+        )
+
+    def wait(self, name: str, timeout: float = 120.0) -> dict:
+        return _wait(self.ctx, name, timeout)
+
+
+def _wait(ctx: Context, name: str, timeout: float) -> dict:
+    """Block until ``finished`` or ``jobState=failed`` (server-side long
+    poll via /observe, looped client-side for arbitrary timeouts)."""
+    deadline = time.time() + timeout
+    while True:
+        remaining = max(1.0, min(30.0, deadline - time.time()))
+        meta = ctx.request(
+            "GET", f"/observe/{name}", query={"timeout": remaining}
+        )["metadata"]
+        if meta.get("finished") or meta.get("jobState") == "failed":
+            return meta
+        if time.time() >= deadline:
+            raise TimeoutError(f"artifact {name!r} not finished "
+                               f"after {timeout}s: {meta}")
+
+
+class _Dataset(_Service):
+    def __init__(self, ctx: Context, kind: str):
+        super().__init__(ctx)
+        self.service_path = f"dataset/{kind}"
+
+    def insert(self, dataset_name: str, url: str) -> dict:
+        return self.ctx.request(
+            "POST", f"/{self.service_path}",
+            {"datasetName": dataset_name, "url": url},
+        )
+
+    def list(self) -> list[dict]:
+        return self.ctx.request("GET", f"/{self.service_path}")
+
+
+class _Projection(_Service):
+    service_path = "transform/projection"
+
+    def create(self, projection_name: str, dataset_name: str,
+               fields: list[str]) -> dict:
+        return self.ctx.request(
+            "POST", "/transform/projection",
+            {"projectionName": projection_name, "datasetName": dataset_name,
+             "fields": fields},
+        )
+
+
+class _DataType(_Service):
+    service_path = "transform/dataType"
+
+    def update(self, dataset_name: str, types: dict) -> dict:
+        return self.ctx.request(
+            "PATCH", "/transform/dataType",
+            {"datasetName": dataset_name, "types": types},
+        )
+
+
+class _Histogram(_Service):
+    service_path = "explore/histogram"
+
+    def create(self, histogram_name: str, dataset_name: str,
+               fields: list[str]) -> dict:
+        return self.ctx.request(
+            "POST", "/explore/histogram",
+            {"histogramName": histogram_name, "datasetName": dataset_name,
+             "fields": fields},
+        )
+
+
+class _Explore(_Service):
+    def __init__(self, ctx: Context, tool: str):
+        super().__init__(ctx)
+        self.tool = tool
+        self.service_path = f"explore/{tool}"
+
+    def create(self, name: str, *, module_path: str, class_name: str,
+               class_parameters: dict | None = None,
+               method: str = "fit_transform",
+               method_parameters: dict | None = None,
+               color_by: str | None = None, description: str = "") -> dict:
+        return self.ctx.request(
+            "POST", f"/explore/{self.tool}",
+            {"name": name, "modulePath": module_path, "class": class_name,
+             "classParameters": class_parameters or {}, "method": method,
+             "methodParameters": method_parameters or {},
+             "colorBy": color_by, "description": description},
+        )
+
+    def image(self, name: str) -> bytes:
+        return self.ctx.request(
+            "GET", f"/explore/{self.tool}/{name}", raw=True
+        )
+
+    def search(self, name: str, *, query: dict | None = None,
+               limit: int = 20, skip: int = 0) -> list[dict]:
+        # GET /explore/{tool}/{name} serves the PNG; rows live under the
+        # /metadata suffix (reference: krakend.json explore block).
+        q: dict = {"limit": limit, "skip": skip}
+        if query:
+            q["query"] = query
+        return self.ctx.request(
+            "GET", f"/explore/{self.tool}/{name}/metadata", query=q
+        )
+
+    def metadata(self, name: str) -> dict:
+        docs = self.search(name, limit=1)
+        return docs[0] if docs else {}
+
+    def wait(self, name: str, timeout: float = 120.0) -> dict:
+        return _wait(self.ctx, name, timeout)
+
+
+class _Model(_Service):
+    def __init__(self, ctx: Context, tool: str):
+        super().__init__(ctx)
+        self.tool = tool
+        self.service_path = f"model/{tool}"
+
+    def create(self, model_name: str, *, module_path: str, class_name: str,
+               class_parameters: dict | None = None,
+               description: str = "") -> dict:
+        return self.ctx.request(
+            "POST", f"/model/{self.tool}",
+            {"modelName": model_name, "modulePath": module_path,
+             "class": class_name,
+             "classParameters": class_parameters or {},
+             "description": description},
+        )
+
+    def update(self, model_name: str,
+               class_parameters: dict | None = None,
+               description: str = "") -> dict:
+        return self.ctx.request(
+            "PATCH", f"/model/{self.tool}/{model_name}",
+            {"classParameters": class_parameters, "description": description},
+        )
+
+
+class _Executor(_Service):
+    """tune / train / evaluate / predict over a parent artifact."""
+
+    def __init__(self, ctx: Context, service: str, tool: str):
+        super().__init__(ctx)
+        self.service = service
+        self.tool = tool
+        self.service_path = f"{service}/{tool}"
+
+    def create(self, name: str, *, parent_name: str | None = None,
+               model_name: str | None = None, method: str | None = None,
+               method_parameters: dict | None = None,
+               param_grid: dict | None = None,
+               scoring_parameters: dict | None = None,
+               description: str = "") -> dict:
+        body: dict = {
+            "name": name,
+            "parentName": parent_name or model_name,
+            "modelName": model_name,
+            "method": method or ("fit" if self.service in ("train", "tune")
+                                 else self.service),
+            "methodParameters": method_parameters or {},
+            "description": description,
+        }
+        if param_grid:
+            body["paramGrid"] = param_grid
+            if scoring_parameters:
+                body["scoringParameters"] = scoring_parameters
+        return self.ctx.request("POST", f"/{self.service_path}", body)
+
+    def update(self, name: str, *, method_parameters: dict | None = None,
+               description: str = "") -> dict:
+        return self.ctx.request(
+            "PATCH", f"/{self.service_path}/{name}",
+            {"methodParameters": method_parameters,
+             "description": description},
+        )
+
+
+class _DistributedTrain(_Service):
+    service_path = "train/horovod"
+
+    def create(self, name: str, *, parent_name: str,
+               training_parameters: dict,
+               compile_spec: dict | None = None,
+               mesh: dict | None = None,
+               monitoring_path: str | None = None,
+               description: str = "") -> dict:
+        return self.ctx.request(
+            "POST", "/train/horovod",
+            {"name": name, "parentName": parent_name,
+             "trainingParameters": training_parameters,
+             "compile": compile_spec, "mesh": mesh,
+             "monitoringPath": monitoring_path,
+             "description": description},
+        )
+
+
+class _Function(_Service):
+    service_path = "function/python"
+
+    def create(self, name: str, *, function: str,
+               function_parameters: dict | None = None,
+               description: str = "") -> dict:
+        return self.ctx.request(
+            "POST", "/function/python",
+            {"name": name, "function": function,
+             "functionParameters": function_parameters or {},
+             "description": description},
+        )
+
+    def update(self, name: str, *, function: str | None = None,
+               function_parameters: dict | None = None,
+               description: str = "") -> dict:
+        return self.ctx.request(
+            "PATCH", f"/function/python/{name}",
+            {"function": function,
+             "functionParameters": function_parameters,
+             "description": description},
+        )
+
+
+class _Builder(_Service):
+    service_path = "builder/sparkml"
+
+    def create(self, *, train_dataset: str, test_dataset: str,
+               classifiers: list[str], label_field: str = "label",
+               feature_fields: list[str] | None = None,
+               modeling_code: str | None = None,
+               classifier_parameters: dict | None = None,
+               description: str = "") -> dict:
+        """Whole-pipeline builder (reference: POST /builder/sparkml)."""
+        return self.ctx.request(
+            "POST", "/builder/sparkml",
+            {"trainDatasetName": train_dataset,
+             "testDatasetName": test_dataset,
+             "classifiersList": classifiers, "labelField": label_field,
+             "featureFields": feature_fields,
+             "modelingCode": modeling_code,
+             "classifierParameters": classifier_parameters,
+             "description": description},
+        )
+
+    def create_distributed(self, name: str, *, function: str,
+                           function_parameters: dict | None = None,
+                           n_workers: int | None = None,
+                           description: str = "") -> dict:
+        """One user function on every rank (reference: POST
+        /builder/tensorflow|pytorch → builder/horovod)."""
+        return self.ctx.request(
+            "POST", "/builder/tensorflow",
+            {"name": name, "function": function,
+             "functionParameters": function_parameters or {},
+             "nWorkers": n_workers, "description": description},
+        )
+
+
+class _Monitoring:
+    """Session registry lookups — NOT an artifact service (its GET
+    returns a session dict, not document rows)."""
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+
+    def lookup(self, nickname: str) -> dict:
+        return self.ctx.request(
+            "GET", f"/monitoring/tensorflow/{nickname}"
+        )
+
+    def list(self) -> list[dict]:
+        return self.ctx.request("GET", "/monitoring/tensorflow")
+
+    def stop(self, nickname: str) -> dict:
+        return self.ctx.request(
+            "DELETE", f"/monitoring/tensorflow/{nickname}"
+        )
+
+
+class _Observe:
+    """The reference's separate Observe service (collection watch,
+    README.md:71) — here a server-side long poll."""
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+
+    def wait(self, name: str, timeout: float = 120.0) -> dict:
+        return _wait(self.ctx, name, timeout)

@@ -81,10 +81,17 @@ class ServiceContext:
 
     def delete_artifact(self, name: str) -> dict:
         """Shared delete: collection + volume binary (dataset/model/
-        executor/function services all expose the same DELETE)."""
+        executor/function services all expose the same DELETE), plus any
+        managed train checkpoints — a recreated artifact with the same
+        name must never resume from a deleted job's state."""
         meta = self.require_existing(name)
         self.artifacts.delete(name)
         self.volumes.delete(meta.get("type", ""), name)
+        import shutil
+
+        ckdir = self.volumes.root / "_checkpoints" / name
+        if ckdir.exists():
+            shutil.rmtree(ckdir, ignore_errors=True)
         return meta
 
     def require_finished_parent(self, name: str) -> dict:

@@ -15,12 +15,14 @@ candidate's score as a result row, selecting the best instance.
 from __future__ import annotations
 
 import itertools
+import shutil
 import time
 from typing import Any
 
 import numpy as np
 
 from learningorchestra_tpu import dsl
+from learningorchestra_tpu.train.neural import NeuralEstimator
 from learningorchestra_tpu.services.context import (
     ServiceContext,
     ValidationError,
@@ -40,6 +42,7 @@ def store_history_rows(documents, name: str, history: dict) -> int:
         documents.insert_one(
             name,
             {
+                "docType": "history",
                 "epoch": i,
                 **{
                     k: history[k][i] for k in keys if len(history[k]) > i
@@ -98,7 +101,7 @@ class ExecutorService:
         )
         self._submit(
             name, parent_meta, method, method_parameters, artifact_type,
-            description,
+            description, resume_checkpoint=False,
         )
         return meta
 
@@ -110,7 +113,13 @@ class ExecutorService:
         description: str = "",
     ) -> dict:
         """PATCH re-run with new parameters (reference:
-        server.py:110-156)."""
+        server.py:110-156).
+
+        A re-run of a FAILED train job resumes from its newest managed
+        checkpoint (the preemption path); a re-run of a finished job is
+        a fresh fit from epoch 0 — new parameters must actually apply,
+        so stale checkpoints are cleared.
+        """
         meta = self.ctx.require_existing(name)
         parent = meta.get("parentName")
         if not parent:
@@ -118,15 +127,19 @@ class ExecutorService:
                 f"artifact {name!r} has no parent — not an executor result"
             )
         parent_meta = self.ctx.require_finished_parent(parent)
+        resume = meta.get("jobState") == "failed"
         self.ctx.artifacts.metadata.restart(name)
         self._submit(
             name, parent_meta, meta.get("method"), method_parameters,
-            meta.get("type"), description,
+            meta.get("type"), description, resume_checkpoint=resume,
         )
         return self.ctx.artifacts.metadata.read(name)
 
+    def _checkpoint_dir(self, name: str):
+        return self.ctx.volumes.root / "_checkpoints" / name
+
     def _submit(self, name, parent_meta, method, method_parameters,
-                artifact_type, description):
+                artifact_type, description, *, resume_checkpoint=False):
         parent_name = parent_meta["name"]
         parent_type = parent_meta.get("type", "")
         kind = artifact_type.split("/", 1)[0]
@@ -134,6 +147,23 @@ class ExecutorService:
         def run():
             instance = self.ctx.volumes.read_object(parent_type, parent_name)
             params = dsl.resolve_params(method_parameters, self.ctx.loader)
+            if (
+                kind in TRAIN_KINDS
+                and method == "fit"
+                and isinstance(instance, NeuralEstimator)
+                and "checkpoint_dir" not in params
+            ):
+                # Managed in-loop checkpointing: a FAILED train job
+                # PATCHed back resumes from its newest checkpoint instead
+                # of epoch 0 (train/checkpoint.py; the reference loses
+                # mid-job state entirely, SURVEY §5.4).  Fresh runs and
+                # param-changing re-runs of finished jobs must not
+                # resurrect old state, so their checkpoint dir is wiped.
+                ckdir = self._checkpoint_dir(name)
+                if not resume_checkpoint and ckdir.exists():
+                    shutil.rmtree(ckdir, ignore_errors=True)
+                params["checkpoint_dir"] = str(ckdir)
+                params.setdefault("resume", resume_checkpoint)
             t0 = time.perf_counter()
             result = getattr(instance, method)(**params)
             fit_time = time.perf_counter() - t0
@@ -144,6 +174,12 @@ class ExecutorService:
                 extra = {"fitTime": fit_time}
                 hist = getattr(instance, "history", None)
                 if hist:
+                    # Re-runs re-store the full history; drop the old
+                    # rows or epochs would duplicate.
+                    for doc in self.ctx.documents.find(
+                        name, query={"docType": "history"}
+                    ):
+                        self.ctx.documents.delete_one(name, doc["_id"])
                     store_history_rows(self.ctx.documents, name, hist)
                 return extra
             # Evaluate/predict semantics: persist result rows + binary.

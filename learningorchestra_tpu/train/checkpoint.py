@@ -1,0 +1,85 @@
+"""Managed in-loop training checkpoints (orbax-backed).
+
+The reference has NO intra-training checkpointing: a mid-job failure
+loses the job and distributed training returns weights only at the end
+(reference: training_function/train_function.py:84-87; README.md:193-197
+documents that a task running when the cluster dies "is lost").  On TPU,
+preemption is routine, so the train executor checkpoints the estimator
+state every N epochs and PATCH re-runs resume instead of restarting —
+closing the gap SURVEY §5.4 calls out.
+
+Layout under ``<dir>``::
+
+    step_<n>/        orbax pytree checkpoint (params + opt_state)
+    latest.json      {"step": n, "history": {...}} — atomically replaced
+
+``latest.json`` is written AFTER the step directory commits, so a crash
+mid-save leaves the previous checkpoint intact and discoverable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from pathlib import Path
+
+KEEP = 2  # retained checkpoints; older ones are pruned after each save
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save(directory: str | Path, step: int, state: dict,
+         history: dict | None = None) -> Path:
+    """Persist {params, opt_state} at ``step``; returns the step path."""
+    import jax
+
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    path = directory / f"step_{step}"
+    if path.exists():
+        shutil.rmtree(path)
+    with _checkpointer() as ck:
+        ck.save(path, jax.device_get(state))
+    marker = {"step": step, "history": history or {}}
+    tmp = directory / "latest.json.tmp"
+    tmp.write_text(json.dumps(marker))
+    os.replace(tmp, directory / "latest.json")
+    for old in sorted(directory.glob("step_*")):
+        try:
+            n = int(old.name.split("_", 1)[1])
+        except ValueError:
+            continue
+        if n <= step - KEEP:
+            shutil.rmtree(old, ignore_errors=True)
+    return path
+
+
+def load_latest(directory: str | Path, template: dict):
+    """Restore the newest checkpoint as (state, step, history), or None.
+
+    ``template`` is a concrete pytree with the target structure (e.g. a
+    freshly-initialized {params, opt_state}) — orbax uses it to rebuild
+    optax's namedtuple states exactly.
+    """
+    directory = Path(directory)
+    marker_path = directory / "latest.json"
+    if not marker_path.exists():
+        return None
+    try:
+        marker = json.loads(marker_path.read_text())
+        step = int(marker["step"])
+    except (ValueError, KeyError, json.JSONDecodeError):
+        return None
+    path = directory / f"step_{step}"
+    if not path.exists():
+        return None
+    import jax
+
+    with _checkpointer() as ck:
+        state = ck.restore(path, jax.device_get(template))
+    return state, step, marker.get("history") or {}

@@ -290,8 +290,21 @@ class NeuralEstimator(Estimator):
         shuffle: bool = True,
         verbose: int = 0,
         callbacks: list | None = None,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 1,
+        checkpoint_min_interval_s: float = 60.0,
+        resume: bool = True,
         **_,
     ) -> "NeuralEstimator":
+        """keras-fit surface plus managed in-loop checkpointing: with
+        ``checkpoint_dir`` set, (params, opt_state) persist every
+        ``checkpoint_every`` epochs — but at most once per
+        ``checkpoint_min_interval_s`` (fast epochs on big models must
+        not stall the loop on full-state host transfers; the final
+        epoch always saves) — and an interrupted fit resumes from the
+        newest checkpoint instead of epoch 0 (``resume=False`` ignores
+        existing checkpoints) — the preemption story the reference
+        lacks (SURVEY §5.4)."""
         x = np.asarray(as_array(x))
         y_arr = np.asarray(y if not hasattr(y, "to_numpy") else y.to_numpy())
         y_arr = y_arr.reshape(-1) if y_arr.ndim == 2 and y_arr.shape[1] == 1 \
@@ -338,8 +351,24 @@ class NeuralEstimator(Estimator):
         ys = jnp.asarray(y_arr)
         root_key = jax.random.PRNGKey(self.seed)
 
+        start_epoch = 0
+        if checkpoint_dir and resume:
+            from learningorchestra_tpu.train import checkpoint as ckpt
+
+            loaded = ckpt.load_latest(
+                checkpoint_dir,
+                {"params": self.params, "opt_state": self.opt_state},
+            )
+            if loaded is not None:
+                state, step, past_history = loaded
+                self.params = state["params"]
+                self.opt_state = state["opt_state"]
+                self.history = TrainHistory(past_history)
+                start_epoch = step
+
         params, opt_state = self.params, self.opt_state
-        for epoch_i in range(epochs):
+        last_save = time.monotonic()
+        for epoch_i in range(start_epoch, epochs):
             t0 = time.perf_counter()
             params, opt_state, metrics = self._device_epoch(
                 params, opt_state, xs, ys,
@@ -360,6 +389,23 @@ class NeuralEstimator(Estimator):
                 )
                 metrics.update({f"val_{k}": v for k, v in vmetrics.items()})
             self.history.append(metrics)
+            final = epoch_i + 1 == epochs
+            if checkpoint_dir and checkpoint_every > 0 and (
+                final
+                or (
+                    (epoch_i + 1) % checkpoint_every == 0
+                    and time.monotonic() - last_save
+                    >= checkpoint_min_interval_s
+                )
+            ):
+                from learningorchestra_tpu.train import checkpoint as ckpt
+
+                ckpt.save(
+                    checkpoint_dir, epoch_i + 1,
+                    {"params": params, "opt_state": opt_state},
+                    history=dict(self.history),
+                )
+                last_save = time.monotonic()
             if verbose:
                 print(f"epoch {epoch_i + 1}/{epochs}: {metrics}", flush=True)
             for cb in callbacks or []:

@@ -1,0 +1,150 @@
+"""Python client (layer L0) over the live HTTP server — the pipeline a
+``learning-orchestra-client`` user runs (reference: README.md:82-93)."""
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.api import APIServer
+from learningorchestra_tpu.client import ClientError, Context
+from learningorchestra_tpu.config import Config
+
+
+@pytest.fixture(scope="module")
+def ctx(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("client")
+    cfg = Config()
+    cfg.store.root = str(tmp / "store")
+    cfg.store.volume_root = str(tmp / "volumes")
+    server = APIServer(cfg)
+    port = server.start_background()
+
+    rng = np.random.default_rng(0)
+    csv = tmp / "data.csv"
+    with open(csv, "w") as fh:
+        fh.write("f1,f2,label\n")
+        for _ in range(300):
+            a, b = rng.random(), rng.random()
+            fh.write(f"{a:.4f},{b:.4f},{int(a + b > 1)}\n")
+
+    client = Context(f"http://127.0.0.1:{port}")
+    yield client, str(csv)
+    server.shutdown()
+
+
+class TestClientPipeline:
+    def test_full_pipeline(self, ctx):
+        client, csv = ctx
+        r = client.dataset_csv.insert("cds", f"file://{csv}")
+        assert "result" in r or r  # 201 payload carries the artifact URI
+        meta = client.observe.wait("cds", timeout=60)
+        assert meta["finished"] and meta["rows"] == 300
+
+        client.projection.create("cds_x", "cds", ["f1", "f2"])
+        client.observe.wait("cds_x", timeout=60)
+
+        client.histogram.create("cds_hist", "cds", ["label"])
+        client.histogram.wait("cds_hist", timeout=60)
+        rows = client.histogram.search("cds_hist", limit=10)
+        counts = [d for d in rows if d.get("field") == "label"]
+        assert counts and sum(counts[0]["counts"].values()) == 300
+
+        client.model.create(
+            "cmlp",
+            module_path="learningorchestra_tpu.models.mlp",
+            class_name="MLPClassifier",
+            class_parameters={"hidden_layer_sizes": [8], "num_classes": 2},
+        )
+        client.model.wait("cmlp", timeout=60)
+
+        client.train.create(
+            "cfit",
+            model_name="cmlp",
+            method="fit",
+            method_parameters={
+                "x": "$cds_x", "y": "$cds.label",
+                "epochs": 2, "batch_size": 64,
+            },
+        )
+        meta = client.train.wait("cfit", timeout=180)
+        assert meta["finished"]
+
+        client.predict.create(
+            "cpred", parent_name="cfit", method="predict",
+            method_parameters={"x": "$cds_x"},
+        )
+        meta = client.predict.wait("cpred", timeout=120)
+        assert meta["finished"]
+        preds = client.predict.search("cpred", limit=5)
+        assert len(preds) >= 2  # metadata + result rows
+
+    def test_duplicate_name_is_client_error(self, ctx):
+        client, csv = ctx
+        with pytest.raises(ClientError) as exc:
+            client.dataset_csv.insert("cds", f"file://{csv}")
+        assert exc.value.status == 409
+
+    def test_missing_artifact_404(self, ctx):
+        client, _ = ctx
+        with pytest.raises(ClientError) as exc:
+            client.train.search("never-existed")
+        assert exc.value.status == 404
+
+    def test_function_and_failure_surface(self, ctx):
+        client, _ = ctx
+        client.function.create(
+            "cfn", function="response = sum(range(10))"
+        )
+        meta = client.observe.wait("cfn", timeout=60)
+        assert meta["finished"]
+
+        client.function.create("cboom", function="raise RuntimeError('x')")
+        meta = client.observe.wait("cboom", timeout=60)
+        assert meta["jobState"] == "failed"
+
+    def test_delete(self, ctx):
+        client, _ = ctx
+        client.function.create("ctmp", function="response = 1")
+        client.observe.wait("ctmp", timeout=60)
+        client.function.delete("ctmp")
+        with pytest.raises(ClientError) as exc:
+            client.function.search("ctmp")
+        assert exc.value.status == 404
+
+    def test_train_patch_rerun_is_fresh_and_undup(self, ctx):
+        """PATCH re-run of a FINISHED train job is a fresh fit (new
+        parameters must apply; checkpoints only resume FAILED jobs) and
+        history rows are replaced, not duplicated."""
+        client, _ = ctx
+        client.model.create(
+            "ckmlp",
+            module_path="learningorchestra_tpu.models.mlp",
+            class_name="MLPClassifier",
+            class_parameters={"hidden_layer_sizes": [8], "num_classes": 2},
+        )
+        client.model.wait("ckmlp", timeout=60)
+        client.train.create(
+            "ckfit", model_name="ckmlp", method="fit",
+            method_parameters={
+                "x": "$cds_x", "y": "$cds.label",
+                "epochs": 2, "batch_size": 64,
+            },
+        )
+        client.train.wait("ckfit", timeout=120)
+        rows = client.train.search("ckfit", limit=50)
+        assert len([d for d in rows if "epoch" in d]) == 2  # history rows
+
+        client.train.update(
+            "ckfit",
+            method_parameters={
+                "x": "$cds_x", "y": "$cds.label",
+                "epochs": 4, "batch_size": 64,
+            },
+        )
+        meta = client.train.wait("ckfit", timeout=120)
+        assert meta["finished"]
+        rows = client.train.search("ckfit", limit=50)
+        hist = [d for d in rows if "epoch" in d]
+        # Fresh 4-epoch history, old rows replaced — exactly one row per
+        # epoch 0..3, no duplicates from the first run.
+        epochs = sorted(d["epoch"] for d in hist)
+        assert epochs == [0, 1, 2, 3]
