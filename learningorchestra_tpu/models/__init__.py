@@ -17,6 +17,7 @@ from learningorchestra_tpu.models.text import (
     TransformerClassifier,
     BertModel,
 )
+from learningorchestra_tpu.models.longcontext import LongContextTransformer
 
 __all__ = [
     "MLPClassifier",
@@ -27,4 +28,5 @@ __all__ = [
     "LSTMClassifier",
     "TransformerClassifier",
     "BertModel",
+    "LongContextTransformer",
 ]

@@ -1,0 +1,169 @@
+"""Long-context transformer — sequence-parallel attention over ``sp``.
+
+The reference's sequence length is bounded by one Horovod worker's
+``model.fit`` memory (SURVEY §5.7: no attention code, scaling = more
+data-parallel replicas only).  This model family is the long-context
+capability the TPU framework adds: attention runs as ring attention
+(parallel/ring_attention.py) when a mesh with ``sp > 1`` is bound — each
+device holds T/sp of the sequence and K/V blocks rotate over ICI — and
+as vanilla attention otherwise, with an IDENTICAL parameter tree either
+way (the mesh is runtime state, not architecture).
+
+``DistributedTrainer`` binds its mesh automatically via ``bind_mesh``;
+stored artifacts drop the mesh (meshes aren't serializable state) and
+re-bind on the next distributed run.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import Mesh
+
+from learningorchestra_tpu.parallel.ring_attention import RingSelfAttention
+from learningorchestra_tpu.toolkit.registry import register
+from learningorchestra_tpu.train.neural import NeuralEstimator
+
+_MODULE = "learningorchestra_tpu.models.longcontext"
+
+
+class _LongBlock(nn.Module):
+    hidden_dim: int
+    num_heads: int
+    mlp_dim: int
+    mesh: Mesh | None
+    causal: bool
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, kmask=None):
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = RingSelfAttention(
+            num_heads=self.num_heads,
+            mesh=self.mesh,
+            causal=self.causal,
+            dtype=self.dtype,
+            name="attention",
+        )(y, kmask=kmask)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.Dense(self.mlp_dim, dtype=self.dtype)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(self.hidden_dim, dtype=self.dtype)(y)
+        return x + y
+
+
+class _LongClassifier(nn.Module):
+    vocab_size: int
+    hidden_dim: int
+    num_layers: int
+    num_heads: int
+    mlp_dim: int
+    max_len: int
+    num_classes: int
+    mesh: Mesh | None
+    causal: bool
+
+    @nn.compact
+    def __call__(self, tokens):
+        tokens = tokens.astype(jnp.int32)
+        seq = tokens.shape[1]
+        x = nn.Embed(self.vocab_size, self.hidden_dim)(tokens)
+        x = x + nn.Embed(self.max_len, self.hidden_dim)(
+            jnp.arange(seq)[None, :]
+        )
+        kmask = tokens != 0
+        for _ in range(self.num_layers):
+            x = _LongBlock(
+                hidden_dim=self.hidden_dim,
+                num_heads=self.num_heads,
+                mlp_dim=self.mlp_dim,
+                mesh=self.mesh,
+                causal=self.causal,
+            )(x, kmask=kmask)
+        x = nn.LayerNorm()(x)
+        # Mean-pool valid positions (sequence may be sharded; the mean is
+        # a plain reduction XLA handles across shards).
+        m = kmask.astype(x.dtype)[..., None]
+        pooled = (x * m).sum(1) / jnp.maximum(m.sum(1), 1.0)
+        return nn.Dense(self.num_classes)(pooled)
+
+
+@register(_MODULE)
+class LongContextTransformer(NeuralEstimator):
+    """Sequence-parallel transformer classifier.
+
+    Train single-device like any estimator, or through
+    ``DistributedTrainer(..., shard_sequence=True)`` on a mesh with
+    ``sp > 1`` for sequences that don't fit one device.
+    """
+
+    def __init__(
+        self,
+        vocab_size: int = 32000,
+        hidden_dim: int = 256,
+        num_layers: int = 4,
+        num_heads: int = 8,
+        mlp_dim: int | None = None,
+        max_len: int = 65536,
+        num_classes: int = 2,
+        causal: bool = False,
+        learning_rate: float = 1e-3,
+        seed: int = 0,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_dim = hidden_dim
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.mlp_dim = mlp_dim or hidden_dim * 4
+        self.max_len = max_len
+        self.num_classes = num_classes
+        self.causal = causal
+        super().__init__(
+            self._make_module(mesh=None),
+            loss="softmax_ce",
+            learning_rate=learning_rate,
+            seed=seed,
+        )
+
+    def _make_module(self, mesh: Mesh | None) -> _LongClassifier:
+        return _LongClassifier(
+            vocab_size=self.vocab_size,
+            hidden_dim=self.hidden_dim,
+            num_layers=self.num_layers,
+            num_heads=self.num_heads,
+            mlp_dim=self.mlp_dim,
+            max_len=self.max_len,
+            num_classes=self.num_classes,
+            mesh=mesh,
+            causal=self.causal,
+        )
+
+    def _init_params(self, x0) -> None:
+        """Initialize through the vanilla-attention module: init sees a
+        single example, which need not divide the mesh's data axes, and
+        both attention paths share one parameter tree."""
+        if getattr(self.module, "mesh", None) is None:
+            return super()._init_params(x0)
+        import jax
+
+        rng = jax.random.PRNGKey(self.seed)
+        self.params = self._make_module(mesh=None).init(rng, x0)
+        self.opt_state = self.optimizer.init(self.params)
+
+    def bind_mesh(self, mesh: Mesh | None) -> None:
+        """Swap the attention implementation (ring ⇄ vanilla) for the
+        given mesh.  Parameters are untouched — both paths share one
+        parameter tree — but jitted closures are invalidated."""
+        self.module = self._make_module(mesh)
+        self._step_fn = None
+        self._eval_fn = None
+        self._apply_fn = None
+        self._device_epoch = None
+        self._device_epoch_key = None
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        # Meshes hold device handles — never serialize them.
+        d["module"] = self._make_module(mesh=None)
+        return d

@@ -21,6 +21,7 @@ step over a named mesh:
 
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Any
 
@@ -60,10 +61,29 @@ class DistributedTrainer:
         self.estimator = estimator
         self.mesh = mesh if mesh is not None else build_mesh(spec)
         self.shard_sequence = shard_sequence
+        self._bind_depth = 0
         self.history = TrainHistory()
         self._epoch_fn = None
         self._eval_fn = None
         self._loss_kind = None
+
+    @contextlib.contextmanager
+    def _mesh_bound(self):
+        """Mesh-aware models (ring attention over sp) get the mesh bound
+        for the duration of a trainer call ONLY — left bound, the
+        estimator's own single-device predict/evaluate would hit
+        shard_map divisibility errors on arbitrary batch shapes."""
+        est = self.estimator
+        bindable = hasattr(est, "bind_mesh")
+        if bindable and self._bind_depth == 0:
+            est.bind_mesh(self.mesh)
+        self._bind_depth += 1
+        try:
+            yield
+        finally:
+            self._bind_depth -= 1
+            if bindable and self._bind_depth == 0:
+                est.bind_mesh(None)
 
     # -- placement ----------------------------------------------------------
 
@@ -159,44 +179,59 @@ class DistributedTrainer:
                 f"global batch_size {batch_size} not divisible by "
                 f"dp*fsdp={self.data_axes}"
             )
-
-        if est.params is None:
-            est._init_params(jnp.asarray(x[:1]))
-        if self._epoch_fn is None or self._loss_kind != loss_kind:
-            self._epoch_fn, self._eval_fn = self._build(loss_kind)
-            self._loss_kind = loss_kind
-
-        params, opt_state = self._place_state()
+        sp = self.mesh.shape.get("sp", 1)
         tokens = np.issubdtype(x.dtype, np.integer)
-        rng = np.random.default_rng(est.seed)
-        for epoch_i in range(epochs):
-            t0 = time.perf_counter()
-            xb, yb, mb = _batch_data(
-                x, y_arr, batch_size, rng if shuffle else _NoShuffle()
+        if (
+            self.shard_sequence and tokens and sp > 1
+            and x.ndim > 1 and x.shape[1] % sp
+        ):
+            raise ValueError(
+                f"sequence length {x.shape[1]} not divisible by sp={sp}"
             )
-            xs = jax.device_put(xb, self._data_sharding(xb.ndim, tokens))
-            ys = jax.device_put(yb, self._data_sharding(yb.ndim, False))
-            ms = jax.device_put(mb, self._data_sharding(mb.ndim, False))
-            params, opt_state, metrics = self._epoch_fn(
-                params, opt_state, xs, ys, ms
-            )
-            metrics = {k: float(v) for k, v in metrics.items()}
-            dt = time.perf_counter() - t0
-            metrics["epoch_time"] = dt
-            metrics["samples_per_sec"] = xb.shape[0] * xb.shape[1] / dt
-            if validation_data is not None:
-                vx, vy = validation_data
-                metrics.update(
-                    {
-                        f"val_{k}": v
-                        for k, v in self.evaluate(
-                            vx, vy, batch_size=batch_size, _params=params
-                        ).items()
-                    }
+
+        with self._mesh_bound():
+            if est.params is None:
+                est._init_params(jnp.asarray(x[:1]))
+            if self._epoch_fn is None or self._loss_kind != loss_kind:
+                self._epoch_fn, self._eval_fn = self._build(loss_kind)
+                self._loss_kind = loss_kind
+
+            params, opt_state = self._place_state()
+            rng = np.random.default_rng(est.seed)
+            for epoch_i in range(epochs):
+                t0 = time.perf_counter()
+                xb, yb, mb = _batch_data(
+                    x, y_arr, batch_size, rng if shuffle else _NoShuffle()
                 )
-            self.history.append(metrics)
-            if verbose:
-                print(f"epoch {epoch_i + 1}/{epochs}: {metrics}", flush=True)
+                xs = jax.device_put(
+                    xb, self._data_sharding(xb.ndim, tokens)
+                )
+                ys = jax.device_put(yb, self._data_sharding(yb.ndim, False))
+                ms = jax.device_put(mb, self._data_sharding(mb.ndim, False))
+                params, opt_state, metrics = self._epoch_fn(
+                    params, opt_state, xs, ys, ms
+                )
+                metrics = {k: float(v) for k, v in metrics.items()}
+                dt = time.perf_counter() - t0
+                metrics["epoch_time"] = dt
+                metrics["samples_per_sec"] = xb.shape[0] * xb.shape[1] / dt
+                if validation_data is not None:
+                    vx, vy = validation_data
+                    metrics.update(
+                        {
+                            f"val_{k}": v
+                            for k, v in self.evaluate(
+                                vx, vy, batch_size=batch_size,
+                                _params=params,
+                            ).items()
+                        }
+                    )
+                self.history.append(metrics)
+                if verbose:
+                    print(
+                        f"epoch {epoch_i + 1}/{epochs}: {metrics}",
+                        flush=True,
+                    )
 
         # Hand the trained state back to the estimator (host pytree) so the
         # artifact contract — any step re-executable from the stored binary
@@ -222,23 +257,24 @@ class DistributedTrainer:
         y_arr = y_arr.astype(
             np.int32 if loss_kind == "softmax_ce" else np.float32
         )
-        if self._eval_fn is None:
-            self._epoch_fn, self._eval_fn = self._build(loss_kind)
-            self._loss_kind = loss_kind
-        params = _params if _params is not None else est.params
-        # Round up to a shardable global batch instead of erroring — eval
-        # batch size is a throughput knob, not a semantic one.
-        batch_size = -(-max(1, batch_size) // self.data_axes) \
-            * self.data_axes
-        xb, yb, mb = _batch_data(x, y_arr, batch_size, _NoShuffle())
-        tokens = np.issubdtype(x.dtype, np.integer)
-        metrics = self._eval_fn(
-            params,
-            jax.device_put(xb, self._data_sharding(xb.ndim, tokens)),
-            jax.device_put(yb, self._data_sharding(yb.ndim, False)),
-            jax.device_put(mb, self._data_sharding(mb.ndim, False)),
-        )
-        return {k: float(v) for k, v in metrics.items()}
+        with self._mesh_bound():
+            if self._eval_fn is None:
+                self._epoch_fn, self._eval_fn = self._build(loss_kind)
+                self._loss_kind = loss_kind
+            params = _params if _params is not None else est.params
+            # Round up to a shardable global batch instead of erroring —
+            # eval batch size is a throughput knob, not a semantic one.
+            batch_size = -(-max(1, batch_size) // self.data_axes) \
+                * self.data_axes
+            xb, yb, mb = _batch_data(x, y_arr, batch_size, _NoShuffle())
+            tokens = np.issubdtype(x.dtype, np.integer)
+            metrics = self._eval_fn(
+                params,
+                jax.device_put(xb, self._data_sharding(xb.ndim, tokens)),
+                jax.device_put(yb, self._data_sharding(yb.ndim, False)),
+                jax.device_put(mb, self._data_sharding(mb.ndim, False)),
+            )
+            return {k: float(v) for k, v in metrics.items()}
 
 
 def distributed_fit(

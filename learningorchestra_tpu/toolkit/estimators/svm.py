@@ -1,0 +1,161 @@
+"""Support vector machines — JAX-native ``sklearn.svm`` surface.
+
+The reference exposes any ``sklearn.*`` class through its model service
+(reference: microservices/model_image/model.py:92-162,
+utils.py:151-159 signature validation); SVC/LinearSVC are the common
+classifiers missing from the rest of the estimator zoo.
+
+Design (TPU-idiomatic, not a libsvm port):
+- ``LinearSVC``: primal squared-hinge objective minimised by a jitted
+  ``lax.scan`` of optax-adam steps — one compiled loop, full-batch
+  matmuls on the MXU, no per-step host dispatch.
+- ``SVC``: kernelised via **random Fourier features** (Rahimi & Recht's
+  classic RBF approximation): z(x) = sqrt(2/D)·cos(xW + b) with
+  W ~ N(0, gamma·I).  The kernel trick becomes one feature matmul plus
+  the same primal solver — O(n·D) instead of the O(n²) Gram matrix /
+  data-dependent support-vector control flow that XLA can't tile.
+  ``kernel="linear"`` skips the feature map.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from learningorchestra_tpu.toolkit.base import (
+    Estimator,
+    as_array,
+    encode_classes,
+)
+from learningorchestra_tpu.toolkit.registry import register
+
+_MODULE = "learningorchestra_tpu.toolkit.estimators.svm"
+
+
+def _add_bias(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.concatenate([x, jnp.ones((x.shape[0], 1), x.dtype)], axis=1)
+
+
+def _fit_squared_hinge(x, y_pm, n_classes, c, learning_rate, max_iter):
+    """One-vs-rest squared-hinge SVM, all classes trained in one jitted
+    scan (weights shape (features, classes))."""
+    n, d = x.shape
+    w0 = jnp.zeros((d, n_classes), jnp.float32)
+    optimizer = optax.adam(learning_rate)
+
+    def objective(w):
+        margins = y_pm * (x @ w)  # (n, classes), y_pm in {-1, +1}
+        hinge = jnp.maximum(0.0, 1.0 - margins)
+        return 0.5 * jnp.sum(w * w) / n + c * jnp.mean(hinge ** 2)
+
+    def step(carry, _):
+        w, opt_state = carry
+        loss, grads = jax.value_and_grad(objective)(w)
+        updates, opt_state = optimizer.update(grads, opt_state, w)
+        return (optax.apply_updates(w, updates), opt_state), loss
+
+    (w, _), losses = jax.lax.scan(
+        step, (w0, optimizer.init(w0)), None, length=max_iter
+    )
+    return w, losses
+
+
+_fit_squared_hinge_jit = jax.jit(
+    _fit_squared_hinge, static_argnames=("n_classes", "max_iter")
+)
+
+
+class _HingeSVMBase(Estimator):
+    def __init__(self, C: float = 1.0, max_iter: int = 300,
+                 learning_rate: float = 0.05, random_state: int = 0):
+        self.C = C
+        self.max_iter = max_iter
+        self.learning_rate = learning_rate
+        self.random_state = random_state
+        self.coef_ = None
+        self.classes_ = None
+
+    # feature map hook (identity for the linear machine)
+    def _features(self, x: jnp.ndarray) -> jnp.ndarray:
+        return x
+
+    def _init_features(self, x: jnp.ndarray) -> None:
+        pass
+
+    def fit(self, x, y):
+        x = jnp.asarray(as_array(x), jnp.float32)
+        self.classes_, y_idx = encode_classes(y)
+        n_classes = max(2, len(self.classes_))
+        self._init_features(x)
+        feats = _add_bias(self._features(x))
+        onehot = jax.nn.one_hot(jnp.asarray(y_idx), n_classes)
+        y_pm = 2.0 * onehot - 1.0
+        self.coef_, self.losses_ = _fit_squared_hinge_jit(
+            feats, y_pm, n_classes, jnp.float32(self.C),
+            jnp.float32(self.learning_rate), self.max_iter,
+        )
+        return self
+
+    def decision_function(self, x):
+        x = jnp.asarray(as_array(x), jnp.float32)
+        return _add_bias(self._features(x)) @ self.coef_
+
+    def predict(self, x):
+        idx = np.asarray(jnp.argmax(self.decision_function(x), axis=-1))
+        return np.asarray(self.classes_)[idx]
+    # score() inherited from Estimator — handles string labels.
+
+
+@register(_MODULE)
+class LinearSVC(_HingeSVMBase):
+    """Primal linear SVM (squared hinge, one-vs-rest)."""
+
+
+@register(_MODULE)
+class SVC(_HingeSVMBase):
+    """RBF-kernel SVM via random Fourier features.
+
+    ``gamma``: "scale" (sklearn default, 1/(d·var)) or a float.
+    ``n_components``: feature-map width (quality/compute trade-off).
+    """
+
+    def __init__(self, C: float = 1.0, kernel: str = "rbf",
+                 gamma: str | float = "scale", n_components: int = 256,
+                 max_iter: int = 300, learning_rate: float = 0.05,
+                 random_state: int = 0):
+        super().__init__(C=C, max_iter=max_iter,
+                         learning_rate=learning_rate,
+                         random_state=random_state)
+        if kernel not in ("rbf", "linear"):
+            raise ValueError(f"unsupported kernel: {kernel!r}")
+        self.kernel = kernel
+        self.gamma = gamma
+        self.n_components = n_components
+        self._w = None
+        self._b = None
+
+    def _init_features(self, x: jnp.ndarray) -> None:
+        if self.kernel == "linear":
+            return
+        d = x.shape[1]
+        if self.gamma == "scale":
+            var = float(jnp.var(x))
+            gamma = 1.0 / (d * var) if var > 0 else 1.0 / d
+        else:
+            gamma = float(self.gamma)
+        key = jax.random.PRNGKey(self.random_state)
+        kw, kb = jax.random.split(key)
+        self._w = jax.random.normal(
+            kw, (d, self.n_components), jnp.float32
+        ) * jnp.sqrt(2.0 * gamma)
+        self._b = jax.random.uniform(
+            kb, (self.n_components,), jnp.float32, 0.0, 2.0 * jnp.pi
+        )
+
+    def _features(self, x: jnp.ndarray) -> jnp.ndarray:
+        if self.kernel == "linear":
+            return x
+        proj = x @ self._w + self._b
+        return jnp.sqrt(2.0 / self.n_components) * jnp.cos(proj)

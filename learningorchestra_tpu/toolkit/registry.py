@@ -33,6 +33,7 @@ MODULE_ALIASES = {
     "sklearn.preprocessing":
         "learningorchestra_tpu.toolkit.estimators.preprocessing",
     "sklearn.neighbors": "learningorchestra_tpu.toolkit.estimators.neighbors",
+    "sklearn.svm": "learningorchestra_tpu.toolkit.estimators.svm",
     "tensorflow.keras.applications": "learningorchestra_tpu.models.vision",
     "tensorflow.keras.models": "learningorchestra_tpu.models",
     "torch.nn": "learningorchestra_tpu.models",
@@ -74,9 +75,11 @@ def _ensure_loaded() -> None:
         "learningorchestra_tpu.toolkit.estimators.decomposition",
         "learningorchestra_tpu.toolkit.estimators.preprocessing",
         "learningorchestra_tpu.toolkit.estimators.neighbors",
+        "learningorchestra_tpu.toolkit.estimators.svm",
         "learningorchestra_tpu.models.mlp",
         "learningorchestra_tpu.models.vision",
         "learningorchestra_tpu.models.text",
+        "learningorchestra_tpu.models.longcontext",
     ):
         importlib.import_module(mod)
 

@@ -248,3 +248,33 @@ class TestNativeConcurrency:
         docs = store.find("c")
         assert len(docs) == 1600
         assert len({d["_id"] for d in docs}) == 1600
+
+
+class TestThreadSanitizer:
+    def test_tsan_stress_clean(self, tmp_path):
+        """Build the -fsanitize=thread stress binary and run it: any data
+        race in the native store fails this test (TSAN halt_on_error).
+        The reference ships no race detection at all (SURVEY §5.2)."""
+        import os
+        import subprocess
+
+        native_dir = (
+            __import__("pathlib").Path(__file__).parent.parent / "native"
+        )
+        try:
+            build = subprocess.run(
+                ["make", "-C", str(native_dir), "tsan"],
+                capture_output=True, timeout=120,
+            )
+        except FileNotFoundError:
+            pytest.skip("make not installed")
+        if build.returncode != 0:
+            pytest.skip(f"tsan build unavailable: {build.stderr[-200:]}")
+        run = subprocess.run(
+            [str(native_dir / "build" / "stress_tsan"), str(tmp_path / "s")],
+            capture_output=True, timeout=120,
+            env={**os.environ, "TSAN_OPTIONS": "halt_on_error=1"},
+        )
+        assert run.returncode == 0, (
+            run.stdout[-500:], run.stderr[-2000:]
+        )

@@ -148,3 +148,66 @@ def test_tsne_runs_small():
     emb = np.asarray(tsne.fit_transform(x))
     assert emb.shape == (60, 2)
     assert np.isfinite(emb).all()
+
+
+class TestSVM:
+    def _blobs(self, n=200, seed=0):
+        rng = np.random.default_rng(seed)
+        x0 = rng.normal((-2, -2), 0.8, (n // 2, 2))
+        x1 = rng.normal((2, 2), 0.8, (n // 2, 2))
+        x = np.vstack([x0, x1]).astype(np.float32)
+        y = np.array([0] * (n // 2) + [1] * (n // 2))
+        return x, y
+
+    def _rings(self, n=300, seed=1):
+        """Radially-separated classes — linearly inseparable."""
+        rng = np.random.default_rng(seed)
+        theta = rng.uniform(0, 2 * np.pi, n)
+        r = np.where(np.arange(n) % 2 == 0, 1.0, 3.0)
+        r = r + rng.normal(0, 0.15, n)
+        x = np.stack([r * np.cos(theta), r * np.sin(theta)], 1)
+        return x.astype(np.float32), (np.arange(n) % 2)
+
+    def test_linear_svc_separable(self):
+        from learningorchestra_tpu.toolkit.estimators.svm import LinearSVC
+
+        x, y = self._blobs()
+        clf = LinearSVC().fit(x, y)
+        assert clf.score(x, y) > 0.97
+
+    def test_svc_rbf_nonlinear(self):
+        from learningorchestra_tpu.toolkit.estimators.svm import SVC
+
+        x, y = self._rings()
+        rbf = SVC(C=5.0, max_iter=500).fit(x, y)
+        lin = SVC(kernel="linear").fit(x, y)
+        assert rbf.score(x, y) > 0.9
+        assert rbf.score(x, y) > lin.score(x, y) + 0.2  # kernel matters
+
+    def test_svc_multiclass_and_labels(self):
+        from learningorchestra_tpu.toolkit.estimators.svm import LinearSVC
+
+        rng = np.random.default_rng(2)
+        centers = np.array([[0, 4], [4, 0], [-4, 0]])
+        x = np.vstack([
+            rng.normal(c, 0.5, (40, 2)) for c in centers
+        ]).astype(np.float32)
+        y = np.array(["a"] * 40 + ["b"] * 40 + ["c"] * 40)
+        clf = LinearSVC().fit(x, y)
+        preds = clf.predict(x)
+        assert set(preds) <= {"a", "b", "c"}
+        assert float(np.mean(preds == y)) > 0.95
+
+    def test_registry_alias(self):
+        from learningorchestra_tpu.toolkit import registry
+
+        cls = registry.resolve("sklearn.svm", "SVC")
+        assert cls.__name__ == "SVC"
+
+    def test_string_label_score(self):
+        from learningorchestra_tpu.toolkit.estimators.svm import LinearSVC
+
+        x, y = self._blobs()
+        labels = np.where(y == 0, "neg", "pos")
+        clf = LinearSVC().fit(x, labels)
+        assert clf.score(x, labels) > 0.97
