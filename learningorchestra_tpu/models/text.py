@@ -108,6 +108,10 @@ class BertEncoder(nn.Module):
     max_len: int = 512
     dtype: jnp.dtype = jnp.float32
     use_flash: bool | None = None
+    # jax.checkpoint each block: activations rematerialize in the
+    # backward pass — trades ~1 extra forward of FLOPs for O(layers)
+    # less HBM, the standard long-sequence/large-batch headroom knob.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens):
@@ -124,13 +128,21 @@ class BertEncoder(nn.Module):
         # for every non-pad query row; pad query rows produce values no
         # one reads — the [CLS] head pools position 0 only.
         pad_mask = tokens != 0  # (B, T)
-        for _ in range(self.num_layers):
-            x = TransformerBlock(
+        block_cls = nn.remat(TransformerBlock) if self.remat \
+            else TransformerBlock
+        for i in range(self.num_layers):
+            # Explicit names keep the parameter tree identical whether
+            # remat is on or off (auto-naming would differ:
+            # CheckpointTransformerBlock_i vs TransformerBlock_i) AND
+            # match the historical auto-names, so stored artifacts
+            # survive toggling the memory knob.
+            x = block_cls(
                 hidden_dim=self.hidden_dim,
                 num_heads=self.num_heads,
                 mlp_dim=self.mlp_dim,
                 dtype=self.dtype,
                 use_flash=self.use_flash,
+                name=f"TransformerBlock_{i}",
             )(x, key_mask=pad_mask)
         return nn.LayerNorm(dtype=self.dtype)(x)
 
@@ -166,6 +178,7 @@ class BertModel(NeuralEstimator):
         num_classes: int = 2,
         learning_rate: float = 2e-5,
         seed: int = 0,
+        remat: bool = False,
     ):
         self.vocab_size = vocab_size
         self.hidden_dim = hidden_dim
@@ -174,6 +187,7 @@ class BertModel(NeuralEstimator):
         self.mlp_dim = mlp_dim or hidden_dim * 4
         self.max_len = max_len
         self.num_classes = num_classes
+        self.remat = remat
         encoder = BertEncoder(
             vocab_size=vocab_size,
             hidden_dim=hidden_dim,
@@ -181,6 +195,7 @@ class BertModel(NeuralEstimator):
             num_heads=num_heads,
             mlp_dim=self.mlp_dim,
             max_len=max_len,
+            remat=remat,
         )
         super().__init__(
             _BertClassifier(encoder=encoder, num_classes=num_classes),

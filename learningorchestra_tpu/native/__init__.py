@@ -100,6 +100,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.lods_compact.argtypes = [i64, c_char_p]
     lib.lods_csv_parse.argtypes = [c_char_p, i64, ctypes.c_int, p_i64]
     lib.lods_csv_parse.restype = buf_t
+    lib.lods_project.argtypes = [i64, c_char_p, c_char_p, c_char_p]
+    lib.lods_project.restype = i64
     return lib
 
 
@@ -338,6 +340,17 @@ class NativeDocumentStore:
                 key = json.dumps(key, default=str)
             counts[key] = counts.get(key, 0) + rec["n"]
         return counts
+
+    def project(self, src: str, dst: str, fields: list[str]) -> int:
+        """Native column projection src → dst (data rows only); returns
+        rows written.  The Spark-projection replacement (SURVEY §2.3)."""
+        n = self._lib.lods_project(
+            self._h, src.encode(), dst.encode(),
+            "\n".join(fields).encode(),
+        )
+        if n < 0:
+            _raise_native(self._lib)
+        return int(n)
 
     # -- maintenance --------------------------------------------------------
 

@@ -136,6 +136,18 @@ class DistributedTrainer:
             opt_state = fresh
         return params, opt_state
 
+    def _check_seq_divisible(self, x: np.ndarray) -> None:
+        """Friendly error for sequence lengths the sp axis can't shard
+        (otherwise shard_map fails with an opaque divisibility error)."""
+        sp = self.mesh.shape.get("sp", 1)
+        if (
+            self.shard_sequence and sp > 1 and x.ndim > 1
+            and np.issubdtype(x.dtype, np.integer) and x.shape[1] % sp
+        ):
+            raise ValueError(
+                f"sequence length {x.shape[1]} not divisible by sp={sp}"
+            )
+
     # -- step construction --------------------------------------------------
 
     def _build(self, loss_kind: str):
@@ -179,15 +191,10 @@ class DistributedTrainer:
                 f"global batch_size {batch_size} not divisible by "
                 f"dp*fsdp={self.data_axes}"
             )
-        sp = self.mesh.shape.get("sp", 1)
         tokens = np.issubdtype(x.dtype, np.integer)
-        if (
-            self.shard_sequence and tokens and sp > 1
-            and x.ndim > 1 and x.shape[1] % sp
-        ):
-            raise ValueError(
-                f"sequence length {x.shape[1]} not divisible by sp={sp}"
-            )
+        self._check_seq_divisible(x)
+        if validation_data is not None:
+            self._check_seq_divisible(np.asarray(validation_data[0]))
 
         with self._mesh_bound():
             if est.params is None:
@@ -257,6 +264,7 @@ class DistributedTrainer:
         y_arr = y_arr.astype(
             np.int32 if loss_kind == "softmax_ce" else np.float32
         )
+        self._check_seq_divisible(x)
         with self._mesh_bound():
             if self._eval_fn is None:
                 self._epoch_fn, self._eval_fn = self._build(loss_kind)

@@ -51,6 +51,12 @@ class TransformService:
         )
 
         def project():
+            if hasattr(self.ctx.documents, "project"):
+                # Native scan: rows never materialize as Python objects
+                # (the reference runs this as a Spark job over the
+                # mongo connector; projection_image/projection.py:20-48).
+                n = self.ctx.documents.project(parent_name, name, fields)
+                return {"rows": n}
             docs = self.ctx.documents.find(
                 parent_name,
                 query={"_id": {"$gte": 1}, "docType": {"$ne": "execution"}},

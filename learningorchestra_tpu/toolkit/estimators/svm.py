@@ -87,7 +87,12 @@ class _HingeSVMBase(Estimator):
     def fit(self, x, y):
         x = jnp.asarray(as_array(x), jnp.float32)
         self.classes_, y_idx = encode_classes(y)
-        n_classes = max(2, len(self.classes_))
+        if len(self.classes_) < 2:
+            raise ValueError(
+                "fit needs at least 2 classes; got "
+                f"{list(self.classes_)!r}"
+            )
+        n_classes = len(self.classes_)
         self._init_features(x)
         feats = _add_bias(self._features(x))
         onehot = jax.nn.one_hot(jnp.asarray(y_idx), n_classes)

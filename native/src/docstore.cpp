@@ -818,6 +818,88 @@ int lods_compact(int64_t h, const char *name) {
   return coll->open_log() ? 0 : -1;
 }
 
+// Project selected top-level fields of every data row of src into a new
+// collection dst — the reference's Spark-executed column projection
+// (projection_image/projection.py:20-48) as a native scan.  Skips the
+// metadata doc (_id=0) and execution-ledger docs; missing fields become
+// null (matching the Python path's d.get(f)).  fields_nl: '\n'-separated
+// field names.  Returns rows written, or -1.
+int64_t lods_project(int64_t h, const char *src_name, const char *dst_name,
+                     const char *fields_nl) {
+  Store *st = store_for(h);
+  if (!st) return -1;
+  std::shared_ptr<Collection> src = st->get(src_name, false);
+  if (!src) return -1;
+
+  std::vector<std::string> fields;
+  {
+    const char *p = fields_nl;
+    while (*p) {
+      const char *q = p;
+      while (*q && *q != '\n') q++;
+      if (q > p) fields.emplace_back(p, q - p);
+      p = *q ? q + 1 : q;
+    }
+  }
+
+  // Snapshot the projected rows under the src lock, then release it
+  // before taking the dst lock (no ordering between collections).
+  std::vector<std::string> rows;
+  {
+    std::lock_guard<std::mutex> lock(src->mu);
+    rows.reserve(src->docs.size());
+    std::vector<KV> pairs;
+    for (auto &kv : src->docs) {
+      if (kv.first == 0) continue;
+      pairs.clear();
+      if (!parse_object(kv.second, pairs)) continue;
+      bool is_exec = false;
+      for (auto &pair : pairs) {
+        if (pair.key == "docType" && pair.raw_val == "\"execution\"") {
+          is_exec = true;
+          break;
+        }
+      }
+      if (is_exec) continue;
+      std::string out = "{";
+      for (size_t i = 0; i < fields.size(); i++) {
+        if (i) out += ',';
+        json_escape(fields[i], out);
+        out += ':';
+        const std::string *val = nullptr;
+        for (auto &pair : pairs) {
+          if (pair.key == fields[i]) {
+            val = &pair.raw_val;
+            break;
+          }
+        }
+        out += val ? *val : "null";
+      }
+      out += "}";
+      rows.push_back(std::move(out));
+    }
+  }
+
+  std::shared_ptr<Collection> dst = st->get(dst_name, true);
+  if (!dst) return -1;
+  std::lock_guard<std::mutex> lock(dst->mu);
+  std::string batch;
+  for (auto &row : rows) {
+    long long id = dst->next_id++;
+    std::string doc = with_id(row, id);
+    dst->docs[id] = doc;
+    batch += "{\"op\":\"i\",\"d\":";
+    batch += doc;
+    batch += "}\n";
+  }
+  if (!batch.empty() && dst->fh) {
+    fwrite(batch.data(), 1, batch.size(), dst->fh);
+    fflush(dst->fh);
+    if (dst->durable) fsync(fileno(dst->fh));
+  }
+  return (int64_t)rows.size();
+}
+
 // ---------------------------------------------------------------------------
 // CSV → JSONL docs.  Output: first line is the cleaned header as a JSON
 // array; each following line is a document object (no _id) ready for

@@ -278,3 +278,22 @@ class TestThreadSanitizer:
         assert run.returncode == 0, (
             run.stdout[-500:], run.stderr[-2000:]
         )
+
+
+class TestNativeProjection:
+    def test_project_matches_python_semantics(self, store):
+        store.insert_unique("src", {"name": "src", "finished": True}, 0)
+        store.insert_many("src", [
+            {"a": i, "b": i * 2, "c": f"s{i}"} for i in range(10)
+        ])
+        store.insert_one("src", {"a": 99, "docType": "execution"})
+        store.insert_unique("dst", {"name": "dst"}, 0)  # metadata first
+        n = store.project("src", "dst", ["a", "c", "missing"])
+        assert n == 10  # execution doc and metadata excluded
+        rows = [d for d in store.find("dst") if d["_id"] >= 1]
+        assert rows[0] == {"a": 0, "c": "s0", "missing": None, "_id": 1}
+        assert rows[-1]["a"] == 9
+
+    def test_project_missing_source(self, store):
+        with pytest.raises((NoSuchCollection, RuntimeError)):
+            store.project("ghost", "dst2", ["a"])

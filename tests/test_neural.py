@@ -165,3 +165,21 @@ class TestCheckpointing:
         )
         steps = sorted(p.name for p in ckdir.glob("step_*"))
         assert steps == ["step_4", "step_5"]
+
+
+def test_bert_remat_trains_and_matches():
+    """remat=True must change memory, not math."""
+    from learningorchestra_tpu.models.text import BertModel
+
+    rng = np.random.default_rng(0)
+    x = rng.integers(1, 32, (8, 8), dtype=np.int32)
+    y = rng.integers(0, 2, (8,), dtype=np.int32)
+    kwargs = dict(vocab_size=32, hidden_dim=16, num_layers=2, num_heads=2,
+                  max_len=8, seed=7)
+    plain = BertModel(**kwargs)
+    remat = BertModel(remat=True, **kwargs)
+    plain.fit(x, y, epochs=1, batch_size=8, shuffle=False)
+    remat.fit(x, y, epochs=1, batch_size=8, shuffle=False)
+    np.testing.assert_allclose(
+        plain.history["loss"], remat.history["loss"], rtol=1e-4
+    )
