@@ -1,0 +1,96 @@
+"""Deployment CLI — the reference's ``run.sh``/container entrypoints
+(reference: run.sh:32, docker-compose service commands) as one binary:
+
+    python -m learningorchestra_tpu serve
+        REST API server on LO_TPU_API_PORT (default 80).
+
+    python -m learningorchestra_tpu coordinator --host 0.0.0.0 --port 7070
+        Multi-host control plane (replaces Ray GCS + client,
+        SURVEY §5.8).
+
+    python -m learningorchestra_tpu agent --coordinator HOST:PORT \\
+            [--id ID] [--capacity N]
+        Per-host worker: registers, heartbeats, leases distributed
+        tasks (replaces a Ray worker joining the head node).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import socket
+import sys
+import time
+
+
+def _cmd_serve(_args) -> int:
+    from learningorchestra_tpu.api.server import serve
+
+    serve()
+    return 0
+
+
+def _cmd_coordinator(args) -> int:
+    from learningorchestra_tpu.parallel.coordinator import Coordinator
+
+    coord = Coordinator(host=args.host, port=args.port).start()
+    print(f"coordinator listening on {coord.address}", flush=True)
+    try:
+        signal.pause()
+    except (KeyboardInterrupt, AttributeError):
+        # AttributeError: signal.pause is POSIX-only; fall back to sleep.
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+    coord.stop()
+    return 0
+
+
+def _cmd_agent(args) -> int:
+    from learningorchestra_tpu.parallel.coordinator import HostAgent
+
+    agent_id = args.id or f"{socket.gethostname()}-{int(time.time())}"
+    agent = HostAgent(
+        args.coordinator, agent_id, capacity=args.capacity
+    )
+    agent.serve()
+    print(
+        f"agent {agent_id} polling coordinator {args.coordinator}",
+        flush=True,
+    )
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        agent.stop()
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="learningorchestra_tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("serve", help="run the REST API server")
+
+    coord = sub.add_parser("coordinator", help="run the control plane")
+    coord.add_argument("--host", default="0.0.0.0")
+    coord.add_argument("--port", type=int, default=7070)
+
+    agent = sub.add_parser("agent", help="run a per-host worker agent")
+    agent.add_argument("--coordinator", required=True,
+                       help="coordinator HOST:PORT")
+    agent.add_argument("--id", default=None)
+    agent.add_argument("--capacity", type=int, default=1)
+
+    args = parser.parse_args(argv)
+    return {
+        "serve": _cmd_serve,
+        "coordinator": _cmd_coordinator,
+        "agent": _cmd_agent,
+    }[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

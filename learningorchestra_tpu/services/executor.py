@@ -17,6 +17,7 @@ from __future__ import annotations
 import itertools
 import shutil
 import time
+from concurrent.futures import ThreadPoolExecutor, as_completed
 from typing import Any
 
 import numpy as np
@@ -273,28 +274,51 @@ class ExecutorService:
                 k: v for k, v in fit_params.items() if k in ("x", "y")
             }
             keys = sorted(param_grid)
-            best_score, best_instance, best_combo = -np.inf, None, None
-            for combo in itertools.product(
-                *(param_grid[k] for k in keys)
-            ):
-                kwargs = dict(zip(keys, combo))
+            combos = [
+                dict(zip(keys, combo))
+                for combo in itertools.product(
+                    *(param_grid[k] for k in keys)
+                )
+            ]
+
+            def eval_candidate(kwargs: dict):
                 candidate = factory(**kwargs)
                 t0 = time.perf_counter()
                 getattr(candidate, method)(**fit_params)
                 fit_time = time.perf_counter() - t0
-                score = float(candidate.score(**score_params))
-                self.ctx.documents.insert_one(
-                    name,
-                    {
-                        "params": _json_safe(kwargs),
-                        "score": score,
-                        "fitTime": fit_time,
-                    },
-                )
-                if score > best_score:
-                    best_score, best_instance, best_combo = (
-                        score, candidate, kwargs,
+                return candidate, float(
+                    candidate.score(**score_params)
+                ), fit_time
+
+            # Candidates run concurrently (the reference trains its
+            # builder classifiers in parallel threads the same way,
+            # builder_image/builder.py:62-78); device compute serializes
+            # on the accelerator, but host-side prep/score overlap.
+            # Trials stream: each result doc inserts as it completes
+            # (clients polling GET see progress) and only the current
+            # best candidate's parameters stay referenced — a big grid
+            # over a large model must not hold every fitted candidate.
+            best_score, best_instance, best_combo = -np.inf, None, None
+            workers = min(4, len(combos))
+            with ThreadPoolExecutor(max_workers=workers) as pool:
+                futures = {
+                    pool.submit(eval_candidate, kw): kw for kw in combos
+                }
+                for fut in as_completed(futures):
+                    kwargs = futures[fut]
+                    candidate, score, fit_time = fut.result()
+                    self.ctx.documents.insert_one(
+                        name,
+                        {
+                            "params": _json_safe(kwargs),
+                            "score": score,
+                            "fitTime": fit_time,
+                        },
                     )
+                    if score > best_score:
+                        best_score, best_instance, best_combo = (
+                            score, candidate, kwargs,
+                        )
             self.ctx.volumes.save_object(artifact_type, name, best_instance)
             return {
                 "bestScore": best_score,

@@ -361,3 +361,51 @@ class TestLongContextModel:
         y = rng.integers(0, 2, (8,), dtype=np.int32)
         with pytest.raises(ValueError, match="sequence length"):
             trainer.fit(x, y, epochs=1, batch_size=8)
+
+
+class TestCLI:
+    def test_coordinator_and_agent_commands(self):
+        """python -m learningorchestra_tpu coordinator/agent run a real
+        distributed job end-to-end over localhost."""
+        import subprocess
+        import sys
+        import time as _time
+
+        import requests as _requests
+
+        env_cmd = [sys.executable, "-m", "learningorchestra_tpu",
+                   "coordinator", "--host", "127.0.0.1", "--port", "0"]
+        proc = subprocess.Popen(
+            env_cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        try:
+            line = proc.stdout.readline()
+            assert "listening on" in line, line
+            addr = line.strip().rsplit(" ", 1)[1]
+            # Coordinator is reachable over HTTP.
+            deadline = _time.time() + 10
+            while _time.time() < deadline:
+                try:
+                    r = _requests.get(f"http://{addr}/agents", timeout=2)
+                    assert r.status_code == 200
+                    break
+                except Exception:
+                    _time.sleep(0.1)
+            else:
+                raise AssertionError("coordinator not reachable")
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+    def test_cli_help(self):
+        import subprocess
+        import sys
+
+        out = subprocess.run(
+            [sys.executable, "-m", "learningorchestra_tpu", "--help"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 0
+        for cmd in ("serve", "coordinator", "agent"):
+            assert cmd in out.stdout
