@@ -37,8 +37,10 @@ def _cmd_coordinator(args) -> int:
     print(f"coordinator listening on {coord.address}", flush=True)
     try:
         signal.pause()
-    except (KeyboardInterrupt, AttributeError):
-        # AttributeError: signal.pause is POSIX-only; fall back to sleep.
+    except KeyboardInterrupt:
+        pass
+    except AttributeError:
+        # signal.pause is POSIX-only; fall back to a sleep loop.
         try:
             while True:
                 time.sleep(3600)

@@ -175,8 +175,16 @@ class DistributedTrainer:
         validation_data: tuple | None = None,
         shuffle: bool = True,
         verbose: int = 0,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 1,
+        checkpoint_min_interval_s: float = 60.0,
+        resume: bool = True,
         **_,
     ) -> "DistributedTrainer":
+        """Same managed in-loop checkpointing contract as the
+        single-device ``NeuralEstimator.fit`` — sharded state gathers to
+        host at save points (``jax.device_get``), so a preempted
+        distributed job resumes on any mesh shape."""
         est = self.estimator
         x = np.asarray(as_array(x))
         y_arr = np.asarray(y if not hasattr(y, "to_numpy") else y.to_numpy())
@@ -196,16 +204,31 @@ class DistributedTrainer:
         if validation_data is not None:
             self._check_seq_divisible(np.asarray(validation_data[0]))
 
+        start_epoch = 0
         with self._mesh_bound():
             if est.params is None:
                 est._init_params(jnp.asarray(x[:1]))
+            if checkpoint_dir and resume:
+                from learningorchestra_tpu.train import checkpoint as ckpt
+
+                loaded = ckpt.load_latest(
+                    checkpoint_dir,
+                    {"params": est.params, "opt_state": est.opt_state},
+                )
+                if loaded is not None:
+                    state, step, past_history = loaded
+                    est.params = state["params"]
+                    est.opt_state = state["opt_state"]
+                    self.history = TrainHistory(past_history)
+                    start_epoch = step
             if self._epoch_fn is None or self._loss_kind != loss_kind:
                 self._epoch_fn, self._eval_fn = self._build(loss_kind)
                 self._loss_kind = loss_kind
 
             params, opt_state = self._place_state()
             rng = np.random.default_rng(est.seed)
-            for epoch_i in range(epochs):
+            last_save = time.monotonic()
+            for epoch_i in range(start_epoch, epochs):
                 t0 = time.perf_counter()
                 xb, yb, mb = _batch_data(
                     x, y_arr, batch_size, rng if shuffle else _NoShuffle()
@@ -234,6 +257,25 @@ class DistributedTrainer:
                         }
                     )
                 self.history.append(metrics)
+                final = epoch_i + 1 == epochs
+                if checkpoint_dir and checkpoint_every > 0 and (
+                    final
+                    or (
+                        (epoch_i + 1) % checkpoint_every == 0
+                        and time.monotonic() - last_save
+                        >= checkpoint_min_interval_s
+                    )
+                ):
+                    from learningorchestra_tpu.train import (
+                        checkpoint as ckpt,
+                    )
+
+                    ckpt.save(
+                        checkpoint_dir, epoch_i + 1,
+                        {"params": params, "opt_state": opt_state},
+                        history=dict(self.history),
+                    )
+                    last_save = time.monotonic()
                 if verbose:
                     print(
                         f"epoch {epoch_i + 1}/{epochs}: {metrics}",
@@ -245,8 +287,9 @@ class DistributedTrainer:
         # (SURVEY §5.4) — holds regardless of which path trained it.
         est.params = jax.device_get(params)
         est.opt_state = jax.device_get(opt_state)
+        ran = epochs - start_epoch  # epochs executed THIS call
         n_epochs = len(self.history.get("loss", ()))
-        for i in range(n_epochs - epochs, n_epochs):
+        for i in range(n_epochs - ran, n_epochs):
             est.history.append(
                 {k: v[i] for k, v in self.history.items() if len(v) > i}
             )

@@ -20,6 +20,13 @@ from learningorchestra_tpu.store import (
 )
 
 
+import re
+
+# Same shape the document store enforces (document_store._NAME_RE):
+# first char word-like, no separators — '..' and '/x' can never match.
+_ARTIFACT_NAME_RE = re.compile(r"[A-Za-z0-9_][A-Za-z0-9_.\-]*")
+
+
 class ValidationError(Exception):
     """Semantic request error → HTTP 406 (reference's NOT_ACCEPTABLE)."""
 
@@ -70,6 +77,12 @@ class ServiceContext:
     def require_new_name(self, name: str) -> None:
         if not name or not isinstance(name, str):
             raise ValidationError("missing or invalid 'name'")
+        # Artifact names become collection files, volume paths AND
+        # checkpoint directories; reject path-shaped names here (406)
+        # rather than relying on the store's internal gate (500) — and
+        # never let '..'/absolute names reach a shutil.rmtree.
+        if not _ARTIFACT_NAME_RE.fullmatch(name):
+            raise ValidationError(f"invalid artifact name: {name!r}")
         if self.artifacts.metadata.exists(name):
             raise ConflictError(f"duplicate artifact name: {name!r}")
 

@@ -164,6 +164,19 @@ class DistributedExecutorService:
                 )
             spec = MeshSpec.from_dict(mesh) if mesh else None
             trainer = DistributedTrainer(instance, spec=spec)
+            if "checkpoint_dir" not in params:
+                # Managed in-loop checkpoints for the flagship
+                # distributed path too (train/checkpoint.py).  The
+                # route is POST-only (reference parity), so a fresh
+                # create wipes any stale tree; users resume explicitly
+                # by passing their own checkpoint parameters.
+                import shutil as _shutil
+
+                ckdir = self.ctx.volumes.root / "_checkpoints" / name
+                if ckdir.exists():
+                    _shutil.rmtree(ckdir, ignore_errors=True)
+                params["checkpoint_dir"] = str(ckdir)
+                params["resume"] = False
             t0 = time.perf_counter()
             if session_name is not None:
                 with self.monitoring.trace(session_name):

@@ -304,21 +304,31 @@ class ExecutorService:
                 futures = {
                     pool.submit(eval_candidate, kw): kw for kw in combos
                 }
-                for fut in as_completed(futures):
-                    kwargs = futures[fut]
-                    candidate, score, fit_time = fut.result()
-                    self.ctx.documents.insert_one(
-                        name,
-                        {
-                            "params": _json_safe(kwargs),
-                            "score": score,
-                            "fitTime": fit_time,
-                        },
-                    )
-                    if score > best_score:
-                        best_score, best_instance, best_combo = (
-                            score, candidate, kwargs,
+                try:
+                    for fut in as_completed(list(futures)):
+                        # pop: a consumed future (and its non-best
+                        # candidate) must become collectable now, not
+                        # when the pool exits.
+                        kwargs = futures.pop(fut)
+                        candidate, score, fit_time = fut.result()
+                        self.ctx.documents.insert_one(
+                            name,
+                            {
+                                "params": _json_safe(kwargs),
+                                "score": score,
+                                "fitTime": fit_time,
+                            },
                         )
+                        if score > best_score:
+                            best_score, best_instance, best_combo = (
+                                score, candidate, kwargs,
+                            )
+                except Exception:
+                    # First failure aborts the search: don't burn the
+                    # accelerator fitting the remaining queued combos.
+                    for pending in futures:
+                        pending.cancel()
+                    raise
             self.ctx.volumes.save_object(artifact_type, name, best_instance)
             return {
                 "bestScore": best_score,

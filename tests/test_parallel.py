@@ -409,3 +409,112 @@ class TestCLI:
         assert out.returncode == 0
         for cmd in ("serve", "coordinator", "agent"):
             assert cmd in out.stdout
+
+
+class TestMultiHostDCN:
+    def test_two_process_global_collective(self, tmp_path):
+        """init_multihost joins two real processes into one JAX runtime;
+        a cross-process reduction runs over the inter-host transport
+        (CPU/Gloo here, DCN on pods) — the reference's Gloo ring
+        equivalent (SURVEY §5.8), minus Horovod."""
+        import socket
+        import subprocess
+        import sys
+        import textwrap
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+
+        worker = tmp_path / "worker.py"
+        worker.write_text(textwrap.dedent(f"""
+            import os, sys
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+            import jax._src.xla_bridge as _xb
+            if not _xb._backends:
+                _xb._backend_factories.pop("axon", None)
+                jax.config.update("jax_platforms", "cpu")
+            sys.path.insert(0, {str(__import__('pathlib').Path(__file__).parent.parent)!r})
+            import numpy as np
+            import jax.numpy as jnp
+            from jax.sharding import Mesh, NamedSharding
+            from jax.sharding import PartitionSpec as P
+            from learningorchestra_tpu.parallel.coordinator import (
+                init_multihost,
+            )
+            pid = int(sys.argv[1])
+            init_multihost("127.0.0.1:{port}", 2, pid)
+            assert jax.process_count() == 2
+            devs = jax.devices()
+            mesh = Mesh(devs, ("dp",))
+            arr = jax.make_array_from_process_local_data(
+                NamedSharding(mesh, P("dp")), np.ones((1,)) * (pid + 1)
+            )
+            total = jax.jit(
+                lambda a: jnp.sum(a),
+                out_shardings=NamedSharding(mesh, P()),
+            )(arr)
+            assert float(total) == 3.0, float(total)
+            print("RANK_OK", pid, flush=True)
+        """))
+        # One device per process: drop conftest's 8-virtual-device flag.
+        env = {
+            k: v for k, v in __import__("os").environ.items()
+            if k != "XLA_FLAGS"
+        }
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(worker), str(i)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env,
+            )
+            for i in range(2)
+        ]
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+        for i, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {i}:\n{out[-2000:]}"
+            assert f"RANK_OK {i}" in out
+
+
+class TestDistributedCheckpointing:
+    def test_distributed_fit_checkpoints_and_resumes(self, tmp_path):
+        from learningorchestra_tpu.models.mlp import MLPClassifier
+        from learningorchestra_tpu.parallel.distributed import (
+            DistributedTrainer,
+        )
+        from learningorchestra_tpu.parallel.mesh import MeshSpec, build_mesh
+        from learningorchestra_tpu.train import checkpoint as ckpt
+
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((64, 4)).astype(np.float32)
+        y = (x.sum(1) > 0).astype(np.int32)
+        ckdir = tmp_path / "dck"
+        mesh = build_mesh(MeshSpec(dp=8))
+
+        est = MLPClassifier(hidden_layer_sizes=[8], num_classes=2, seed=5)
+        DistributedTrainer(est, mesh=mesh).fit(
+            x, y, epochs=2, batch_size=16, checkpoint_dir=str(ckdir),
+            checkpoint_min_interval_s=0.0,
+        )
+        loaded = ckpt.load_latest(
+            str(ckdir), {"params": est.params, "opt_state": est.opt_state}
+        )
+        assert loaded is not None and loaded[1] == 2
+
+        # Fresh estimator resumes at epoch 2 and continues to 4.
+        est2 = MLPClassifier(hidden_layer_sizes=[8], num_classes=2, seed=5)
+        tr = DistributedTrainer(est2, mesh=mesh)
+        tr.fit(
+            x, y, epochs=4, batch_size=16, checkpoint_dir=str(ckdir),
+            checkpoint_min_interval_s=0.0,
+        )
+        assert len(tr.history["loss"]) == 4
+        assert len(est2.history["loss"]) == 2  # only the 2 epochs it ran
+        loaded = ckpt.load_latest(
+            str(ckdir), {"params": est2.params, "opt_state": est2.opt_state}
+        )
+        assert loaded[1] == 4
