@@ -11,7 +11,13 @@ layer drives by reflection.
 """
 
 from learningorchestra_tpu.models.mlp import MLPClassifier, MLPRegressor
-from learningorchestra_tpu.models.vision import MnistCNN, ResNet18, ResNet50
+from learningorchestra_tpu.models.vision import (
+    MnistCNN,
+    MobileNet,
+    ResNet18,
+    ResNet50,
+    VGG16,
+)
 from learningorchestra_tpu.models.text import (
     LSTMClassifier,
     TransformerClassifier,
@@ -25,6 +31,8 @@ __all__ = [
     "MnistCNN",
     "ResNet18",
     "ResNet50",
+    "VGG16",
+    "MobileNet",
     "LSTMClassifier",
     "TransformerClassifier",
     "BertModel",

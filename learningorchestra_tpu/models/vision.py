@@ -15,6 +15,7 @@ README demo pipelines at README.md:53).  Here they are Flax modules:
 
 from __future__ import annotations
 
+import math
 from typing import Sequence
 
 from flax import linen as nn
@@ -169,6 +170,127 @@ class ResNet50(NeuralEstimator):
                 stage_sizes=(3, 4, 6, 3),
                 block=_BottleneckBlock,
                 num_classes=num_classes,
+            ),
+            loss="softmax_ce",
+            learning_rate=learning_rate,
+            seed=seed,
+        )
+
+
+# -- VGG ---------------------------------------------------------------------
+
+
+class _VGG(nn.Module):
+    """VGG-16 layout (Simonyan & Zisserman config D), GroupNorm'd."""
+
+    num_classes: int
+    stage_sizes: Sequence[int] = (2, 2, 3, 3, 3)
+    widths: Sequence[int] = (64, 128, 256, 512, 512)
+
+    @nn.compact
+    def __call__(self, x):
+        if x.ndim == 3:
+            x = x[..., None]
+        for blocks, width in zip(self.stage_sizes, self.widths):
+            for _ in range(blocks):
+                x = nn.Conv(width, (3, 3), padding="SAME")(x)
+                x = nn.GroupNorm(num_groups=math.gcd(32, width))(x)
+                x = nn.relu(x)
+            # SAME-padded pooling: small inputs (e.g. 28x28 MNIST) must
+            # not shrink to a zero-size axis (VALID would: 28->...->0,
+            # making global average pooling return NaN).
+            x = nn.max_pool(x, (2, 2), strides=(2, 2), padding="SAME")
+        x = x.mean(axis=(1, 2))  # GAP replaces the 4096-wide FC stack
+        x = nn.relu(nn.Dense(1024)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+@register(_MODULE)
+class VGG16(NeuralEstimator):
+    def __init__(
+        self,
+        num_classes: int = 1000,
+        learning_rate: float = 1e-3,
+        seed: int = 0,
+    ):
+        self.num_classes = num_classes
+        super().__init__(
+            _VGG(num_classes=num_classes),
+            loss="softmax_ce",
+            learning_rate=learning_rate,
+            seed=seed,
+        )
+
+
+# -- MobileNet ---------------------------------------------------------------
+
+
+class _DepthwiseSeparable(nn.Module):
+    """Depthwise (feature_group_count=C) + pointwise conv pair."""
+
+    filters: int
+    strides: tuple = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        channels = x.shape[-1]
+        x = nn.Conv(
+            channels, (3, 3), strides=self.strides, padding="SAME",
+            feature_group_count=channels,
+        )(x)
+        # gcd: group count must DIVIDE the channel count, which
+        # arbitrary width multipliers (0.75 -> 48 channels) break for a
+        # fixed 32.
+        x = nn.GroupNorm(num_groups=math.gcd(32, channels))(x)
+        x = nn.relu(x)
+        x = nn.Conv(self.filters, (1, 1))(x)
+        x = nn.GroupNorm(num_groups=math.gcd(32, self.filters))(x)
+        return nn.relu(x)
+
+
+class _MobileNet(nn.Module):
+    """MobileNetV1 layout — depthwise-separable stacks."""
+
+    num_classes: int
+    width_multiplier: float = 1.0
+
+    @nn.compact
+    def __call__(self, x):
+        if x.ndim == 3:
+            x = x[..., None]
+
+        def w(c):
+            return max(8, int(c * self.width_multiplier))
+
+        x = nn.Conv(w(32), (3, 3), strides=(2, 2), padding="SAME")(x)
+        x = nn.relu(nn.GroupNorm(num_groups=math.gcd(32, w(32)))(x))
+        plan = [
+            (w(64), (1, 1)), (w(128), (2, 2)), (w(128), (1, 1)),
+            (w(256), (2, 2)), (w(256), (1, 1)), (w(512), (2, 2)),
+            *([(w(512), (1, 1))] * 5),
+            (w(1024), (2, 2)), (w(1024), (1, 1)),
+        ]
+        for filters, strides in plan:
+            x = _DepthwiseSeparable(filters=filters, strides=strides)(x)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+@register(_MODULE)
+class MobileNet(NeuralEstimator):
+    def __init__(
+        self,
+        num_classes: int = 1000,
+        width_multiplier: float = 1.0,
+        learning_rate: float = 1e-3,
+        seed: int = 0,
+    ):
+        self.num_classes = num_classes
+        self.width_multiplier = width_multiplier
+        super().__init__(
+            _MobileNet(
+                num_classes=num_classes,
+                width_multiplier=width_multiplier,
             ),
             loss="softmax_ce",
             learning_rate=learning_rate,

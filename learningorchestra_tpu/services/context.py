@@ -81,7 +81,7 @@ class ServiceContext:
         # checkpoint directories; reject path-shaped names here (406)
         # rather than relying on the store's internal gate (500) — and
         # never let '..'/absolute names reach a shutil.rmtree.
-        if not _ARTIFACT_NAME_RE.fullmatch(name):
+        if not _ARTIFACT_NAME_RE.fullmatch(name) or ".." in name:
             raise ValidationError(f"invalid artifact name: {name!r}")
         if self.artifacts.metadata.exists(name):
             raise ConflictError(f"duplicate artifact name: {name!r}")
@@ -91,6 +91,12 @@ class ServiceContext:
         if meta is None:
             raise NotFoundError(f"no such artifact: {name!r}")
         return meta
+
+    def checkpoint_dir(self, name: str):
+        """Managed per-artifact train-checkpoint tree — the ONE place
+        this path is built (executor, distributed route and delete all
+        share it)."""
+        return self.volumes.root / "_checkpoints" / name
 
     def delete_artifact(self, name: str) -> dict:
         """Shared delete: collection + volume binary (dataset/model/
@@ -102,7 +108,7 @@ class ServiceContext:
         self.volumes.delete(meta.get("type", ""), name)
         import shutil
 
-        ckdir = self.volumes.root / "_checkpoints" / name
+        ckdir = self.checkpoint_dir(name)
         if ckdir.exists():
             shutil.rmtree(ckdir, ignore_errors=True)
         return meta

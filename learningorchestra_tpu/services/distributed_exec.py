@@ -106,6 +106,14 @@ class DistributedExecutorService:
         monitoring URL the reference returned inline
         (server.py:70-76,104)."""
         self.ctx.require_new_name(name)
+        if training_parameters and "checkpoint_dir" in training_parameters:
+            # Checkpoint placement is managed server-side; a raw
+            # filesystem path from the network would be written to and
+            # pruned (rmtree of step_* subtrees) verbatim.
+            raise ValidationError(
+                "checkpoint_dir is managed by the service; use "
+                "checkpoint_every/resume to control checkpointing"
+            )
         parent_meta = self.ctx.require_finished_parent(parent_name)
         # Resolve + validate the monitoring nickname BEFORE creating the
         # artifact: a bad monitoringPath must 406, not burn the name on a
@@ -164,19 +172,18 @@ class DistributedExecutorService:
                 )
             spec = MeshSpec.from_dict(mesh) if mesh else None
             trainer = DistributedTrainer(instance, spec=spec)
-            if "checkpoint_dir" not in params:
-                # Managed in-loop checkpoints for the flagship
-                # distributed path too (train/checkpoint.py).  The
-                # route is POST-only (reference parity), so a fresh
-                # create wipes any stale tree; users resume explicitly
-                # by passing their own checkpoint parameters.
-                import shutil as _shutil
+            # Managed in-loop checkpoints for the flagship distributed
+            # path too (train/checkpoint.py).  The route is POST-only
+            # (reference parity), so a fresh create wipes any stale
+            # tree.  The directory is always the managed one — a raw
+            # filesystem path from the request was rejected at create.
+            import shutil as _shutil
 
-                ckdir = self.ctx.volumes.root / "_checkpoints" / name
-                if ckdir.exists():
-                    _shutil.rmtree(ckdir, ignore_errors=True)
-                params["checkpoint_dir"] = str(ckdir)
-                params["resume"] = False
+            ckdir = self.ctx.checkpoint_dir(name)
+            if ckdir.exists():
+                _shutil.rmtree(ckdir, ignore_errors=True)
+            params["checkpoint_dir"] = str(ckdir)
+            params.setdefault("resume", False)
             t0 = time.perf_counter()
             if session_name is not None:
                 with self.monitoring.trace(session_name):

@@ -59,8 +59,19 @@ class ExecutorService:
 
     # -- shared validation (reference: server.py:332-398) ---------------------
 
+    @staticmethod
+    def _reject_raw_checkpoint_dir(method_parameters) -> None:
+        """Checkpoint placement is managed server-side (ctx.checkpoint_dir);
+        a raw path from the network would be written/pruned verbatim."""
+        if method_parameters and "checkpoint_dir" in method_parameters:
+            raise ValidationError(
+                "checkpoint_dir is managed by the service; use "
+                "checkpoint_every/resume to control checkpointing"
+            )
+
     def _validate_request(self, name, parent_name, method, method_parameters):
         self.ctx.require_new_name(name)
+        self._reject_raw_checkpoint_dir(method_parameters)
         parent_meta = self.ctx.require_finished_parent(parent_name)
         model_meta = self.ctx.artifacts.metadata.find_model_ancestor(
             parent_name
@@ -122,6 +133,7 @@ class ExecutorService:
         so stale checkpoints are cleared.
         """
         meta = self.ctx.require_existing(name)
+        self._reject_raw_checkpoint_dir(method_parameters)
         parent = meta.get("parentName")
         if not parent:
             raise ValidationError(
@@ -135,9 +147,6 @@ class ExecutorService:
             meta.get("type"), description, resume_checkpoint=resume,
         )
         return self.ctx.artifacts.metadata.read(name)
-
-    def _checkpoint_dir(self, name: str):
-        return self.ctx.volumes.root / "_checkpoints" / name
 
     def _submit(self, name, parent_meta, method, method_parameters,
                 artifact_type, description, *, resume_checkpoint=False):
@@ -160,7 +169,7 @@ class ExecutorService:
                 # mid-job state entirely, SURVEY §5.4).  Fresh runs and
                 # param-changing re-runs of finished jobs must not
                 # resurrect old state, so their checkpoint dir is wiped.
-                ckdir = self._checkpoint_dir(name)
+                ckdir = self.ctx.checkpoint_dir(name)
                 if not resume_checkpoint and ckdir.exists():
                     shutil.rmtree(ckdir, ignore_errors=True)
                 params["checkpoint_dir"] = str(ckdir)
@@ -242,6 +251,7 @@ class ExecutorService:
                     f"param_grid[{key!r}] must be a non-empty list"
                 )
         self.ctx.require_new_name(name)
+        self._reject_raw_checkpoint_dir(method_parameters)
         self.ctx.require_finished_parent(parent_name)
         model_meta = self.ctx.artifacts.metadata.find_model_ancestor(
             parent_name

@@ -183,3 +183,20 @@ def test_bert_remat_trains_and_matches():
     np.testing.assert_allclose(
         plain.history["loss"], remat.history["loss"], rtol=1e-4
     )
+
+
+@pytest.mark.parametrize("cls_name", ["VGG16", "MobileNet"])
+def test_new_vision_models_train_step(cls_name):
+    from learningorchestra_tpu import models as zoo
+    from learningorchestra_tpu.toolkit import registry
+
+    # Reachable through the reference-style keras.applications path.
+    cls = registry.resolve("tensorflow.keras.applications", cls_name)
+    assert cls is getattr(zoo, cls_name)
+    est = cls(num_classes=3, learning_rate=1e-3)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 32, 32, 3)).astype(np.float32)
+    y = rng.integers(0, 3, (8,), dtype=np.int32)
+    est.fit(x, y, epochs=1, batch_size=4)
+    assert np.isfinite(est.history["loss"][-1])
+    assert est.predict(x).shape == (8, 3)
