@@ -390,6 +390,20 @@ class APIServer:
         add("POST", r"/train/(?:horovod|distributed)",
             distributed_train_create)
 
+        def distributed_train_update(m, body, query):
+            meta = self.distributed.update_train(
+                m.group("name"),
+                training_parameters=body.get("trainingParameters")
+                or body.get("methodParameters"),
+                compile_spec=body.get("compile"),
+                mesh=body.get("mesh"),
+                description=body.get("description", ""),
+            )
+            return 200, {"metadata": meta}
+
+        add("PATCH", rf"/train/(?:horovod|distributed)/{NAME}",
+            distributed_train_update)
+
         # ---- Monitoring (reference: GET /monitoring/tensorflow/{name} →
         # TensorBoard URL lookup, server.py:185-200) ----
         def monitoring_lookup(m, body, query):

@@ -30,6 +30,10 @@ class StoreConfig:
     durable_writes: bool = False
     # Document-store engine: "auto" | "native" (C++ liblodstore) | "python".
     backend: str = "auto"
+    # Persistent XLA compilation cache (first TPU compile of a model is
+    # 20-40s; repeat jobs across server restarts hit the disk cache).
+    # Empty string disables.
+    xla_cache_dir: str = "~/.learningorchestra_tpu/xla_cache"
 
     def store_path(self) -> Path:
         return Path(os.path.expanduser(self.root))
@@ -135,6 +139,8 @@ class Config:
             cfg.store.volume_root = env["LO_TPU_VOLUME_ROOT"]
         if "LO_TPU_STORE_BACKEND" in env:
             cfg.store.backend = env["LO_TPU_STORE_BACKEND"]
+        if "LO_TPU_XLA_CACHE" in env:  # "" disables
+            cfg.store.xla_cache_dir = env["LO_TPU_XLA_CACHE"]
         if "LO_TPU_API_PORT" in env:
             cfg.api.port = int(env["LO_TPU_API_PORT"])
         if "LO_TPU_MAX_WORKERS" in env:

@@ -55,17 +55,30 @@ class ServiceContext:
         self.loader = StoreLoader(self)
         self._init_backend()
 
-    @staticmethod
-    def _init_backend() -> None:
+    def _init_backend(self) -> None:
         """Eagerly initialize the JAX backend on the main thread.
 
         Two job threads racing first-time backend init deadlock inside
         xla_bridge (observed with concurrent fits on worker threads);
         paying init once at service startup removes the race and also
         front-loads the TPU client handshake out of the first job's
-        latency."""
+        latency.  The persistent compilation cache means a re-submitted
+        job (or a restarted server) skips the 20-40s TPU compile."""
+        import os
+
         import jax
 
+        cache_dir = self.config.store.xla_cache_dir
+        if cache_dir:
+            try:
+                path = os.path.expanduser(cache_dir)
+                os.makedirs(path, exist_ok=True)
+                jax.config.update("jax_compilation_cache_dir", path)
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 1.0
+                )
+            except Exception:
+                pass  # cache is an optimization, never a failure
         jax.devices()
 
     def close(self) -> None:

@@ -35,6 +35,7 @@ from learningorchestra_tpu.services.context import (
     ValidationError,
 )
 from learningorchestra_tpu.services.executor import (
+    ExecutorService,
     _json_safe,
     store_history_rows,
 )
@@ -106,14 +107,7 @@ class DistributedExecutorService:
         monitoring URL the reference returned inline
         (server.py:70-76,104)."""
         self.ctx.require_new_name(name)
-        if training_parameters and "checkpoint_dir" in training_parameters:
-            # Checkpoint placement is managed server-side; a raw
-            # filesystem path from the network would be written to and
-            # pruned (rmtree of step_* subtrees) verbatim.
-            raise ValidationError(
-                "checkpoint_dir is managed by the service; use "
-                "checkpoint_every/resume to control checkpointing"
-            )
+        ExecutorService._reject_raw_checkpoint_dir(training_parameters)
         parent_meta = self.ctx.require_finished_parent(parent_name)
         # Resolve + validate the monitoring nickname BEFORE creating the
         # artifact: a bad monitoringPath must 406, not burn the name on a
@@ -149,6 +143,51 @@ class DistributedExecutorService:
             session_logdir = session_info["logdir"]
             extra_results["monitoring"] = session_info
 
+        self._submit_train(
+            name, parent_meta, training_parameters, compile_spec, mesh,
+            artifact_type, description,
+            session_name=session_name, session_logdir=session_logdir,
+            resume_default=False,
+        )
+        return meta, extra_results
+
+    def update_train(
+        self,
+        name: str,
+        *,
+        training_parameters: dict | None = None,
+        compile_spec: dict | None = None,
+        mesh: dict | None = None,
+        description: str = "",
+    ) -> dict:
+        """PATCH re-run.  A FAILED (e.g. preempted) distributed job
+        resumes from its managed in-loop checkpoint; re-running a
+        finished job starts fresh so new parameters apply — identical
+        semantics to the single-device executor's PATCH."""
+        meta = self.ctx.require_existing(name)
+        ExecutorService._reject_raw_checkpoint_dir(training_parameters)
+        parent = meta.get("parentName")
+        if not parent:
+            raise ValidationError(
+                f"artifact {name!r} has no parent — not a train result"
+            )
+        parent_meta = self.ctx.require_finished_parent(parent)
+        resume = meta.get("jobState") == "failed"
+        self.ctx.artifacts.metadata.restart(name)
+        self._submit_train(
+            name, parent_meta, training_parameters, compile_spec,
+            mesh or meta.get("mesh"), meta.get("type"), description,
+            session_name=None, session_logdir=None,
+            resume_default=resume,
+        )
+        return self.ctx.artifacts.metadata.read(name)
+
+    def _submit_train(
+        self, name, parent_meta, training_parameters, compile_spec, mesh,
+        artifact_type, description, *, session_name, session_logdir,
+        resume_default,
+    ):
+        parent_name = parent_meta["name"]
         parent_type = parent_meta.get("type", "")
 
         def run():
@@ -172,18 +211,18 @@ class DistributedExecutorService:
                 )
             spec = MeshSpec.from_dict(mesh) if mesh else None
             trainer = DistributedTrainer(instance, spec=spec)
-            # Managed in-loop checkpoints for the flagship distributed
-            # path too (train/checkpoint.py).  The route is POST-only
-            # (reference parity), so a fresh create wipes any stale
-            # tree.  The directory is always the managed one — a raw
-            # filesystem path from the request was rejected at create.
+            # Managed in-loop checkpoints (train/checkpoint.py).  The
+            # directory is always the managed one — raw paths were
+            # rejected at the route.  resume defaults by request kind:
+            # fresh POST wipes stale state; PATCH of a failed job
+            # resumes it.
             import shutil as _shutil
 
             ckdir = self.ctx.checkpoint_dir(name)
-            if ckdir.exists():
+            params.setdefault("resume", resume_default)
+            if not params["resume"] and ckdir.exists():
                 _shutil.rmtree(ckdir, ignore_errors=True)
             params["checkpoint_dir"] = str(ckdir)
-            params.setdefault("resume", False)
             t0 = time.perf_counter()
             if session_name is not None:
                 with self.monitoring.trace(session_name):
@@ -192,6 +231,11 @@ class DistributedExecutorService:
                 trainer.fit(**params)
             fit_time = time.perf_counter() - t0
             self.ctx.volumes.save_object(artifact_type, name, instance)
+            # Replace (not append) history rows on re-runs.
+            for doc in self.ctx.documents.find(
+                name, query={"docType": "history"}
+            ):
+                self.ctx.documents.delete_one(name, doc["_id"])
             store_history_rows(
                 self.ctx.documents, name, dict(trainer.history)
             )
@@ -212,7 +256,6 @@ class DistributedExecutorService:
             parameters=_json_safe(training_parameters),
             on_success=lambda extra: extra,
         )
-        return meta, extra_results
 
     # -- distributed builder --------------------------------------------------
 

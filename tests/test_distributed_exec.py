@@ -242,3 +242,67 @@ def test_builder_rejects_toplevel_side_effects(api):
         f"{base}/builder/tensorflow",
         json={"name": "wdoc", "function": code_ok, "nWorkers": 1},
     ).status_code == 201
+
+
+def test_distributed_train_patch_rerun(api, dataset):
+    """PATCH /train/horovod/{name}: finished jobs re-run fresh with the
+    new parameters; history rows are replaced, not appended."""
+    base, _ = api
+    resp = requests.post(
+        f"{base}/model/tensorflow",
+        json={
+            "name": "dp_mlp",
+            "modulePath": "learningorchestra_tpu.models.mlp",
+            "class": "MLPClassifier",
+            "classParameters": {"hidden_layer_sizes": [8],
+                                "num_classes": 2},
+        },
+    )
+    assert resp.status_code == 201, resp.text
+    poll(base, "/model/tensorflow/dp_mlp")
+    resp = requests.post(
+        f"{base}/train/horovod",
+        json={
+            "name": "dp_fit",
+            "parentName": "dp_mlp",
+            "trainingParameters": {
+                "x": "$dd_X", "y": "$dd.label",
+                "epochs": 2, "batch_size": 16,
+            },
+        },
+    )
+    assert resp.status_code == 201, resp.text
+    poll(base, "/train/horovod/dp_fit")
+
+    resp = requests.patch(
+        f"{base}/train/horovod/dp_fit",
+        json={
+            "trainingParameters": {
+                "x": "$dd_X", "y": "$dd.label",
+                "epochs": 3, "batch_size": 16,
+            },
+        },
+    )
+    assert resp.status_code == 200, resp.text
+    meta = poll(base, "/train/horovod/dp_fit")
+    assert meta["finished"]
+    docs = requests.get(
+        f"{base}/train/horovod/dp_fit", params={"limit": 50}
+    ).json()
+    epochs = sorted(
+        d["epoch"] for d in docs if d.get("docType") == "history"
+    )
+    assert epochs == [0, 1, 2]
+
+
+def test_distributed_train_rejects_raw_checkpoint_dir(api, dataset):
+    base, _ = api
+    resp = requests.post(
+        f"{base}/train/horovod",
+        json={
+            "name": "dp_evil",
+            "parentName": "dp_mlp",
+            "trainingParameters": {"checkpoint_dir": "/srv/data"},
+        },
+    )
+    assert resp.status_code == 406, resp.text

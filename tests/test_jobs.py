@@ -112,3 +112,26 @@ def test_rerun_after_restart(artifacts, engine):
     engine.submit("j6", lambda: 2)
     assert engine.wait("j6", timeout=10) == 2
     assert len(artifacts.ledger.history("j6")) == 2
+
+
+def test_xla_compilation_cache_configured(tmp_path):
+    """ServiceContext points JAX at the persistent compile cache."""
+    import jax
+
+    from learningorchestra_tpu.config import Config
+    from learningorchestra_tpu.services.context import ServiceContext
+
+    cfg = Config()
+    cfg.store.root = str(tmp_path / "store")
+    cfg.store.volume_root = str(tmp_path / "volumes")
+    cfg.store.xla_cache_dir = str(tmp_path / "xla")
+    prev = jax.config.jax_compilation_cache_dir
+    ctx = ServiceContext(cfg)
+    try:
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path / "xla")
+        assert (tmp_path / "xla").is_dir()
+    finally:
+        ctx.close()
+        # Global jax config: restore so later tests don't write compile
+        # cache entries into this (deleted) tmp dir.
+        jax.config.update("jax_compilation_cache_dir", prev)
