@@ -27,6 +27,8 @@ def _prior_best() -> float | None:
         try:
             with open(path) as f:
                 rec = json.load(f)
+            # The driver wraps bench output under "parsed".
+            rec = rec.get("parsed", rec)
             val = float(rec.get("value"))
         except Exception:
             continue
@@ -68,6 +70,66 @@ def _force_cpu() -> None:
         pass
 
 
+def _peak_flops(platform: str) -> float:
+    """Per-chip peak bf16 FLOP/s for the MFU denominator."""
+    if platform != "tpu":
+        return 0.0
+    import jax
+
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    # TPU generation -> peak dense bf16 TFLOP/s (public spec sheets).
+    table = {"v4": 275e12, "v5 lite": 197e12, "v5e": 197e12,
+             "v5p": 459e12, "v6": 918e12}
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 197e12  # conservative default for unknown TPU kinds
+
+
+def _model_flops_per_sample(est, x1) -> float:
+    """Analytic fwd FLOPs from XLA's own cost model, times 3 for the
+    canonical fwd+bwd estimate."""
+    import jax
+
+    try:
+        fwd = jax.jit(est.module.apply).lower(
+            est.params, x1
+        ).compile().cost_analysis()
+        return 3.0 * float(fwd.get("flops", 0.0))
+    except Exception:
+        return 0.0
+
+
+def _flash_check() -> dict:
+    """Compile + run the Pallas flash-attention kernel on the live
+    backend against the jnp reference — records FAILED if the kernel
+    stops compiling on TPU (VERDICT r1 item 2)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from learningorchestra_tpu.ops.attention import (
+        flash_attention, mha_reference,
+    )
+
+    if jax.default_backend() != "tpu":
+        return {"flash_on_tpu": "skipped (cpu backend)"}
+    rng = np.random.default_rng(0)
+    b, h, t, d = 2, 4, 2048, 64
+    q = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.bfloat16)
+    mask = jnp.asarray(rng.integers(0, 2, (b, t)).astype(np.float32))
+    out = jax.jit(flash_attention)(q, k, v, mask)
+    ref = jax.jit(mha_reference)(q, k, v, mask)
+    err = float(jnp.max(jnp.abs(
+        out.astype(jnp.float32) - ref.astype(jnp.float32)
+    )))
+    if not err < 0.05:
+        raise RuntimeError(f"flash-attention TPU mismatch: max err {err}")
+    return {"flash_on_tpu": "ok", "flash_max_err": round(err, 5)}
+
+
 def main() -> None:
     if not _probe_backend():
         _force_cpu()  # record a CPU number rather than hang the driver
@@ -96,6 +158,18 @@ def main() -> None:
     best_epoch = min(epoch_times)
     throughput = n_samples / best_epoch
 
+    extra: dict = {}
+    peak = _peak_flops(platform)
+    if peak:
+        per_sample = _model_flops_per_sample(est, jnp.asarray(x[:1]))
+        if per_sample:
+            extra["mfu"] = round(throughput * per_sample / peak, 4)
+            extra["model_flops_per_sample"] = per_sample
+    try:
+        extra.update(_flash_check())
+    except Exception as exc:  # noqa: BLE001 — record, don't hide
+        extra["flash_on_tpu"] = f"FAILED: {exc!r}"
+
     prior = _prior_best()
     vs_baseline = throughput / prior if prior else 1.0
     print(json.dumps({
@@ -103,6 +177,7 @@ def main() -> None:
         "value": round(throughput, 1),
         "unit": "samples/sec/chip",
         "vs_baseline": round(vs_baseline, 3),
+        **extra,
     }))
 
 

@@ -8,8 +8,11 @@ softmax (running max / running sum) recurrence, so HBM traffic is O(T·D)
 instead of O(T²) and the MXU sees (block_q × D) @ (D × block_k) matmuls.
 
 Numerical contract (tested against ``mha_reference``):
-- computes in float32 regardless of input dtype (bfloat16 inputs are
-  upcast at the MXU via ``preferred_element_type``);
+- matmuls multiply in the storage dtype (bf16 on the training path —
+  full MXU rate) and ACCUMULATE in float32 via
+  ``preferred_element_type``; the softmax/online-max recurrence runs in
+  float32, with the probabilities/dS downcast to the storage dtype for
+  the second matmul of each pass (standard flash-attention precision);
 - key-side padding mask: masked keys contribute zero probability; rows
   whose keys are ALL masked output exactly 0 (and get zero gradient).
 
@@ -24,9 +27,24 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG_BIG = -1e30  # additive mask value; exp(_NEG_BIG - lse) == 0 in f32
 _LSE_EMPTY = 1e30  # lse sentinel for fully-masked rows: exp(s - 1e30) == 0
+
+# jax renamed TPUCompilerParams → CompilerParams across releases.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
+
+def _tpu_params(n_parallel: int):
+    """Mark the trailing grid axis sequential (carry in VMEM scratch)
+    and the leading ones parallel, so Mosaic pipelines the K/V block
+    DMAs against compute (double buffering)."""
+    return _CompilerParams(
+        dimension_semantics=("parallel",) * n_parallel + ("arbitrary",)
+    )
 
 
 def _auto_interpret() -> bool:
@@ -73,42 +91,60 @@ def mha_reference(q, k, v, key_mask=None):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref, *, nk, bk, scale):
-    q = q_ref[0, 0].astype(jnp.float32)  # (bq, D)
-    bq, d = q.shape
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, scale,
+):
+    """One (q-block, k-block) grid step.  The k axis is the innermost,
+    sequential grid dimension: the online-softmax running state lives in
+    VMEM scratch across k steps, and each step sees ONE (bk, D) K/V block
+    streamed from HBM — VMEM use is O(block), not O(T), and Mosaic
+    overlaps the next block's DMA with this block's MXU work."""
+    j = pl.program_id(3)
+    nk = pl.num_programs(3)
 
-    def body(j, carry):
-        m, l, acc = carry
-        kb = k_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)  # (bk, D)
-        vb = v_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        km = km_ref[0, :, pl.ds(j * bk, bk)]  # (1, bk) float32, 1=keep
-        s = jax.lax.dot_general(
-            q, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # (bq, bk)
-        s = s + (km - 1.0) * -_NEG_BIG  # masked keys -> -1e30
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new) * km  # zero masked keys exactly
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jax.lax.dot_general(
-            p, vb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_BIG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Matmul inputs stay in their storage dtype (bf16 on the training
+    # path): the MXU multiplies bf16 at full rate and accumulates f32 via
+    # preferred_element_type — upcasting first would halve throughput.
+    q = q_ref[0, 0]  # (bq, D)
+    kb = k_ref[0, 0]  # (bk, D)
+    vb = v_ref[0, 0]
+    km = km_ref[0]  # (1, bk) float32, 1=keep
+    s = jax.lax.dot_general(
+        q, kb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # (bq, bk) f32
+    s = s + (km - 1.0) * -_NEG_BIG  # masked keys -> -1e30
+    m_prev = m_scr[...]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new) * km  # zero masked keys exactly
+    m_scr[...] = m_new
+    l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+        p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_scr[...]
+        nonempty = l > 0.0
+        out = jnp.where(
+            nonempty, acc_scr[...] / jnp.where(nonempty, l, 1.0), 0.0
         )
-        return m_new, l, acc
-
-    m0 = jnp.full((bq, 1), _NEG_BIG, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    a0 = jnp.zeros((bq, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, a0))
-
-    nonempty = l > 0.0
-    out = jnp.where(nonempty, acc / jnp.where(nonempty, l, 1.0), 0.0)
-    lse = jnp.where(
-        nonempty[:, 0], (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0], _LSE_EMPTY
-    )  # (bq,)
-    o_ref[0, 0] = out.astype(o_ref.dtype)
-    lse_ref[0, 0] = lse[:, None]
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+        lse_ref[0, 0] = jnp.where(
+            nonempty,
+            m_scr[...] + jnp.log(jnp.maximum(l, 1e-30)),
+            _LSE_EMPTY,
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -118,78 +154,90 @@ def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref, *, nk, bk, scale):
 
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    *, nk, bk, scale,
+    dq_scr, *, scale,
 ):
-    q = q_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)
+    """dQ pass: grid (b, h, nq, nk) — same streamed K/V layout as the
+    forward; dq accumulates in VMEM scratch across the sequential k axis."""
+    j = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    q = q_ref[0, 0]
+    do = do_ref[0, 0]
     lse = lse_ref[0, 0]  # (bq, 1)
     delta = delta_ref[0, 0]
-    bq, d = q.shape
+    kb = k_ref[0, 0]
+    vb = v_ref[0, 0]
+    km = km_ref[0]
+    s = jax.lax.dot_general(
+        q, kb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    s = s + (km - 1.0) * -_NEG_BIG
+    p = jnp.exp(s - lse) * km  # (bq, bk) f32
+    dp = jax.lax.dot_general(
+        do, vb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = (p * (dp - delta) * scale).astype(kb.dtype)
+    dq_scr[...] += jax.lax.dot_general(
+        ds, kb, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
 
-    def body(j, dq):
-        kb = k_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        vb = v_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        km = km_ref[0, :, pl.ds(j * bk, bk)]
-        s = jax.lax.dot_general(
-            q, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        s = s + (km - 1.0) * -_NEG_BIG
-        p = jnp.exp(s - lse) * km  # (bq, bk)
-        dp = jax.lax.dot_general(
-            do, vb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta) * scale
-        return dq + jax.lax.dot_general(
-            ds, kb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-
-    dq = jax.lax.fori_loop(0, nk, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, delta_ref,
-    dk_ref, dv_ref, *, nq, bq, scale,
+    dk_ref, dv_ref, dk_scr, dv_scr, *, scale,
 ):
-    kb = k_ref[0, 0].astype(jnp.float32)  # (bk, D)
-    vb = v_ref[0, 0].astype(jnp.float32)
+    """dK/dV pass: grid (b, h, nk, nq) — one K/V block is resident while
+    Q/dO/lse/delta blocks stream along the sequential inner q axis."""
+    i = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    kb = k_ref[0, 0]  # (bk, D)
+    vb = v_ref[0, 0]
     km = km_ref[0]  # (1, bk)
-    bk, d = kb.shape
+    q = q_ref[0, 0]  # (bq, D)
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0]  # (bq, 1)
+    delta = delta_ref[0, 0]
+    s = jax.lax.dot_general(
+        q, kb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    s = s + (km - 1.0) * -_NEG_BIG
+    p = jnp.exp(s - lse) * km  # (bq, bk) f32
+    dv_scr[...] += jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dp = jax.lax.dot_general(
+        do, vb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = (p * (dp - delta) * scale).astype(q.dtype)
+    dk_scr[...] += jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        do = do_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(i * bq, bq), :]  # (bq, 1)
-        delta = delta_ref[0, 0, pl.ds(i * bq, bq), :]
-        s = jax.lax.dot_general(
-            q, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        s = s + (km - 1.0) * -_NEG_BIG
-        p = jnp.exp(s - lse) * km  # (bq, bk)
-        dv = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dp = jax.lax.dot_general(
-            do, vb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta) * scale
-        dk = dk + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return dk, dv
-
-    z = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(0, nq, body, (z, z))
-    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -202,26 +250,40 @@ def _fwd_call(q, k, v, km, block_q, block_k, interpret):
     tk = k.shape[2]
     nq, nk = tq // block_q, tk // block_k
     scale = 1.0 / (d ** 0.5)
-    kernel = functools.partial(_fwd_kernel, nk=nk, bk=block_k, scale=scale)
+    kernel = functools.partial(_fwd_kernel, scale=scale)
     return pl.pallas_call(
         kernel,
-        grid=(b, h, nq),
+        grid=(b, h, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bb, hh, i: (bb, hh, i, 0)),
-            pl.BlockSpec((1, 1, tk, d), lambda bb, hh, i: (bb, hh, 0, 0)),
-            pl.BlockSpec((1, 1, tk, d), lambda bb, hh, i: (bb, hh, 0, 0)),
-            pl.BlockSpec((1, 1, tk), lambda bb, hh, i: (bb, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, block_q, d), lambda bb, hh, i, j: (bb, hh, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, d), lambda bb, hh, i, j: (bb, hh, j, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, d), lambda bb, hh, i, j: (bb, hh, j, 0)
+            ),
+            pl.BlockSpec((1, 1, block_k), lambda bb, hh, i, j: (bb, 0, j)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bb, hh, i: (bb, hh, i, 0)),
             pl.BlockSpec(
-                (1, 1, block_q, 1), lambda bb, hh, i: (bb, hh, i, 0)
+                (1, 1, block_q, d), lambda bb, hh, i, j: (bb, hh, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q, 1), lambda bb, hh, i, j: (bb, hh, i, 0)
             ),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
             jax.ShapeDtypeStruct((b, h, tq, 1), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=_tpu_params(3),
         interpret=interpret,
     )(q, k, v, km)
 
@@ -233,48 +295,79 @@ def _bwd_call(q, k, v, km, do, lse, delta, block_q, block_k, interpret):
     scale = 1.0 / (d ** 0.5)
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, nk=nk, bk=block_k, scale=scale),
-        grid=(b, h, nq),
+        functools.partial(_bwd_dq_kernel, scale=scale),
+        grid=(b, h, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bb, hh, i: (bb, hh, i, 0)),
-            pl.BlockSpec((1, 1, tk, d), lambda bb, hh, i: (bb, hh, 0, 0)),
-            pl.BlockSpec((1, 1, tk, d), lambda bb, hh, i: (bb, hh, 0, 0)),
-            pl.BlockSpec((1, 1, tk), lambda bb, hh, i: (bb, 0, 0)),
-            pl.BlockSpec((1, 1, block_q, d), lambda bb, hh, i: (bb, hh, i, 0)),
             pl.BlockSpec(
-                (1, 1, block_q, 1), lambda bb, hh, i: (bb, hh, i, 0)
+                (1, 1, block_q, d), lambda bb, hh, i, j: (bb, hh, i, 0)
             ),
             pl.BlockSpec(
-                (1, 1, block_q, 1), lambda bb, hh, i: (bb, hh, i, 0)
+                (1, 1, block_k, d), lambda bb, hh, i, j: (bb, hh, j, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, d), lambda bb, hh, i, j: (bb, hh, j, 0)
+            ),
+            pl.BlockSpec((1, 1, block_k), lambda bb, hh, i, j: (bb, 0, j)),
+            pl.BlockSpec(
+                (1, 1, block_q, d), lambda bb, hh, i, j: (bb, hh, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q, 1), lambda bb, hh, i, j: (bb, hh, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q, 1), lambda bb, hh, i, j: (bb, hh, i, 0)
             ),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, block_q, d), lambda bb, hh, i: (bb, hh, i, 0)
+            (1, 1, block_q, d), lambda bb, hh, i, j: (bb, hh, i, 0)
         ),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_tpu_params(3),
         interpret=interpret,
     )(q, k, v, km, do, lse, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, nq=nq, bq=block_q, scale=scale),
-        grid=(b, h, nk),
+        functools.partial(_bwd_dkv_kernel, scale=scale),
+        grid=(b, h, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, 1, tq, d), lambda bb, hh, j: (bb, hh, 0, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda bb, hh, j: (bb, hh, j, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda bb, hh, j: (bb, hh, j, 0)),
-            pl.BlockSpec((1, 1, block_k), lambda bb, hh, j: (bb, 0, j)),
-            pl.BlockSpec((1, 1, tq, d), lambda bb, hh, j: (bb, hh, 0, 0)),
-            pl.BlockSpec((1, 1, tq, 1), lambda bb, hh, j: (bb, hh, 0, 0)),
-            pl.BlockSpec((1, 1, tq, 1), lambda bb, hh, j: (bb, hh, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, block_q, d), lambda bb, hh, j, i: (bb, hh, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, d), lambda bb, hh, j, i: (bb, hh, j, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, d), lambda bb, hh, j, i: (bb, hh, j, 0)
+            ),
+            pl.BlockSpec((1, 1, block_k), lambda bb, hh, j, i: (bb, 0, j)),
+            pl.BlockSpec(
+                (1, 1, block_q, d), lambda bb, hh, j, i: (bb, hh, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q, 1), lambda bb, hh, j, i: (bb, hh, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q, 1), lambda bb, hh, j, i: (bb, hh, i, 0)
+            ),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_k, d), lambda bb, hh, j: (bb, hh, j, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda bb, hh, j: (bb, hh, j, 0)),
+            pl.BlockSpec(
+                (1, 1, block_k, d), lambda bb, hh, j, i: (bb, hh, j, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, d), lambda bb, hh, j, i: (bb, hh, j, 0)
+            ),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=_tpu_params(3),
         interpret=interpret,
     )(q, k, v, km, do, lse, delta)
     return dq, dk, dv
@@ -322,14 +415,18 @@ def flash_attention(
     v,
     key_mask=None,
     *,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 1024,
     interpret: bool | None = None,
 ):
     """Blockwise attention. q,k,v: (B, H, T, D); key_mask: (B, Tk) bool.
 
     Sequences are padded to block multiples internally; padded keys are
     masked out, padded query rows are sliced off the output.
+
+    Default blocks were tuned on TPU v5e at D=64: (512, 1024) reaches
+    2.8x XLA's fused attention at T=32k (36 vs 13 TF/s); 128-sized
+    blocks leave the MXU idle on grid overhead (~4 MFLOP per step).
     """
     if interpret is None:
         interpret = _auto_interpret()

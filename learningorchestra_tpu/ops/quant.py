@@ -32,12 +32,20 @@ def _quantize_kernel(seed_ref, x_ref, values_ref, scales_ref, *, stochastic):
     scale = jnp.maximum(abs_max, 1e-12) / 127.0
     scaled = x / scale
     if stochastic:
-        pltpu.prng_seed(seed_ref[0])
+        # Re-seed per row block so streams stay independent across the
+        # grid (every program would otherwise draw identical bits).
+        # Multi-word seed: (seed + i) would collide with (seed+1, i-1)
+        # when callers seed by step counter.
+        pltpu.prng_seed(seed_ref[0], pl.program_id(0))
         bits = pltpu.bitcast(
             pltpu.prng_random_bits(scaled.shape), jnp.uint32
         )
-        # Uniform in [0, 1): 23 mantissa bits of the random word.
-        u = (bits >> jnp.uint32(9)).astype(jnp.float32) * (1.0 / (1 << 23))
+        # Uniform in [0, 1): 23 mantissa bits of the random word.  The
+        # shift clears the sign bit, so the int32 hop is lossless —
+        # Mosaic has no direct uint32->f32 cast.
+        u = (
+            (bits >> jnp.uint32(9)).astype(jnp.int32).astype(jnp.float32)
+        ) * (1.0 / (1 << 23))
         q = jnp.floor(scaled + u)
     else:
         q = jnp.round(scaled)
@@ -47,6 +55,15 @@ def _quantize_kernel(seed_ref, x_ref, values_ref, scales_ref, *, stochastic):
 
 def _dequantize_kernel(values_ref, scales_ref, out_ref):
     out_ref[:] = values_ref[:].astype(jnp.float32) * scales_ref[:]
+
+
+def _row_block(n: int, d: int, bytes_per_elt: int = 4) -> int:
+    """Rows per grid step, sized to ~4 MB of VMEM per staged block so
+    arbitrarily large matrices (e.g. a 30k x 768 embedding) compile —
+    a single whole-array block caps out at VMEM (~16 MB)."""
+    target = (4 * 1024 * 1024) // max(1, d * bytes_per_elt)
+    block = max(8, min(n, target) // 8 * 8)
+    return block
 
 
 def quantize_rowwise(
@@ -69,35 +86,51 @@ def quantize_rowwise(
     if stochastic is None:
         stochastic = not interpret
     n, d = x.shape
+    bn = _row_block(n, d)
+    pad = (-n) % bn
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
     seed_arr = jnp.asarray([seed], jnp.int32)
-    return pl.pallas_call(
+    values, scales = pl.pallas_call(
         functools.partial(_quantize_kernel, stochastic=stochastic),
+        grid=((n + pad) // bn,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, d), lambda i: (i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, d), lambda i: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n, d), jnp.int8),
-            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n + pad, d), jnp.int8),
+            jax.ShapeDtypeStruct((n + pad, 1), jnp.float32),
         ],
         interpret=interpret,
     )(seed_arr, x)
+    return (values[:n], scales[:n]) if pad else (values, scales)
 
 
 def dequantize_rowwise(values, scales, *, interpret: bool | None = None):
     if interpret is None:
         interpret = _auto_interpret()
-    return pl.pallas_call(
+    n, d = values.shape
+    # Block by the f32 OUTPUT element size — the output block is the
+    # largest VMEM resident here, not the int8 input.
+    bn = _row_block(n, d)
+    pad = (-n) % bn
+    if pad:
+        values = jnp.pad(values, ((0, pad), (0, 0)))
+        scales = jnp.pad(scales, ((0, pad), (0, 0)))
+    out = pl.pallas_call(
         _dequantize_kernel,
+        grid=((n + pad) // bn,),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, d), lambda i: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
         ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct(values.shape, jnp.float32),
+        out_specs=pl.BlockSpec((bn, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n + pad, d), jnp.float32),
         interpret=interpret,
     )(values, scales)
+    return out[:n] if pad else out
