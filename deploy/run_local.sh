@@ -1,0 +1,64 @@
+#!/usr/bin/env bash
+# One-command LOCAL cluster bring-up with restart-on-failure supervision
+# — the container-less analogue of `docker compose up` above and of the
+# reference's `run.sh` (reference: run.sh:32 `docker stack deploy`,
+# docker-compose.yml:3-6 restart policy).
+#
+#   deploy/run_local.sh [N_AGENTS]
+#
+# Env: LO_TPU_API_PORT (default 8080), LO_COORD_PORT (default 7070),
+#      LO_TPU_STORE_ROOT / LO_TPU_VOLUME_ROOT (default ./lo-data/...).
+# Stops the whole cluster on Ctrl-C / SIGTERM.
+
+set -u
+
+N_AGENTS="${1:-2}"
+API_PORT="${LO_TPU_API_PORT:-8080}"
+COORD_PORT="${LO_COORD_PORT:-7070}"
+DATA_ROOT="${LO_DATA_ROOT:-$PWD/lo-data}"
+export LO_TPU_API_PORT="$API_PORT"
+export LO_TPU_STORE_ROOT="${LO_TPU_STORE_ROOT:-$DATA_ROOT/store}"
+export LO_TPU_VOLUME_ROOT="${LO_TPU_VOLUME_ROOT:-$DATA_ROOT/volumes}"
+mkdir -p "$LO_TPU_STORE_ROOT" "$LO_TPU_VOLUME_ROOT"
+
+PIDS=()
+
+# Supervise: restart the role if it exits non-zero (the reference's
+# on-failure policy); clean exit (0) ends supervision.
+supervise() {
+  local name="$1"; shift
+  (
+    while true; do
+      "$@"
+      code=$?
+      if [ "$code" -eq 0 ]; then
+        echo "[$name] exited cleanly" >&2
+        break
+      fi
+      echo "[$name] exited with $code — restarting in 1s" >&2
+      sleep 1
+    done
+  ) &
+  PIDS+=($!)
+}
+
+cleanup() {
+  echo "stopping cluster" >&2
+  for pid in "${PIDS[@]}"; do
+    kill -- -"$pid" 2>/dev/null || kill "$pid" 2>/dev/null || true
+  done
+  wait 2>/dev/null
+  exit 0
+}
+trap cleanup INT TERM
+
+supervise coordinator python -m learningorchestra_tpu coordinator \
+  --host 127.0.0.1 --port "$COORD_PORT"
+supervise api python -m learningorchestra_tpu serve
+for i in $(seq 1 "$N_AGENTS"); do
+  supervise "agent$i" python -m learningorchestra_tpu agent \
+    --coordinator "127.0.0.1:$COORD_PORT" --id "agent$i"
+done
+
+echo "cluster up: api=:$API_PORT coordinator=:$COORD_PORT agents=$N_AGENTS" >&2
+wait

@@ -51,6 +51,9 @@ def _cmd_coordinator(args) -> int:
 
 
 def _cmd_agent(args) -> int:
+    # Importing launch registers the named multihost task functions
+    # (lo.multihost_fit, ...) before the agent starts leasing work.
+    import learningorchestra_tpu.parallel.launch  # noqa: F401
     from learningorchestra_tpu.parallel.coordinator import HostAgent
 
     agent_id = args.id or f"{socket.gethostname()}-{int(time.time())}"

@@ -48,27 +48,50 @@ class BadRequest(Exception):
 
 
 class Router:
-    """Regex route table: (verb, pattern) → handler(match, body, query)."""
+    """Regex route table: (verb, pattern) → handler(match, body, query).
+
+    Per-route flags carry the gateway budget semantics (reference:
+    krakend.json global ``timeout``/``cache_ttl`` + metrics exporter):
+    ``cacheable`` opts a GET into the response cache (poll GETs must
+    NOT cache — job completion writes through the store, not HTTP, so a
+    TTL cache would serve stale ``finished`` flags); ``no_timeout``
+    exempts deliberate long-polls (observe) from the request deadline.
+    """
 
     def __init__(self, prefix: str):
         self.prefix = prefix.rstrip("/")
-        self.routes: list[tuple[str, re.Pattern, Callable]] = []
+        self.routes: list[tuple[str, re.Pattern, Callable, str, dict]] = []
 
-    def add(self, verb: str, pattern: str, handler: Callable) -> None:
+    def add(self, verb: str, pattern: str, handler: Callable, *,
+            cacheable: bool = False, no_timeout: bool = False) -> None:
         full = re.compile("^" + self.prefix + pattern + "/?$")
-        self.routes.append((verb.upper(), full, handler))
+        verb = verb.upper()
+        self.routes.append((
+            verb, full, handler, f"{verb} {pattern}",
+            {"cacheable": cacheable, "no_timeout": no_timeout},
+        ))
 
-    def dispatch(self, verb: str, path: str, body: dict, query: dict):
+    def resolve(self, verb: str, path: str):
+        """→ (handler, match, route_key, flags) | (None, None, key, {})."""
         matched_path = False
-        for route_verb, pattern, handler in self.routes:
+        for route_verb, pattern, handler, key, flags in self.routes:
             m = pattern.match(path)
             if m:
                 matched_path = True
                 if route_verb == verb:
-                    return handler(m, body, query)
-        if matched_path:
-            return 405, {"error": f"method {verb} not allowed on {path}"}
-        return 404, {"error": f"no such route: {path}"}
+                    return handler, m, key, flags
+        key = "405" if matched_path else "404"
+        return None, None, key, {"matched_path": matched_path}
+
+    def dispatch(self, verb: str, path: str, body: dict, query: dict):
+        handler, m, _key, flags = self.resolve(verb, path)
+        if handler is None:
+            if flags.get("matched_path"):
+                return 405, {
+                    "error": f"method {verb} not allowed on {path}"
+                }
+            return 404, {"error": f"no such route: {path}"}
+        return handler(m, body, query)
 
 
 class APIServer:
@@ -103,6 +126,12 @@ class APIServer:
         self.router = Router(self.config.api.api_prefix)
         self._register_routes()
         self._httpd: ThreadingHTTPServer | None = None
+        # Gateway budget (reference: krakend.json global timeout /
+        # cache_ttl / metrics exporter on :8090 — SURVEY §5.1, §6).
+        self._cache: dict[tuple, tuple] = {}
+        self._cache_lock = threading.Lock()
+        self._metrics: dict[str, dict] = {}
+        self._metrics_lock = threading.Lock()
 
     # -- helpers --------------------------------------------------------------
 
@@ -178,7 +207,28 @@ class APIServer:
             )
             return self._created("transform/projection", meta)
 
+        def projection_update(m, body, query):
+            meta = self.transform.update_projection(
+                body.get("projectionName") or body.get("name"),
+                fields=body.get("fields"),
+            )
+            return 200, {"metadata": meta}
+
         add("POST", r"/transform/projection", projection_create)
+        # Reference: PATCH /transform/projection carries the name in the
+        # body (krakend.json transform block); also accept /{name}.
+        add("PATCH", r"/transform/projection", projection_update)
+        add(
+            "PATCH", r"/transform/projection/" + NAME,
+            lambda m, b, q: (
+                200,
+                {
+                    "metadata": self.transform.update_projection(
+                        m.group("name"), fields=b.get("fields")
+                    )
+                },
+            ),
+        )
         add(
             "GET", r"/transform/projection/" + NAME,
             lambda m, b, q: (
@@ -219,7 +269,17 @@ class APIServer:
             )
             return self._created(f"transform/{tool}", meta)
 
+        def transform_update(m, body, query):
+            meta = self.transform.update_generic(
+                m.group("name"),
+                class_parameters=body.get("classParameters"),
+                method_parameters=body.get("methodParameters"),
+                description=body.get("description", ""),
+            )
+            return 200, {"metadata": meta}
+
         add("POST", rf"/transform/{TOOL}", transform_create)
+        add("PATCH", rf"/transform/{TOOL}/{NAME}", transform_update)
         add(
             "GET", rf"/transform/{TOOL}/{NAME}",
             lambda m, b, q: (
@@ -268,7 +328,18 @@ class APIServer:
             )
             return self._created(f"explore/{tool}", meta)
 
+        def explore_update(m, body, query):
+            meta = self.explore.update_plot(
+                m.group("name"),
+                class_parameters=body.get("classParameters"),
+                method_parameters=body.get("methodParameters"),
+                color_by=body.get("colorBy"),
+                description=body.get("description", ""),
+            )
+            return 200, {"metadata": meta}
+
         add("POST", rf"/explore/{TOOL}", explore_create)
+        add("PATCH", rf"/explore/{TOOL}/{NAME}", explore_update)
         # GET {name} returns the PNG; {name}/metadata returns docs
         # (reference: krakend.json explore block, SURVEY §2.2).
         add(
@@ -283,6 +354,9 @@ class APIServer:
             data = self.explore.read_image(m.group("name"))
             return 200, ("image/png", data)
 
+        # NOT cacheable: a PATCH re-render writes the new PNG from a
+        # background job AFTER the invalidation fires, so a TTL cache
+        # could re-trap the old image for cache_ttl_s.
         add("GET", rf"/explore/{TOOL}/{NAME}", explore_image)
         add(
             "DELETE", rf"/explore/{TOOL}/{NAME}",
@@ -547,12 +621,14 @@ class APIServer:
                 _time.sleep(0.1)
             return 200, {"metadata": self.ctx.artifacts.metadata.read(name)}
 
-        add("GET", r"/observe/" + NAME, observe_wait)
+        # Deliberate long-poll: exempt from the gateway deadline.
+        add("GET", r"/observe/" + NAME, observe_wait, no_timeout=True)
 
         # ---- Introspection ----
         add(
             "GET", r"/registry",
             lambda m, b, q: (200, registry.list_registered()),
+            cacheable=True,
         )
         add(
             "GET", r"/artifacts",
@@ -562,11 +638,34 @@ class APIServer:
         )
         add("GET", r"/health", lambda m, b, q: (200, {"status": "ok"}))
 
+        def metrics_view(m, body, query):
+            with self._metrics_lock:
+                routes = {
+                    k: {
+                        **v,
+                        "avg_ms": round(v["total_ms"] / v["count"], 3)
+                        if v["count"] else 0.0,
+                    }
+                    for k, v in self._metrics.items()
+                }
+            return 200, {
+                "routes": routes,
+                "budget": {
+                    "request_timeout_s":
+                        self.config.api.request_timeout_s,
+                    "cache_ttl_s": self.config.api.cache_ttl_s,
+                },
+            }
+
+        # Per-route request counts/latencies — the krakend :8090
+        # metrics exporter's role (SURVEY §5.1).
+        add("GET", r"/metrics", metrics_view)
+
     # -- HTTP plumbing --------------------------------------------------------
 
-    def handle(self, verb: str, path: str, body: dict, query: dict):
+    def _handle_raw(self, handler, m, body, query):
         try:
-            return self.router.dispatch(verb, path, body, query)
+            return handler(m, body, query)
         except (DuplicateArtifact, ConflictError) as exc:
             return 409, {"error": str(exc)}
         except NotFoundError as exc:
@@ -578,8 +677,92 @@ class APIServer:
                          if isinstance(exc, json.JSONDecodeError)
                          else str(exc)}
         except Exception as exc:  # pragma: no cover - defensive
-            traceback.print_exc()
+            from learningorchestra_tpu.log import get_logger
+
+            get_logger("api").exception("unhandled handler error: %r", exc)
             return 500, {"error": repr(exc)}
+
+    def _record_metric(self, key: str, status: int, dt_ms: float) -> None:
+        with self._metrics_lock:
+            rec = self._metrics.setdefault(
+                key,
+                {"count": 0, "errors": 0, "total_ms": 0.0, "max_ms": 0.0},
+            )
+            rec["count"] += 1
+            if status >= 400:
+                rec["errors"] += 1
+            rec["total_ms"] += dt_ms
+            rec["max_ms"] = max(rec["max_ms"], dt_ms)
+
+    def handle(self, verb: str, path: str, body: dict, query: dict):
+        """Dispatch with the gateway budget enforced: request deadline
+        (reference: krakend 10 s global timeout → 504), TTL response
+        cache on opted-in GETs (300 s ``cache_ttl``), and per-route
+        metrics (krakend's :8090 exporter → GET /metrics)."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        handler, m, route_key, flags = self.router.resolve(verb, path)
+        if handler is None:
+            status, payload = self.router.dispatch(verb, path, body, query)
+            self._record_metric(
+                route_key, status, (_time.perf_counter() - t0) * 1e3
+            )
+            return status, payload
+
+        ttl = self.config.api.cache_ttl_s
+        cache_key = None
+        if verb == "GET" and flags.get("cacheable") and ttl > 0:
+            cache_key = (path, tuple(sorted(query.items())))
+            with self._cache_lock:
+                hit = self._cache.get(cache_key)
+                if hit is not None and hit[0] > _time.monotonic():
+                    self._record_metric(
+                        route_key, hit[1],
+                        (_time.perf_counter() - t0) * 1e3,
+                    )
+                    return hit[1], hit[2]
+        elif verb != "GET":
+            # Any mutation invalidates the whole response cache — cheap
+            # and safe (mutations are rare next to poll GETs).
+            with self._cache_lock:
+                self._cache.clear()
+
+        timeout = self.config.api.request_timeout_s
+        if flags.get("no_timeout") or timeout <= 0:
+            status, payload = self._handle_raw(handler, m, body, query)
+        else:
+            # Per-request thread (NOT a shared pool: N stuck handlers
+            # must not poison a fixed pool into serving only 504s). The
+            # abandoned thread finishes on its own; Python offers no
+            # safe cancellation, so a timed-out mutation may still
+            # commit later — same semantics as any gateway timeout.
+            box: dict = {}
+
+            def _run():
+                box["result"] = self._handle_raw(handler, m, body, query)
+
+            worker = threading.Thread(
+                target=_run, name="gateway-req", daemon=True
+            )
+            worker.start()
+            worker.join(timeout)
+            if "result" in box:
+                status, payload = box["result"]
+            else:
+                status, payload = 504, {
+                    "error": f"request exceeded {timeout}s gateway budget"
+                }
+
+        if cache_key is not None and status < 400:
+            with self._cache_lock:
+                self._cache[cache_key] = (
+                    _time.monotonic() + ttl, status, payload
+                )
+        self._record_metric(
+            route_key, status, (_time.perf_counter() - t0) * 1e3
+        )
+        return status, payload
 
     def serve_forever(self, host: str | None = None, port: int | None = None):
         api = self

@@ -22,11 +22,15 @@ from __future__ import annotations
 import contextlib
 import io
 import threading
+import time
 import traceback
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable
 
+from learningorchestra_tpu.log import get_logger, kv
 from learningorchestra_tpu.store import ArtifactStore
+
+logger = get_logger("jobs")
 
 
 class JobState:
@@ -84,8 +88,10 @@ class JobEngine:
             meta = self.artifacts.metadata
             ledger = self.artifacts.ledger
             attempts = 0
+            t_start = time.monotonic()
             while True:
                 meta.mark_running(name)
+                logger.info(kv(job=name, state="running", method=method))
                 buf = io.StringIO()
                 try:
                     if capture_stdout:
@@ -95,6 +101,9 @@ class JobEngine:
                         result = fn()
                 except Preempted:
                     attempts += 1
+                    logger.warning(
+                        kv(job=name, state="preempted", attempt=attempts)
+                    )
                     ledger.record(
                         name,
                         description=description,
@@ -109,6 +118,10 @@ class JobEngine:
                     return None
                 except BaseException as exc:  # jobs must never kill workers
                     err = repr(exc)
+                    logger.error(
+                        kv(job=name, state="failed", error=err,
+                           dt=f"{time.monotonic() - t_start:.2f}s")
+                    )
                     meta.mark_failed(name, err)
                     ledger.record(
                         name,
@@ -125,6 +138,10 @@ class JobEngine:
                     return None
 
                 extra = on_success(result) if on_success else None
+                logger.info(
+                    kv(job=name, state="finished",
+                       dt=f"{time.monotonic() - t_start:.2f}s")
+                )
                 meta.mark_finished(name, extra or None)
                 ledger.record(
                     name,

@@ -1,0 +1,55 @@
+"""Structured, leveled logging for the framework.
+
+The reference logs with bare ``print(..., flush=True)`` scattered through
+hot paths (reference: binary_executor_image/server.py:34,40,
+binary_execution.py:242-258 — some in Portuguese); round 1 inherited
+that.  This module gives every component one leveled logger with a
+single-line structured format::
+
+    2026-07-29T12:00:00 INFO lo.jobs job=mnist_fit state=finished dt=3.2s
+
+Durable observability stays in the execution ledger (store/artifacts.py
+— every job's parameters/exception/stdout are persisted as documents,
+SURVEY §5.5); the logger is the live, leveled stream next to it.
+
+``LO_TPU_LOG_LEVEL`` sets the level (default INFO).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_ROOT = "lo"
+_configured = False
+
+
+def _configure() -> None:
+    global _configured
+    if _configured:
+        return
+    root = logging.getLogger(_ROOT)
+    if not root.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s %(message)s",
+            datefmt="%Y-%m-%dT%H:%M:%S",
+        ))
+        root.addHandler(handler)
+    level = os.environ.get("LO_TPU_LOG_LEVEL", "INFO").upper()
+    root.setLevel(getattr(logging, level, logging.INFO))
+    root.propagate = False
+    _configured = True
+
+
+def get_logger(component: str) -> logging.Logger:
+    """Logger for a component, namespaced under the framework root
+    (``get_logger("jobs")`` → ``lo.jobs``)."""
+    _configure()
+    return logging.getLogger(f"{_ROOT}.{component}")
+
+
+def kv(**fields) -> str:
+    """Format key=value pairs consistently for log lines."""
+    return " ".join(f"{k}={v}" for k, v in fields.items())

@@ -29,6 +29,10 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 
+from learningorchestra_tpu.log import get_logger, kv
+
+logger = get_logger("coordinator")
+
 DEFAULT_PLACEMENT_TIMEOUT_S = 120.0  # reference parity: server.py:16
 HEARTBEAT_INTERVAL_S = 5.0
 AGENT_DEAD_AFTER_S = 30.0
@@ -203,6 +207,8 @@ class Coordinator:
                 "capacity": capacity,
                 "last_seen": time.time(),
             }
+        logger.info(kv(event="agent_register", agent=agent_id,
+                       capacity=capacity))
         return {"ok": True}
 
     def heartbeat(self, agent_id: str) -> dict:
@@ -276,6 +282,10 @@ class Coordinator:
                 if dead and not reported:
                     job["leased"].remove(holder)
                     job["ranks"].pop(holder, None)
+                    logger.warning(kv(
+                        event="lease_reclaimed", job=job_id,
+                        dead_agent=holder, rank=hrank,
+                    ))
             if len(job["leased"]) >= job["n_agents"]:
                 return None
             if agent_id in job["leased"]:
@@ -322,6 +332,10 @@ class Coordinator:
             covered = set(job["results"]) | set(job["errors"])
             if len(covered) >= job["n_agents"]:
                 job["state"] = "failed" if job["errors"] else "finished"
+                logger.info(kv(
+                    event="job_done", job=job_id, state=job["state"],
+                    errors=len(job["errors"]),
+                ))
         return {"ok": True}
 
     def wait(

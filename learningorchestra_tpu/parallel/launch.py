@@ -1,0 +1,134 @@
+"""Coordinator-driven multi-host training launch.
+
+The reference's flagship distributed path ships a model definition to
+Ray workers and runs ``train`` on each, gathering rank-0 weights
+(reference: microservices/binary_executor_image/binary_execution.py:
+237-292, training_function/train_function.py:53-139).  Here the same
+shape is a *registered* coordinator function (never pickled code over
+the wire, SURVEY §5.8) that every ``HostAgent`` runs with its assigned
+``rank``/``world_size``:
+
+1. join the global JAX runtime (``jax.distributed.initialize`` — ICI
+   within a slice, DCN across hosts);
+2. build the estimator from the toolkit registry and the global mesh
+   from the request's mesh spec;
+3. run ``DistributedTrainer.fit`` — ONE SPMD program over every host's
+   devices (gradients all-reduce inside the jitted step; there is no
+   host-side ring to rendezvous);
+4. rank 0 persists the trained state (the reference's rank-0
+   ``get_weights`` contract, minus weight lists through the control
+   plane — state goes straight to the artifact store).
+
+Every process passes the same host-side dataset (the reference's
+convention: each Horovod worker loaded the data); the trainer hands
+each process only its addressable shards on device.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import numpy as np
+
+from learningorchestra_tpu.parallel.coordinator import (
+    init_multihost,
+    register_function,
+)
+
+# jax.distributed.initialize may only run once per process; remember the
+# address we joined so a second job on the same agent can proceed (same
+# cluster) or fail loudly (different cluster).
+_joined: dict[str, Any] = {}
+
+
+def _join(jax_coordinator: str, world_size: int, rank: int) -> None:
+    if _joined:
+        if _joined.get("addr") != jax_coordinator:
+            raise RuntimeError(
+                f"agent already joined JAX cluster {_joined['addr']!r}; "
+                f"cannot join {jax_coordinator!r}"
+            )
+        return
+    init_multihost(jax_coordinator, world_size, rank)
+    _joined.update({"addr": jax_coordinator, "rank": rank})
+
+
+@register_function("lo.multihost_fit")
+def multihost_fit(
+    rank: int,
+    world_size: int,
+    *,
+    jax_coordinator: str,
+    module_path: str,
+    class_name: str,
+    class_parameters: dict | None = None,
+    mesh: dict | None = None,
+    data: dict,
+    fit: dict | None = None,
+    out: dict | None = None,
+) -> dict:
+    """Join the global mesh and run one sharded fit; see module docstring.
+
+    ``data``: {"x": <.npy path>, "y": <.npy path>} — every host loads the
+    full arrays.  ``out``: {"volume_root", "artifact_type", "name"} —
+    rank 0 persists the trained estimator there.  Returns the training
+    history (every rank returns it; the coordinator keys results by
+    rank, so callers read rank 0's).
+    """
+    import jax
+
+    _join(jax_coordinator, world_size, rank)
+
+    from learningorchestra_tpu.parallel.distributed import DistributedTrainer
+    from learningorchestra_tpu.parallel.mesh import MeshSpec, build_mesh
+    from learningorchestra_tpu.toolkit import registry
+
+    factory = registry.resolve(module_path, class_name)
+    est = factory(**(class_parameters or {}))
+
+    spec = MeshSpec.from_dict(mesh or {"dp": jax.device_count()})
+    trainer = DistributedTrainer(est, mesh=build_mesh(spec))
+
+    x = np.load(data["x"], allow_pickle=False)
+    y = np.load(data["y"], allow_pickle=False)
+    trainer.fit(x, y, **(fit or {}))
+
+    if out and jax.process_index() == 0:
+        from learningorchestra_tpu.store.volumes import VolumeStorage
+
+        storage = VolumeStorage(out["volume_root"])
+        storage.save_object(
+            out.get("artifact_type", "train/tensorflow"), out["name"], est
+        )
+
+    return {
+        "rank": rank,
+        "process_index": jax.process_index(),
+        "history": {k: list(v) for k, v in trainer.history.items()},
+    }
+
+
+def agent_main(
+    coordinator_address: str,
+    agent_id: str | None = None,
+    poll_interval: float = 0.05,
+) -> None:
+    """Foreground host-agent loop — the per-host entry point a deploy
+    runs next to the TPU VM (replaces the reference's ray-worker
+    container, docker-compose.yml:329-347).  Importing this module
+    registers the multihost functions before serving."""
+    from learningorchestra_tpu.parallel.coordinator import HostAgent
+
+    agent = HostAgent(
+        coordinator_address,
+        agent_id or f"agent-{os.getpid()}",
+    )
+    agent.serve(poll_interval=poll_interval)
+    try:
+        while True:
+            import time
+
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        agent.stop()

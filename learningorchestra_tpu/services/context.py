@@ -53,6 +53,11 @@ class ServiceContext:
             self.artifacts, max_workers=self.config.jobs.max_workers
         )
         self.loader = StoreLoader(self)
+        from learningorchestra_tpu.jobs.leases import DeviceLeaser
+
+        # Per-job accelerator placement (jobs/leases.py): concurrent
+        # neural jobs serialize per chip instead of contending for HBM.
+        self.leaser = DeviceLeaser()
         self._init_backend()
 
     def _init_backend(self) -> None:
@@ -104,6 +109,33 @@ class ServiceContext:
         if meta is None:
             raise NotFoundError(f"no such artifact: {name!r}")
         return meta
+
+    def require_not_running(self, name: str) -> dict:
+        """PATCH re-run gate: two jobs for one artifact must not run
+        concurrently (each would interleave delete/insert over the same
+        collection and flip ``finished`` under the other) — 409 while
+        the previous job is still executing."""
+        meta = self.require_existing(name)
+        if meta.get("jobState") in ("pending", "running"):
+            raise ConflictError(
+                f"artifact {name!r} has a job in state "
+                f"{meta.get('jobState')!r}; wait for it to finish"
+            )
+        return meta
+
+    def last_recorded_parameters(self, name: str):
+        """The most recent request parameters persisted to the execution
+        ledger for ``name`` — the fallback a bare PATCH re-run (no body
+        parameters, the natural "just resume" call after a preemption)
+        re-submits with, instead of failing on missing x/y."""
+        rows = [
+            d
+            for d in self.documents.find(
+                name, query={"docType": "execution"}
+            )
+            if d.get("parameters") is not None
+        ]
+        return rows[-1]["parameters"] if rows else None
 
     def checkpoint_dir(self, name: str):
         """Managed per-artifact train-checkpoint tree — the ONE place

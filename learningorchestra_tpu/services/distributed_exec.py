@@ -173,6 +173,11 @@ class DistributedExecutorService:
             )
         parent_meta = self.ctx.require_finished_parent(parent)
         resume = meta.get("jobState") == "failed"
+        if not training_parameters:
+            # Bare PATCH ("just resume"): re-run with the original
+            # request's parameters from the execution ledger rather than
+            # reaching fit() with no x/y (ADVICE r1).
+            training_parameters = self.ctx.last_recorded_parameters(name)
         self.ctx.artifacts.metadata.restart(name)
         self._submit_train(
             name, parent_meta, training_parameters, compile_spec,
@@ -223,13 +228,20 @@ class DistributedExecutorService:
             if not params["resume"] and ckdir.exists():
                 _shutil.rmtree(ckdir, ignore_errors=True)
             params["checkpoint_dir"] = str(ckdir)
-            t0 = time.perf_counter()
-            if session_name is not None:
-                with self.monitoring.trace(session_name):
+            # A distributed fit spans the host's whole slice: lease ALL
+            # devices so it never interleaves with single-chip jobs.
+            with self.ctx.leaser.lease(0, label=name) as devs:
+                if devs:
+                    self.ctx.artifacts.metadata.update(
+                        name, {"leasedDevices": devs}
+                    )
+                t0 = time.perf_counter()
+                if session_name is not None:
+                    with self.monitoring.trace(session_name):
+                        trainer.fit(**params)
+                else:
                     trainer.fit(**params)
-            else:
-                trainer.fit(**params)
-            fit_time = time.perf_counter() - t0
+                fit_time = time.perf_counter() - t0
             self.ctx.volumes.save_object(artifact_type, name, instance)
             # Replace (not append) history rows on re-runs.
             for doc in self.ctx.documents.find(

@@ -88,8 +88,58 @@ class ExploreService:
             module_path=module_path,
             class_name=class_name,
             method=method,
+            # Persisted so a PATCH re-run can re-render without the
+            # original request body.
+            extra={
+                "classParameters": class_parameters or {},
+                "colorBy": color_by,
+            },
         )
+        self._submit_plot(
+            name, factory, class_parameters, method, method_parameters,
+            artifact_type, color_by, description, class_name,
+        )
+        return meta
 
+    def update_plot(
+        self,
+        name: str,
+        *,
+        class_parameters: dict | None = None,
+        method_parameters: dict | None = None,
+        color_by: str | None = None,
+        description: str = "",
+    ) -> dict:
+        """PATCH re-run of a plot execution (reference: PATCH
+        /explore/{t} → database_executor_image/server.py:91-148): flips
+        ``finished`` False and re-renders, with new parameters when
+        given, else the original request's."""
+        meta = self.ctx.require_not_running(name)
+        module_path = meta.get("modulePath")
+        class_name = meta.get("class")
+        if not module_path or not class_name:
+            raise ValidationError(
+                f"{name!r} is not a re-runnable explore execution"
+            )
+        factory = registry.resolve(module_path, class_name)
+        if class_parameters is None:
+            class_parameters = meta.get("classParameters") or {}
+        if method_parameters is None:
+            method_parameters = self.ctx.last_recorded_parameters(name)
+        if color_by is None:
+            color_by = meta.get("colorBy")
+        self.ctx.artifacts.metadata.restart(name)
+        self._submit_plot(
+            name, factory, class_parameters, meta.get("method"),
+            method_parameters, meta.get("type"), color_by, description,
+            class_name,
+        )
+        return self.ctx.artifacts.metadata.read(name)
+
+    def _submit_plot(
+        self, name, factory, class_parameters, method, method_parameters,
+        artifact_type, color_by, description, class_name,
+    ) -> None:
         def run():
             import numpy as np
 
@@ -108,9 +158,9 @@ class ExploreService:
 
         self.ctx.engine.submit(
             name, run, description=description or f"{class_name} plot",
+            method=method, parameters=method_parameters,
             on_success=lambda r: r,
         )
-        return meta
 
     def _render_scatter(self, name, artifact_type, points, colors=None):
         import matplotlib

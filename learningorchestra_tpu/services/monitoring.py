@@ -218,12 +218,20 @@ class MonitoringService:
 
 
 def write_scalar_logs(logdir: str, history: dict, *, prefix: str = "") -> int:
-    """Write a TrainHistory as TensorBoard scalar events (no TF needed —
-    minimal event-file encoding via tensorboardX-style records is overkill;
-    we emit a CSV the profile-less UI and users can read, plus return the
-    row count).  Durable metrics rows for the GET/poll contract live in the
-    document store (SURVEY §5.5); this is the human-readable copy."""
+    """Write a TrainHistory into the monitored logdir twice over:
+
+    - a real tfevents file (services/tfevents.py) so the managed
+      TensorBoard session renders loss/accuracy curves — the reference's
+      monitoring contract (binary_executor_image/server.py:323-329,
+      where keras callbacks write the events);
+    - a CSV as the human-readable copy.
+
+    Durable metrics rows for the GET/poll contract live in the document
+    store (SURVEY §5.5).  Returns the epoch-row count."""
+    from learningorchestra_tpu.services.tfevents import write_scalars
+
     os.makedirs(logdir, exist_ok=True)
+    write_scalars(logdir, history, prefix=prefix)
     path = os.path.join(logdir, f"{prefix or 'metrics'}.csv")
     keys = sorted(history)
     n = max((len(v) for v in history.values()), default=0)

@@ -49,14 +49,53 @@ class TransformService:
             name, PROJECTION_TYPE, parent_name=parent_name,
             extra={"fields": fields},
         )
+        self._submit_projection(name, parent_name, fields, replace=False)
+        return meta
 
+    def update_projection(
+        self, name: str, fields: list[str] | None = None
+    ) -> dict:
+        """PATCH re-run (reference: PATCH /transform/projection →
+        database_executor_image/server.py:91-148 — flip ``finished``
+        False and re-execute): replaces the projected rows, with new
+        ``fields`` when given, else the original request's."""
+        meta = self.ctx.require_not_running(name)
+        if meta.get("type") != PROJECTION_TYPE:
+            raise ValidationError(f"{name!r} is not a projection")
+        parent_name = meta.get("parentName")
+        parent = self.ctx.require_finished_parent(parent_name)
+        fields = fields or meta.get("fields") or []
+        parent_fields = parent.get("fields") or []
+        if parent_fields:
+            missing = [f for f in fields if f not in parent_fields]
+            if missing:
+                raise ValidationError(
+                    f"fields not in parent dataset: {missing}"
+                )
+        self.ctx.artifacts.metadata.restart(name)
+        self._submit_projection(name, parent_name, fields, replace=True)
+        return self.ctx.artifacts.metadata.read(name)
+
+    def _submit_projection(
+        self, name: str, parent_name: str, fields: list[str], *,
+        replace: bool,
+    ) -> None:
         def project():
+            if replace:
+                for doc in self.ctx.documents.find(
+                    name,
+                    query={
+                        "_id": {"$gte": 1},
+                        "docType": {"$ne": "execution"},
+                    },
+                ):
+                    self.ctx.documents.delete_one(name, doc["_id"])
             if hasattr(self.ctx.documents, "project"):
                 # Native scan: rows never materialize as Python objects
                 # (the reference runs this as a Spark job over the
                 # mongo connector; projection_image/projection.py:20-48).
                 n = self.ctx.documents.project(parent_name, name, fields)
-                return {"rows": n}
+                return {"rows": n, "fields": fields}
             docs = self.ctx.documents.find(
                 parent_name,
                 query={"_id": {"$gte": 1}, "docType": {"$ne": "execution"}},
@@ -65,13 +104,13 @@ class TransformService:
                 {f: d.get(f) for f in fields} for d in docs
             )
             n = self.ctx.documents.insert_many(name, out)
-            return {"rows": n}
+            return {"rows": n, "fields": fields}
 
         self.ctx.engine.submit(
             name, project, description=f"projection of {parent_name}",
+            parameters={"fields": fields},
             on_success=lambda r: r,
         )
-        return meta
 
     # -- dtype casting --------------------------------------------------------
 
@@ -156,8 +195,52 @@ class TransformService:
             module_path=module_path,
             class_name=class_name,
             method=method,
+            # Persisted so a PATCH re-run can rebuild the instance
+            # without the original request body.
+            extra={"classParameters": class_parameters or {}},
         )
+        self._submit_generic(
+            name, factory, class_parameters, method, method_parameters,
+            artifact_type, description, class_name,
+        )
+        return meta
 
+    def update_generic(
+        self,
+        name: str,
+        *,
+        class_parameters: dict | None = None,
+        method_parameters: dict | None = None,
+        description: str = "",
+    ) -> dict:
+        """PATCH re-run of a generic transform (reference:
+        database_executor_image/server.py:91-148): re-executes with new
+        parameters when given, else the original request's (class params
+        from metadata, method params from the execution ledger)."""
+        meta = self.ctx.require_not_running(name)
+        module_path = meta.get("modulePath")
+        class_name = meta.get("class")
+        if not module_path or not class_name:
+            raise ValidationError(
+                f"{name!r} is not a re-runnable transform execution"
+            )
+        factory = registry.resolve(module_path, class_name)
+        if class_parameters is None:
+            class_parameters = meta.get("classParameters") or {}
+        if method_parameters is None:
+            method_parameters = self.ctx.last_recorded_parameters(name)
+        method = meta.get("method")
+        self.ctx.artifacts.metadata.restart(name)
+        self._submit_generic(
+            name, factory, class_parameters, method, method_parameters,
+            meta.get("type"), description, class_name,
+        )
+        return self.ctx.artifacts.metadata.read(name)
+
+    def _submit_generic(
+        self, name, factory, class_parameters, method, method_parameters,
+        artifact_type, description, class_name,
+    ) -> None:
         def run():
             cls_params = dsl.resolve_params(
                 class_parameters, self.ctx.loader
@@ -176,4 +259,3 @@ class TransformService:
             name, run, description=description or f"{class_name}.{method}",
             method=method, parameters=method_parameters,
         )
-        return meta

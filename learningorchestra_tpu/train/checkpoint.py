@@ -1,4 +1,4 @@
-"""Managed in-loop training checkpoints (orbax-backed).
+"""Managed in-loop training checkpoints (orbax-backed, shard-aware).
 
 The reference has NO intra-training checkpointing: a mid-job failure
 loses the job and distributed training returns weights only at the end
@@ -7,6 +7,19 @@ documents that a task running when the cluster dies "is lost").  On TPU,
 preemption is routine, so the train executor checkpoints the estimator
 state every N epochs and PATCH re-runs resume instead of restarting —
 closing the gap SURVEY §5.4 calls out.
+
+Sharding contract:
+- ``save`` takes the state tree AS IS — sharded ``jax.Array`` leaves are
+  written by orbax shard-by-shard from the process(es) that own them;
+  there is **no host gather** (a v4-32 ResNet/BERT state never
+  materializes on one host).
+- ``load_latest`` restores INTO the template's placement: a template of
+  mesh-sharded arrays yields sharded arrays on that mesh (which may be a
+  *different* mesh shape than the one that saved — orbax reshards on
+  read); a host-numpy template yields numpy.
+- Multi-process: ``save``/``load_latest`` are collective — every process
+  calls them; only process 0 writes the ``latest.json`` marker and
+  prunes old steps.
 
 Layout under ``<dir>``::
 
@@ -33,29 +46,53 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
-def save(directory: str | Path, step: int, state: dict,
-         history: dict | None = None) -> Path:
-    """Persist {params, opt_state} at ``step``; returns the step path."""
+def _is_primary() -> bool:
     import jax
 
+    return jax.process_index() == 0
+
+
+def _barrier(tag: str) -> None:
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+
+def save(directory: str | Path, step: int, state: dict,
+         history: dict | None = None) -> Path:
+    """Persist {params, opt_state} at ``step``; returns the step path.
+
+    Collective under multi-process JAX; sharded leaves are written
+    without gathering to host.
+    """
     directory = Path(directory)
-    directory.mkdir(parents=True, exist_ok=True)
+    if _is_primary():
+        directory.mkdir(parents=True, exist_ok=True)
+        path = directory / f"step_{step}"
+        if path.exists():
+            shutil.rmtree(path)
     path = directory / f"step_{step}"
-    if path.exists():
-        shutil.rmtree(path)
+    _barrier(f"ckpt-pre-{step}")
     with _checkpointer() as ck:
-        ck.save(path, jax.device_get(state))
-    marker = {"step": step, "history": history or {}}
-    tmp = directory / "latest.json.tmp"
-    tmp.write_text(json.dumps(marker))
-    os.replace(tmp, directory / "latest.json")
-    for old in sorted(directory.glob("step_*")):
-        try:
-            n = int(old.name.split("_", 1)[1])
-        except ValueError:
-            continue
-        if n <= step - KEEP:
-            shutil.rmtree(old, ignore_errors=True)
+        ck.save(path, state)
+    # StandardCheckpointer.save commits (atomic rename) before returning,
+    # on every process, so the marker write below cannot race the data.
+    if _is_primary():
+        marker = {"step": step, "history": history or {}}
+        tmp = directory / "latest.json.tmp"
+        tmp.write_text(json.dumps(marker))
+        os.replace(tmp, directory / "latest.json")
+        for old in sorted(directory.glob("step_*")):
+            try:
+                n = int(old.name.split("_", 1)[1])
+            except ValueError:
+                continue
+            if n <= step - KEEP:
+                shutil.rmtree(old, ignore_errors=True)
+    _barrier(f"ckpt-post-{step}")
     return path
 
 
@@ -64,7 +101,11 @@ def load_latest(directory: str | Path, template: dict):
 
     ``template`` is a concrete pytree with the target structure (e.g. a
     freshly-initialized {params, opt_state}) — orbax uses it to rebuild
-    optax's namedtuple states exactly.
+    optax's namedtuple states exactly, and restores each leaf onto the
+    template leaf's placement: numpy template → numpy out; mesh-sharded
+    ``jax.Array`` template → sharded arrays on that mesh (any mesh
+    shape — restore-time resharding is how a job resumes on a different
+    slice than the one that saved).
     """
     directory = Path(directory)
     marker_path = directory / "latest.json"
@@ -78,8 +119,6 @@ def load_latest(directory: str | Path, template: dict):
     path = directory / f"step_{step}"
     if not path.exists():
         return None
-    import jax
-
     with _checkpointer() as ck:
-        state = ck.restore(path, jax.device_get(template))
+        state = ck.restore(path, template)
     return state, step, marker.get("history") or {}

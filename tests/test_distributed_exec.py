@@ -294,6 +294,21 @@ def test_distributed_train_patch_rerun(api, dataset):
     )
     assert epochs == [0, 1, 2]
 
+    # Bare PATCH (no trainingParameters) — the natural "just re-run"
+    # call — must fall back to the ledger's recorded parameters instead
+    # of reaching fit() without x/y (ADVICE r1).
+    resp = requests.patch(f"{base}/train/horovod/dp_fit", json={})
+    assert resp.status_code == 200, resp.text
+    meta = poll(base, "/train/horovod/dp_fit")
+    assert meta["finished"]
+    docs = requests.get(
+        f"{base}/train/horovod/dp_fit", params={"limit": 50}
+    ).json()
+    epochs = sorted(
+        d["epoch"] for d in docs if d.get("docType") == "history"
+    )
+    assert epochs == [0, 1, 2]  # original 3-epoch request re-applied
+
 
 def test_distributed_train_rejects_raw_checkpoint_dir(api, dataset):
     base, _ = api

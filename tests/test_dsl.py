@@ -74,3 +74,38 @@ def test_split_special_params():
     )
     assert special == {"callbacks": ["x"], "rank0callbacks": ["y"]}
     assert rest == {"epochs": 3}
+
+
+class TestSpecSandboxTightening:
+    """`#` specs are attribute-root-allowlisted with IO attrs denied at
+    every chain level (VERDICT r1 weak item 7)."""
+
+    def test_io_escapes_rejected(self):
+        from learningorchestra_tpu.dsl import (
+            DSLResolutionError,
+            evaluate_spec,
+        )
+
+        for expr in (
+            'np.load("/etc/passwd")',
+            'jnp.load("/x")',
+            'np.fromfile("/x")',
+            'open("/etc/passwd")',
+            'getattr(np, "lo" + "ad")',
+            "np.ctypeslib",
+            "[x for x in (1, 2)]",
+            "lambda: 1",
+            "unknownname",
+        ):
+            with pytest.raises(DSLResolutionError):
+                evaluate_spec(expr)
+
+    def test_legitimate_specs_still_work(self):
+        from learningorchestra_tpu.dsl import evaluate_spec
+
+        opt = evaluate_spec("optax.adam(1e-3)")
+        assert hasattr(opt, "update")
+        layers = evaluate_spec("[nn.Dense(8), nn.relu]")
+        assert len(layers) == 2
+        assert float(evaluate_spec("jnp.ones((2, 2))").sum()) == 4.0
+        assert evaluate_spec("np.float32") is not None
