@@ -24,21 +24,26 @@ mkdir -p "$LO_TPU_STORE_ROOT" "$LO_TPU_VOLUME_ROOT"
 PIDS=()
 
 # Supervise: restart the role if it exits non-zero (the reference's
-# on-failure policy); clean exit (0) ends supervision.
+# on-failure policy); clean exit (0) ends supervision.  Each supervisor
+# runs in its OWN process group (setsid) so cleanup can kill the whole
+# tree — background subshells share the script's pgid, and killing just
+# the subshell would orphan the python service it spawned.
 supervise() {
   local name="$1"; shift
-  (
+  local cmd
+  printf -v cmd '%q ' "$@"
+  setsid bash -c '
     while true; do
-      "$@"
+      '"$cmd"'
       code=$?
       if [ "$code" -eq 0 ]; then
-        echo "[$name] exited cleanly" >&2
+        echo "['"$name"'] exited cleanly" >&2
         break
       fi
-      echo "[$name] exited with $code — restarting in 1s" >&2
+      echo "['"$name"'] exited with $code — restarting in 1s" >&2
       sleep 1
     done
-  ) &
+  ' &
   PIDS+=($!)
 }
 

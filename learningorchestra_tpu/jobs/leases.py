@@ -87,13 +87,19 @@ class DeviceLeaser:
         n_devices: int = 1,
         *,
         label: str = "",
-        timeout: float = DEFAULT_LEASE_TIMEOUT_S,
+        timeout: float | None = None,
     ):
         """Hold ``n_devices`` accelerator devices for the with-block.
 
         ``n_devices <= 0`` means "all devices" (a distributed fit spans
         the host's whole slice).  Yields the leased device ids — empty
         on CPU-only backends, where the block runs unplaced.
+
+        ``timeout=None`` (the default, used by the job services) WAITS
+        — a queued job behind a long training run must queue, not fail;
+        the job engine's pool bounds how many can wait.  Pass a finite
+        timeout to get ``LeaseTimeout`` instead (the reference's 120 s
+        placement-timeout semantics).
         """
         with self._cv:
             self._ensure_devices()
@@ -103,8 +109,14 @@ class DeviceLeaser:
                 want = len(self._all) if n_devices <= 0 else min(
                     n_devices, len(self._all)
                 )
-                deadline = time.monotonic() + timeout
+                deadline = (
+                    None if timeout is None
+                    else time.monotonic() + timeout
+                )
                 while len(self._free) < want:
+                    if deadline is None:
+                        self._cv.wait()
+                        continue
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         raise LeaseTimeout(

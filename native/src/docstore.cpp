@@ -735,10 +735,13 @@ static std::string canonical_count_key(const std::string &val) {
   char *end = nullptr;
   double d = strtod(val.c_str(), &end);
   if (end == val.c_str() || *end != '\0' || errno == ERANGE) return val;
-  char buf[64];
   // Magnitude guard FIRST: (long long)d on an out-of-range double
-  // (1e300, inf) is undefined behavior.
-  if (std::fabs(d) < 9e15 && d == (double)(long long)d) {
+  // (1e300, inf) is undefined behavior.  Beyond 2^53 doubles alias
+  // distinct integers, so keep the raw text — Python's exact ints keep
+  // such values in separate buckets and so must we.
+  if (std::fabs(d) >= 9e15) return val;
+  char buf[64];
+  if (d == (double)(long long)d) {
     snprintf(buf, sizeof buf, "%lld", (long long)d);
   } else {
     snprintf(buf, sizeof buf, "%.17g", d);
