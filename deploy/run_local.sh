@@ -19,6 +19,12 @@ DATA_ROOT="${LO_DATA_ROOT:-$PWD/lo-data}"
 export LO_TPU_API_PORT="$API_PORT"
 export LO_TPU_STORE_ROOT="${LO_TPU_STORE_ROOT:-$DATA_ROOT/store}"
 export LO_TPU_VOLUME_ROOT="${LO_TPU_VOLUME_ROOT:-$DATA_ROOT/volumes}"
+# Cluster mode: POST /train/horovod fans out to the agents below
+# (LO_CLUSTER_MODE=0 keeps fits in-process in the API server).
+if [ "${LO_CLUSTER_MODE:-1}" = "1" ] && [ "$N_AGENTS" -ge 2 ]; then
+  export LO_TPU_TASK_COORDINATOR="127.0.0.1:$COORD_PORT"
+  export LO_TPU_WORLD_SIZE="$N_AGENTS"
+fi
 mkdir -p "$LO_TPU_STORE_ROOT" "$LO_TPU_VOLUME_ROOT"
 
 PIDS=()

@@ -59,8 +59,11 @@ class Context:
         self.dataset_generic = _Dataset(self, "generic")
         self.projection = _Projection(self)
         self.data_type = _DataType(self)
+        self.transform = _Transform(self, "tensorflow")
+        self.transform_sklearn = _Transform(self, "scikitlearn")
         self.histogram = _Histogram(self)
         self.explore = _Explore(self, "tensorflow")
+        self.explore_sklearn = _Explore(self, "scikitlearn")
         self.model = _Model(self, "tensorflow")
         self.tune = _Executor(self, "tune", "tensorflow")
         self.train = _Executor(self, "train", "tensorflow")
@@ -103,6 +106,11 @@ class Context:
             raise ClientError(exc.code, payload) from None
 
     # -- conveniences over the universal GET/poll path ----------------------
+
+    def metrics(self) -> dict:
+        """Gateway metrics: per-route request counts/latencies + the
+        timeout/cache budget (the krakend :8090 exporter's role)."""
+        return self.request("GET", "/metrics")
 
     def search(self, service_path: str, name: str, *, query: dict | None = None,
                limit: int = 20, skip: int = 0) -> list[dict]:
@@ -179,6 +187,49 @@ class _Projection(_Service):
              "fields": fields},
         )
 
+    def update(self, projection_name: str,
+               fields: list[str] | None = None) -> dict:
+        """PATCH re-run — replaces the projected rows (new ``fields``
+        when given, else the original request's)."""
+        return self.ctx.request(
+            "PATCH", "/transform/projection",
+            {"projectionName": projection_name, "fields": fields},
+        )
+
+
+class _Transform(_Service):
+    """Generic transform executions (reference: POST/PATCH/DELETE
+    /transform/{t} → databaseExecutor, SURVEY §2.2)."""
+
+    def __init__(self, ctx: Context, tool: str):
+        super().__init__(ctx)
+        self.tool = tool
+        self.service_path = f"transform/{tool}"
+
+    def create(self, name: str, *, module_path: str, class_name: str,
+               class_parameters: dict | None = None,
+               method: str | None = None,
+               method_parameters: dict | None = None,
+               description: str = "") -> dict:
+        return self.ctx.request(
+            "POST", f"/transform/{self.tool}",
+            {"name": name, "modulePath": module_path, "class": class_name,
+             "classParameters": class_parameters or {}, "method": method,
+             "methodParameters": method_parameters or {},
+             "description": description},
+        )
+
+    def update(self, name: str, *,
+               class_parameters: dict | None = None,
+               method_parameters: dict | None = None,
+               description: str = "") -> dict:
+        return self.ctx.request(
+            "PATCH", f"/transform/{self.tool}/{name}",
+            {"classParameters": class_parameters,
+             "methodParameters": method_parameters,
+             "description": description},
+        )
+
 
 class _DataType(_Service):
     service_path = "transform/dataType"
@@ -218,6 +269,19 @@ class _Explore(_Service):
             {"name": name, "modulePath": module_path, "class": class_name,
              "classParameters": class_parameters or {}, "method": method,
              "methodParameters": method_parameters or {},
+             "colorBy": color_by, "description": description},
+        )
+
+    def update(self, name: str, *,
+               class_parameters: dict | None = None,
+               method_parameters: dict | None = None,
+               color_by: str | None = None,
+               description: str = "") -> dict:
+        """PATCH re-run — re-renders the plot."""
+        return self.ctx.request(
+            "PATCH", f"/explore/{self.tool}/{name}",
+            {"classParameters": class_parameters,
+             "methodParameters": method_parameters,
              "colorBy": color_by, "description": description},
         )
 
@@ -325,6 +389,20 @@ class _DistributedTrain(_Service):
              "trainingParameters": training_parameters,
              "compile": compile_spec, "mesh": mesh,
              "monitoringPath": monitoring_path,
+             "description": description},
+        )
+
+    def update(self, name: str, *,
+               training_parameters: dict | None = None,
+               compile_spec: dict | None = None,
+               mesh: dict | None = None,
+               description: str = "") -> dict:
+        """PATCH re-run; a bare call resumes a failed job with its
+        original parameters."""
+        return self.ctx.request(
+            "PATCH", f"/train/horovod/{name}",
+            {"trainingParameters": training_parameters,
+             "compile": compile_spec, "mesh": mesh,
              "description": description},
         )
 

@@ -116,6 +116,16 @@ class DistributedConfig:
     num_processes: int = 1
     process_id: int = 0
     agent_port: int = 7077  # per-host agent control port
+    # Cluster mode: when set, POST /train/horovod dispatches the fit to
+    # HostAgents through the task Coordinator (parallel/coordinator.py)
+    # instead of fitting in-process — the reference's RayExecutor.run
+    # fan-out (binary_execution.py:237-292), SPMD-style.
+    task_coordinator: str | None = None  # Coordinator HTTP "host:port"
+    jax_coordinator: str | None = None  # jax.distributed rendezvous
+    # Cluster fit wall-clock budget; on expiry the job is cancelled at
+    # the coordinator and this side records failure.  Generous default:
+    # real fine-tunes run for hours.
+    job_timeout_s: float = 86400.0
 
 
 @dataclasses.dataclass
@@ -145,6 +155,12 @@ class Config:
             cfg.api.port = int(env["LO_TPU_API_PORT"])
         if "LO_TPU_MAX_WORKERS" in env:
             cfg.jobs.max_workers = int(env["LO_TPU_MAX_WORKERS"])
+        if "LO_TPU_TASK_COORDINATOR" in env:
+            cfg.dist.task_coordinator = env["LO_TPU_TASK_COORDINATOR"]
+        if "LO_TPU_JAX_COORDINATOR" in env:
+            cfg.dist.jax_coordinator = env["LO_TPU_JAX_COORDINATOR"]
+        if "LO_TPU_WORLD_SIZE" in env:
+            cfg.dist.num_processes = int(env["LO_TPU_WORLD_SIZE"])
         return cfg
 
 

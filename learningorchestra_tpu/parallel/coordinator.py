@@ -197,6 +197,20 @@ class Coordinator:
                 body.get("result"),
                 body.get("error"),
             )
+        if (
+            verb == "POST"
+            and len(parts) == 3
+            and parts[0] == "jobs"
+            and parts[2] == "cancel"
+        ):
+            return 200, self.cancel(parts[1])
+        if (
+            verb == "POST"
+            and len(parts) == 3
+            and parts[0] == "jobs"
+            and parts[2] == "rendezvous"
+        ):
+            return 200, self.set_rendezvous(parts[1], body["address"])
         return 404, {"error": f"no route {verb} {path}"}
 
     # core ops -------------------------------------------------------------
@@ -241,6 +255,7 @@ class Coordinator:
                 "ranks": {},  # agent_id → rank, stable across reclaims
                 "results": {},
                 "errors": {},
+                "rendezvous": None,
                 "state": "queued",
                 "submitted": time.time(),
             }
@@ -260,11 +275,26 @@ class Coordinator:
             job = self._jobs.get(job_id)
             return dict(job) if job else None
 
+    def set_rendezvous(self, job_id: str, address: str) -> dict:
+        """Rank 0 publishes its ``jax.distributed`` rendezvous address
+        here; the other ranks poll the job record for it — no static
+        rank-0 host needs to be configured anywhere."""
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is None:
+                return {"ok": False, "error": f"unknown job {job_id}"}
+            job["rendezvous"] = address
+        return {"ok": True}
+
     def lease(self, job_id: str, agent_id: str) -> dict | None:
         now = time.time()
         with self._lock:
             job = self._jobs.get(job_id)
             if job is None:
+                return None
+            if job["state"] in ("cancelled", "finished", "failed"):
+                # Terminal: no new leases, and never flip the state back
+                # to running (cancel()'s guarantee).
                 return None
             # Reclaim leases held by agents that stopped heartbeating and
             # never reported — the preemption-as-first-class-retry path
@@ -304,6 +334,7 @@ class Coordinator:
                 "kwargs": job["kwargs"],
                 "rank": rank,
                 "world_size": job["n_agents"],
+                "job_id": job_id,
             }
 
     def report(
@@ -330,12 +361,29 @@ class Coordinator:
                 job["results"][rank] = result
                 job["errors"].pop(rank, None)
             covered = set(job["results"]) | set(job["errors"])
-            if len(covered) >= job["n_agents"]:
+            if job["state"] != "cancelled" and len(covered) >= job[
+                "n_agents"
+            ]:
                 job["state"] = "failed" if job["errors"] else "finished"
                 logger.info(kv(
                     event="job_done", job=job_id, state=job["state"],
                     errors=len(job["errors"]),
                 ))
+        return {"ok": True}
+
+    def cancel(self, job_id: str) -> dict:
+        """Mark a job cancelled: no new leases are granted and late
+        reports can no longer flip it to finished — running agents
+        cannot be aborted mid-task (document for callers), but the
+        caller knows the recorded outcome is final."""
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is None:
+                return {"ok": False, "error": f"unknown job {job_id}"}
+            if job["state"] not in ("finished", "failed"):
+                job["state"] = "cancelled"
+                job["n_agents"] = len(job["leased"])  # stop new leases
+        logger.warning(kv(event="job_cancelled", job=job_id))
         return {"ok": True}
 
     def wait(
@@ -346,7 +394,9 @@ class Coordinator:
         deadline = time.time() + timeout
         while time.time() < deadline:
             job = self.job(job_id)
-            if job and job["state"] in ("finished", "failed"):
+            if job and job["state"] in (
+                "finished", "failed", "cancelled"
+            ):
                 return job
             time.sleep(0.05)
         raise TimeoutError(f"job {job_id} timed out after {timeout}s")
@@ -363,7 +413,10 @@ class Coordinator:
 # -- host agent -------------------------------------------------------------
 
 
-def _http(url: str, payload: dict | None = None) -> tuple[int, dict]:
+def http_json(url: str, payload: dict | None = None,
+              timeout: float = 10) -> tuple[int, dict]:
+    """POST (payload given) or GET a JSON endpoint — the one client
+    helper the agents AND the REST service's cluster dispatch share."""
     data = json.dumps(payload).encode() if payload is not None else None
     req = urllib.request.Request(
         url,
@@ -371,9 +424,44 @@ def _http(url: str, payload: dict | None = None) -> tuple[int, dict]:
         headers={"Content-Type": "application/json"},
         method="POST" if data is not None else "GET",
     )
-    with urllib.request.urlopen(req, timeout=10) as resp:
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
         body = resp.read()
         return resp.status, json.loads(body) if body else {}
+
+
+_http = http_json  # internal alias, kept for call-site brevity
+
+
+def submit_job(address: str, function: str, kwargs: dict,
+               n_agents: int = 1) -> str:
+    """Client-side submit against a remote coordinator."""
+    status, payload = http_json(
+        f"http://{address}/jobs",
+        {"function": function, "kwargs": kwargs, "n_agents": n_agents},
+    )
+    if status != 201 or "job_id" not in payload:
+        raise RuntimeError(
+            f"coordinator rejected job submit ({status}): {payload}"
+        )
+    return payload["job_id"]
+
+
+def wait_job(address: str, job_id: str, timeout: float,
+             poll_interval: float = 1.0) -> dict:
+    """Client-side wait: poll until the job reaches a terminal state.
+    On timeout the job is CANCELLED server-side before raising, so a
+    late-finishing agent cannot silently flip the recorded outcome."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        _, job = http_json(f"http://{address}/jobs/{job_id}")
+        if job.get("state") in ("finished", "failed", "cancelled"):
+            return job
+        time.sleep(poll_interval)
+    try:
+        http_json(f"http://{address}/jobs/{job_id}/cancel", {})
+    except OSError:
+        pass
+    raise TimeoutError(f"job {job_id} timed out after {timeout}s")
 
 
 class HostAgent:
@@ -406,10 +494,20 @@ class HostAgent:
         task = payload["task"]
         try:
             fn = get_function(task["function"])
+            kwargs = dict(task["kwargs"])
+            # Functions that declare job_meta get the coordinator
+            # back-channel (rendezvous publication etc.).
+            import inspect
+
+            if "job_meta" in inspect.signature(fn).parameters:
+                kwargs["job_meta"] = {
+                    "job_id": task.get("job_id"),
+                    "coordinator": self.base,
+                }
             result = fn(
                 rank=task["rank"],
                 world_size=task["world_size"],
-                **task["kwargs"],
+                **kwargs,
             )
             report = {"agent_id": self.agent_id, "result": result}
         except Exception as exc:  # noqa: BLE001 — ledger contract §5.3

@@ -284,7 +284,12 @@ class DistributedTrainer:
                     params, opt_state, xs, ys, ms,
                     jax.random.fold_in(root_key, epoch_i),
                 )
-                metrics = {k: float(v) for k, v in metrics.items()}
+                # One host transfer for all metric scalars (replicated
+                # outputs, so this is process-local even multi-host).
+                metrics = {
+                    k: float(v)
+                    for k, v in jax.device_get(metrics).items()
+                }
                 dt = time.perf_counter() - t0
                 metrics["epoch_time"] = dt
                 metrics["samples_per_sec"] = n_samples / dt

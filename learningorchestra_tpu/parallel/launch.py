@@ -27,6 +27,7 @@ each process only its addressable shards on device.
 from __future__ import annotations
 
 import os
+import time
 from typing import Any
 
 import numpy as np
@@ -54,15 +55,56 @@ def _join(jax_coordinator: str, world_size: int, rank: int) -> None:
     _joined.update({"addr": jax_coordinator, "rank": rank})
 
 
+def _negotiate_rendezvous(
+    rank: int, job_meta: dict | None, timeout: float = 120.0
+) -> str:
+    """Rank 0 binds a port and PUBLISHES its address through the task
+    coordinator; other ranks poll the job record for it.  This keeps
+    rank assignment free (first agent to lease wins rank 0) without any
+    statically-configured rank-0 host — the address follows the rank.
+    """
+    import socket
+
+    from learningorchestra_tpu.parallel.coordinator import http_json
+
+    if not job_meta or not job_meta.get("job_id"):
+        raise RuntimeError(
+            "no jax_coordinator configured and no coordinator "
+            "back-channel available to negotiate one"
+        )
+    base, job_id = job_meta["coordinator"], job_meta["job_id"]
+    if rank == 0:
+        with socket.socket() as s:
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+        host = socket.gethostbyname(socket.gethostname())
+        address = f"{host}:{port}"
+        http_json(f"{base}/jobs/{job_id}/rendezvous", {"address": address})
+        return address
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        _, job = http_json(f"{base}/jobs/{job_id}")
+        if job.get("rendezvous"):
+            return job["rendezvous"]
+        time.sleep(0.2)
+    raise TimeoutError(
+        f"rank {rank}: no rendezvous published for job {job_id} "
+        f"within {timeout}s"
+    )
+
+
 @register_function("lo.multihost_fit")
 def multihost_fit(
     rank: int,
     world_size: int,
     *,
-    jax_coordinator: str,
-    module_path: str,
-    class_name: str,
+    jax_coordinator: str | None = None,
+    job_meta: dict | None = None,
+    module_path: str | None = None,
+    class_name: str | None = None,
     class_parameters: dict | None = None,
+    estimator_volume: dict | None = None,
+    compile_spec: dict | None = None,
     mesh: dict | None = None,
     data: dict,
     fit: dict | None = None,
@@ -70,29 +112,63 @@ def multihost_fit(
 ) -> dict:
     """Join the global mesh and run one sharded fit; see module docstring.
 
+    The estimator comes from the toolkit registry
+    (``module_path``/``class_name``/``class_parameters``) or from a
+    shared artifact volume (``estimator_volume`` =
+    {"volume_root", "artifact_type", "name"} — how the REST service
+    ships the parent model, which every deploy mounts on every host).
     ``data``: {"x": <.npy path>, "y": <.npy path>} — every host loads the
-    full arrays.  ``out``: {"volume_root", "artifact_type", "name"} —
+    full arrays.  ``compile_spec``: declarative optimizer/loss overrides;
+    ``#`` expressions evaluate through the DSL sandbox (no store access
+    on agents).  ``out``: {"volume_root", "artifact_type", "name"} —
     rank 0 persists the trained estimator there.  Returns the training
     history (every rank returns it; the coordinator keys results by
     rank, so callers read rank 0's).
     """
     import jax
 
+    if jax_coordinator is None:
+        jax_coordinator = _negotiate_rendezvous(rank, job_meta)
     _join(jax_coordinator, world_size, rank)
 
     from learningorchestra_tpu.parallel.distributed import DistributedTrainer
     from learningorchestra_tpu.parallel.mesh import MeshSpec, build_mesh
-    from learningorchestra_tpu.toolkit import registry
 
-    factory = registry.resolve(module_path, class_name)
-    est = factory(**(class_parameters or {}))
+    if estimator_volume:
+        from learningorchestra_tpu.store.volumes import VolumeStorage
+
+        est = VolumeStorage(estimator_volume["volume_root"]).read_object(
+            estimator_volume["artifact_type"], estimator_volume["name"]
+        )
+    else:
+        from learningorchestra_tpu.toolkit import registry
+
+        factory = registry.resolve(module_path, class_name)
+        est = factory(**(class_parameters or {}))
+
+    if compile_spec:
+        from learningorchestra_tpu import dsl
+
+        class _NoStore:
+            def load(self, name):  # pragma: no cover - guard path
+                raise KeyError(
+                    f"agents cannot load store artifacts (${name})"
+                )
+
+        est.compile(**dsl.resolve_params(compile_spec, _NoStore()))
 
     spec = MeshSpec.from_dict(mesh or {"dp": jax.device_count()})
     trainer = DistributedTrainer(est, mesh=build_mesh(spec))
 
     x = np.load(data["x"], allow_pickle=False)
     y = np.load(data["y"], allow_pickle=False)
-    trainer.fit(x, y, **(fit or {}))
+    fit_kwargs = dict(fit or {})
+    if "vx" in data and "vy" in data:
+        fit_kwargs["validation_data"] = (
+            np.load(data["vx"], allow_pickle=False),
+            np.load(data["vy"], allow_pickle=False),
+        )
+    trainer.fit(x, y, **fit_kwargs)
 
     if out and jax.process_index() == 0:
         from learningorchestra_tpu.store.volumes import VolumeStorage

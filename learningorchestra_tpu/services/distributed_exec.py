@@ -195,6 +195,14 @@ class DistributedExecutorService:
         parent_name = parent_meta["name"]
         parent_type = parent_meta.get("type", "")
 
+        if self.ctx.config.dist.task_coordinator:
+            return self._submit_train_cluster(
+                name, parent_name, parent_type, training_parameters,
+                compile_spec, mesh, artifact_type, description,
+                resume_default=resume_default,
+                session_logdir=session_logdir,
+            )
+
         def run():
             from learningorchestra_tpu.parallel.distributed import (
                 DistributedTrainer,
@@ -264,6 +272,159 @@ class DistributedExecutorService:
             name,
             run,
             description=description or f"distributed fit on {parent_name}",
+            method="fit",
+            parameters=_json_safe(training_parameters),
+            on_success=lambda extra: extra,
+        )
+
+    # trainingParameters the cluster path can ship to agents: arrays go
+    # via staged .npy files, scalars via JSON; anything else must be
+    # rejected loudly, not silently dropped.
+    _CLUSTER_ARRAY_KEYS = ("x", "y")
+
+    def _submit_train_cluster(
+        self, name, parent_name, parent_type, training_parameters,
+        compile_spec, mesh, artifact_type, description, *,
+        resume_default, session_logdir=None,
+    ):
+        """Cluster mode: fan the fit out to HostAgents through the task
+        Coordinator — the reference's ``RayExecutor.run(train)`` shape
+        (binary_execution.py:237-292), except the agents form ONE SPMD
+        program over a global mesh instead of a Horovod ring, and the
+        trained state comes home through the shared artifact volume,
+        not as weight lists over the control plane.
+
+        Monitoring caveat: profiler traces run on the agents, not here;
+        the managed TensorBoard session still gets the scalar curves
+        (written from the returned history after the job completes).
+        """
+        import shutil as _shutil
+
+        import numpy as np
+
+        from learningorchestra_tpu.parallel.coordinator import (
+            submit_job,
+            wait_job,
+        )
+
+        cfg = self.ctx.config.dist
+        coord = cfg.task_coordinator
+        world = int(cfg.num_processes)
+        if world < 2:
+            raise ValidationError(
+                "cluster mode needs dist.num_processes >= 2 "
+                "(LO_TPU_WORLD_SIZE) — one process per agent host"
+            )
+        # jax_coordinator is optional: when unset, the rank-0 agent
+        # binds a port and publishes its address through the task
+        # coordinator (launch._negotiate_rendezvous).
+        jax_coord = cfg.jax_coordinator
+
+        def run():
+            params = dsl.resolve_params(
+                training_parameters, self.ctx.loader
+            )
+            try:
+                x = np.asarray(params.pop("x"))
+                y = np.asarray(params.pop("y"))
+            except KeyError as exc:
+                raise ValidationError(
+                    f"trainingParameters missing {exc} for cluster fit"
+                ) from exc
+            validation = params.pop("validation_data", None)
+            fit_kwargs = {}
+            unsupported = []
+            for key, val in params.items():
+                if val is None or isinstance(val, (int, float, bool, str)):
+                    fit_kwargs[key] = val
+                else:
+                    unsupported.append(key)
+            if unsupported:
+                raise ValidationError(
+                    f"cluster fit cannot ship parameters {unsupported} "
+                    f"(arrays go via x/y/validation_data; callbacks are "
+                    f"local-mode only)"
+                )
+            # Stage data on the shared volume; every agent host mounts
+            # it (deploy/: the lo-data volume / RWX claim).
+            stage = self.ctx.volumes.root / "_staging" / name
+            stage.mkdir(parents=True, exist_ok=True)
+            try:
+                np.save(stage / "x.npy", x)
+                np.save(stage / "y.npy", y)
+                data = {
+                    "x": str(stage / "x.npy"),
+                    "y": str(stage / "y.npy"),
+                }
+                if validation is not None:
+                    vx, vy = validation
+                    np.save(stage / "vx.npy", np.asarray(vx))
+                    np.save(stage / "vy.npy", np.asarray(vy))
+                    data["vx"] = str(stage / "vx.npy")
+                    data["vy"] = str(stage / "vy.npy")
+
+                # Fresh runs must not resurrect a previous run's
+                # checkpoints (same guard as the local path).
+                ckdir = self.ctx.checkpoint_dir(name)
+                fit_kwargs.setdefault("resume", resume_default)
+                if not fit_kwargs["resume"] and ckdir.exists():
+                    _shutil.rmtree(ckdir, ignore_errors=True)
+                fit_kwargs["checkpoint_dir"] = str(ckdir)
+
+                job_id = submit_job(
+                    coord,
+                    "lo.multihost_fit",
+                    {
+                        "jax_coordinator": jax_coord,
+                        "estimator_volume": {
+                            "volume_root": str(self.ctx.volumes.root),
+                            "artifact_type": parent_type,
+                            "name": parent_name,
+                        },
+                        "compile_spec": compile_spec,
+                        "mesh": _json_safe(mesh or {}),
+                        "data": data,
+                        "fit": fit_kwargs,
+                        "out": {
+                            "volume_root": str(self.ctx.volumes.root),
+                            "artifact_type": artifact_type,
+                            "name": name,
+                        },
+                    },
+                    n_agents=world,
+                )
+                t0 = time.perf_counter()
+                job = wait_job(
+                    coord, job_id, timeout=cfg.job_timeout_s,
+                    poll_interval=1.0,
+                )
+                if job["state"] != "finished":
+                    raise RuntimeError(
+                        f"cluster fit {job['state']}: {job.get('errors')}"
+                    )
+                fit_time = time.perf_counter() - t0
+            finally:
+                _shutil.rmtree(stage, ignore_errors=True)
+            rank0 = job["results"].get("0") or job["results"].get(0)
+            history = (rank0 or {}).get("history") or {}
+            for doc in self.ctx.documents.find(
+                name, query={"docType": "history"}
+            ):
+                self.ctx.documents.delete_one(name, doc["_id"])
+            store_history_rows(self.ctx.documents, name, history)
+            if session_logdir is not None:
+                write_scalar_logs(session_logdir, history, prefix=name)
+            return {
+                "fitTime": fit_time,
+                "worldSize": world,
+                "clusterJob": job_id,
+            }
+
+        self.ctx.engine.submit(
+            name,
+            run,
+            description=description
+            or f"cluster distributed fit on {parent_name}",
             method="fit",
             parameters=_json_safe(training_parameters),
             on_success=lambda extra: extra,

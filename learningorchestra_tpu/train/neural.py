@@ -451,7 +451,12 @@ class NeuralEstimator(Estimator):
             # callback/validation below must not strand self.params on
             # deleted buffers.
             self.params, self.opt_state = params, opt_state
-            metrics = {k: float(v) for k, v in metrics.items()}
+            # ONE host transfer for all metric scalars — per-metric
+            # float() pays a device round-trip each (remote-TPU
+            # dispatch is ~7 ms per call).
+            metrics = {
+                k: float(v) for k, v in jax.device_get(metrics).items()
+            }
             metrics["epoch_time"] = time.perf_counter() - t0
             if validation_data is not None:
                 vx, vy = validation_data

@@ -737,11 +737,16 @@ static std::string canonical_count_key(const std::string &val) {
   if (end == val.c_str() || *end != '\0' || errno == ERANGE) return val;
   // Magnitude guard FIRST: (long long)d on an out-of-range double
   // (1e300, inf) is undefined behavior.  Beyond 2^53 doubles alias
-  // distinct integers, so keep the raw text — Python's exact ints keep
-  // such values in separate buckets and so must we.
-  if (std::fabs(d) >= 9e15) return val;
+  // distinct integers, so a pure INTEGER literal keeps its raw text —
+  // Python's exact ints keep such values in separate buckets and so
+  // must we.  Float-syntax spellings ('.', 'e', 'E') are already
+  // doubles on the Python side too, so %.17g canonicalization is safe
+  // (and merges 1e20 with 1E+20).
+  if (std::fabs(d) >= 9e15 &&
+      val.find_first_of(".eE") == std::string::npos)
+    return val;
   char buf[64];
-  if (d == (double)(long long)d) {
+  if (std::fabs(d) < 9e15 && d == (double)(long long)d) {
     snprintf(buf, sizeof buf, "%lld", (long long)d);
   } else {
     snprintf(buf, sizeof buf, "%.17g", d);

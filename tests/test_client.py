@@ -148,3 +148,18 @@ class TestClientPipeline:
         # epoch 0..3, no duplicates from the first run.
         epochs = sorted(d["epoch"] for d in hist)
         assert epochs == [0, 1, 2, 3]
+
+
+def test_client_patch_and_metrics_surface(ctx):
+    """Round-2 client additions: projection/transform/explore/distributed
+    PATCH methods and the gateway metrics accessor."""
+    ctx, _csv = ctx
+    # metrics endpoint
+    metrics = ctx.metrics()
+    assert "routes" in metrics and "budget" in metrics
+    # surface presence (transport covered by the route tests)
+    assert callable(ctx.projection.update)
+    assert callable(ctx.transform.update)
+    assert callable(ctx.transform_sklearn.create)
+    assert callable(ctx.explore.update)
+    assert callable(ctx.train_distributed.update)
