@@ -143,7 +143,10 @@ def main() -> None:
     # CPU is the degraded-tunnel fallback only — keep it fast enough
     # that the driver gets its number in ~2 min, not 11.
     n_samples = 16384 if platform == "tpu" else 1024
-    batch_size = 256 if platform == "tpu" else 128
+    # bs 1024 from the on-chip sweep (TPU_EVIDENCE.md): 369k samples/s
+    # vs 327k at bs 256; bigger batches regress (per-step work too big
+    # for the small CNN's pipeline).
+    batch_size = 1024 if platform == "tpu" else 128
     epochs = 4 if platform == "tpu" else 3
 
     rng = np.random.default_rng(0)

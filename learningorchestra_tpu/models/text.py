@@ -79,6 +79,7 @@ class TransformerBlock(nn.Module):
     mlp_dim: int
     dtype: jnp.dtype = jnp.float32
     use_flash: bool | None = None  # None = auto by backend
+    causal: bool = False  # decoder blocks mask future positions
 
     @nn.compact
     def __call__(self, x, key_mask=None):
@@ -88,6 +89,7 @@ class TransformerBlock(nn.Module):
             qkv_features=self.hidden_dim,
             dtype=self.dtype,
             use_flash=self.use_flash,
+            causal=self.causal,
         )(y, key_mask=key_mask)
         x = x + y
         y = nn.LayerNorm(dtype=self.dtype)(x)
@@ -230,3 +232,116 @@ class TransformerClassifier(BertModel):
             learning_rate=learning_rate,
             seed=seed,
         )
+
+
+class _DecoderLM(nn.Module):
+    """GPT-style causal transformer: pre-LN decoder blocks over the
+    causal flash kernel, tied to a per-token LM head."""
+
+    vocab_size: int
+    hidden_dim: int
+    num_layers: int
+    num_heads: int
+    mlp_dim: int
+    max_len: int
+    dtype: jnp.dtype = jnp.float32
+    use_flash: bool | None = None
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, tokens):
+        tokens = tokens.astype(jnp.int32)
+        seq = tokens.shape[1]
+        tok = nn.Embed(self.vocab_size, self.hidden_dim, dtype=self.dtype)(
+            tokens
+        )
+        pos = nn.Embed(self.max_len, self.hidden_dim, dtype=self.dtype)(
+            jnp.arange(seq)[None, :]
+        )
+        x = tok + pos
+        pad_mask = tokens != 0  # (B, T), pad id 0
+        block_cls = nn.remat(TransformerBlock) if self.remat \
+            else TransformerBlock
+        for i in range(self.num_layers):
+            x = block_cls(
+                hidden_dim=self.hidden_dim,
+                num_heads=self.num_heads,
+                mlp_dim=self.mlp_dim,
+                dtype=self.dtype,
+                use_flash=self.use_flash,
+                causal=True,
+                name=f"TransformerBlock_{i}",
+            )(x, key_mask=pad_mask)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        return nn.Dense(self.vocab_size, dtype=self.dtype)(x)  # (B,T,V)
+
+
+@register(_MODULE)
+class DecoderLM(NeuralEstimator):
+    """Causal (decoder-only) language model — beyond-parity headroom:
+    the reference has no attention at all (SURVEY §5.7); this pairs the
+    causal Pallas flash kernel with the keras-fit surface.
+
+    ``fit(x, y)`` with x = token ids (B, T) and y = next-token targets
+    (B, T) (typically ``x[:, 1:]`` padded); the softmax_ce loss averages
+    per-token over T (train/neural.py sequence handling).
+    ``generate`` greedy-decodes continuations.
+    """
+
+    def __init__(
+        self,
+        vocab_size: int = 32000,
+        hidden_dim: int = 256,
+        num_layers: int = 4,
+        num_heads: int = 8,
+        mlp_dim: int | None = None,
+        max_len: int = 1024,
+        learning_rate: float = 3e-4,
+        seed: int = 0,
+        remat: bool = False,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_dim = hidden_dim
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.mlp_dim = mlp_dim or hidden_dim * 4
+        self.max_len = max_len
+        self.remat = remat
+        super().__init__(
+            _DecoderLM(
+                vocab_size=vocab_size,
+                hidden_dim=hidden_dim,
+                num_layers=num_layers,
+                num_heads=num_heads,
+                mlp_dim=self.mlp_dim,
+                max_len=max_len,
+                remat=remat,
+            ),
+            loss="softmax_ce",
+            learning_rate=learning_rate,
+            seed=seed,
+        )
+
+    def generate(self, prompts, max_new_tokens: int = 32):
+        """Greedy continuation of int32 prompts (B, T0).
+
+        Decodes in a FIXED-shape buffer (right-padded with pad id 0, so
+        causal masking + the model's own pad key-mask make the padded
+        tail inert) — one XLA compile for the whole decode, instead of a
+        retrace per new sequence length."""
+        import jax
+        import numpy as np
+
+        prompts = np.asarray(prompts, dtype=np.int32)
+        bsz, t0 = prompts.shape
+        total = min(self.max_len, t0 + max_new_tokens)
+        if self._apply_fn is None:
+            self._apply_fn = jax.jit(self.module.apply)
+        buf = np.zeros((bsz, total), np.int32)
+        buf[:, :t0] = prompts
+        for cur in range(t0, total):
+            logits = self._apply_fn(self.params, jnp.asarray(buf))
+            buf[:, cur] = np.asarray(
+                jnp.argmax(logits[:, cur - 1], axis=-1)
+            )
+        return buf

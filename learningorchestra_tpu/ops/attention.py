@@ -56,13 +56,15 @@ def _auto_interpret() -> bool:
 # ---------------------------------------------------------------------------
 
 
-def mha_reference(q, k, v, key_mask=None):
+def mha_reference(q, k, v, key_mask=None, causal: bool = False):
     """Plain multi-head attention. q,k,v: (B, H, T, D); key_mask: (B, Tk).
 
     Fully-masked rows output exactly 0 with exactly-0 gradients.  The
     masking uses the double-``where`` pattern: masked lanes never touch a
     live value on either the forward or backward path (a single ``where``
     after ``exp`` leaves NaN-producing -1e30 arithmetic on the grad path).
+    ``causal=True`` additionally masks keys beyond each query's position
+    (decoder self-attention; Tq must equal Tk).
     """
     scale = 1.0 / (q.shape[-1] ** 0.5)
     s = jnp.einsum(
@@ -70,10 +72,18 @@ def mha_reference(q, k, v, key_mask=None):
         q.astype(jnp.float32), k.astype(jnp.float32),
         preferred_element_type=jnp.float32,
     ) * scale
-    if key_mask is None:
+    tq, tk = q.shape[2], k.shape[2]
+    maskb = None
+    if key_mask is not None:
+        maskb = key_mask.astype(bool)[:, None, None, :]
+    if causal:
+        tri = (
+            jnp.arange(tk)[None, :] <= jnp.arange(tq)[:, None]
+        )[None, None]
+        maskb = tri if maskb is None else (maskb & tri)
+    if maskb is None:
         p = jax.nn.softmax(s, axis=-1)
     else:
-        maskb = key_mask.astype(bool)[:, None, None, :]
         m = jnp.max(jnp.where(maskb, s, _NEG_BIG), axis=-1, keepdims=True)
         # Fully-masked rows: make the subtraction a no-op so the masked
         # branch below sees a clean constant, not (-1e30) - (-1e30).
@@ -91,15 +101,25 @@ def mha_reference(q, k, v, key_mask=None):
 # ---------------------------------------------------------------------------
 
 
+def _causal_keep(i, j, bq, bk):
+    """(bq, bk) multiplicative mask for the causal region of block
+    (i, j): 1.0 where global col <= global row."""
+    rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return (cols <= rows).astype(jnp.float32)
+
+
 def _fwd_kernel(
     q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-    *, scale,
+    *, scale, causal,
 ):
     """One (q-block, k-block) grid step.  The k axis is the innermost,
     sequential grid dimension: the online-softmax running state lives in
     VMEM scratch across k steps, and each step sees ONE (bk, D) K/V block
     streamed from HBM — VMEM use is O(block), not O(T), and Mosaic
-    overlaps the next block's DMA with this block's MXU work."""
+    overlaps the next block's DMA with this block's MXU work.  Causal
+    blocks fully above the diagonal skip their compute entirely."""
+    i = pl.program_id(2)
     j = pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -109,28 +129,41 @@ def _fwd_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # Matmul inputs stay in their storage dtype (bf16 on the training
-    # path): the MXU multiplies bf16 at full rate and accumulates f32 via
-    # preferred_element_type — upcasting first would halve throughput.
-    q = q_ref[0, 0]  # (bq, D)
-    kb = k_ref[0, 0]  # (bk, D)
-    vb = v_ref[0, 0]
-    km = km_ref[0]  # (1, bk) float32, 1=keep
-    s = jax.lax.dot_general(
-        q, kb, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale  # (bq, bk) f32
-    s = s + (km - 1.0) * -_NEG_BIG  # masked keys -> -1e30
-    m_prev = m_scr[...]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new) * km  # zero masked keys exactly
-    m_scr[...] = m_new
-    l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
-        p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+    bq, bk = q_ref.shape[2], k_ref.shape[2]
+
+    def _compute():
+        # Matmul inputs stay in their storage dtype (bf16 on the
+        # training path): the MXU multiplies bf16 at full rate and
+        # accumulates f32 via preferred_element_type — upcasting first
+        # would halve throughput.
+        q = q_ref[0, 0]  # (bq, D)
+        kb = k_ref[0, 0]  # (bk, D)
+        vb = v_ref[0, 0]
+        keep = km_ref[0]  # (1, bk) float32, 1=keep
+        if causal:
+            keep = keep * _causal_keep(i, j, bq, bk)  # (bq, bk)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (bq, bk) f32
+        s = s + (keep - 1.0) * -_NEG_BIG  # masked keys -> -1e30
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new) * keep  # zero masked keys exactly
+        m_scr[...] = m_new
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(
+            p, axis=-1, keepdims=True
+        )
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(j * bk < (i + 1) * bq)(_compute)
+    else:
+        _compute()
 
     @pl.when(j == nk - 1)
     def _finalize():
@@ -154,10 +187,11 @@ def _fwd_kernel(
 
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    dq_scr, *, scale,
+    dq_scr, *, scale, causal,
 ):
     """dQ pass: grid (b, h, nq, nk) — same streamed K/V layout as the
     forward; dq accumulates in VMEM scratch across the sequential k axis."""
+    i = pl.program_id(2)
     j = pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -165,28 +199,38 @@ def _bwd_dq_kernel(
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    q = q_ref[0, 0]
-    do = do_ref[0, 0]
-    lse = lse_ref[0, 0]  # (bq, 1)
-    delta = delta_ref[0, 0]
-    kb = k_ref[0, 0]
-    vb = v_ref[0, 0]
-    km = km_ref[0]
-    s = jax.lax.dot_general(
-        q, kb, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale
-    s = s + (km - 1.0) * -_NEG_BIG
-    p = jnp.exp(s - lse) * km  # (bq, bk) f32
-    dp = jax.lax.dot_general(
-        do, vb, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    ds = (p * (dp - delta) * scale).astype(kb.dtype)
-    dq_scr[...] += jax.lax.dot_general(
-        ds, kb, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+    bq, bk = q_ref.shape[2], k_ref.shape[2]
+
+    def _compute():
+        q = q_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]  # (bq, 1)
+        delta = delta_ref[0, 0]
+        kb = k_ref[0, 0]
+        vb = v_ref[0, 0]
+        keep = km_ref[0]
+        if causal:
+            keep = keep * _causal_keep(i, j, bq, bk)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        s = s + (keep - 1.0) * -_NEG_BIG
+        p = jnp.exp(s - lse) * keep  # (bq, bk) f32
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = (p * (dp - delta) * scale).astype(kb.dtype)
+        dq_scr[...] += jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(j * bk < (i + 1) * bq)(_compute)
+    else:
+        _compute()
 
     @pl.when(j == nk - 1)
     def _finalize():
@@ -195,10 +239,11 @@ def _bwd_dq_kernel(
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, delta_ref,
-    dk_ref, dv_ref, dk_scr, dv_scr, *, scale,
+    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
 ):
     """dK/dV pass: grid (b, h, nk, nq) — one K/V block is resident while
     Q/dO/lse/delta blocks stream along the sequential inner q axis."""
+    j = pl.program_id(2)
     i = pl.program_id(3)
     nq = pl.num_programs(3)
 
@@ -207,32 +252,42 @@ def _bwd_dkv_kernel(
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    kb = k_ref[0, 0]  # (bk, D)
-    vb = v_ref[0, 0]
-    km = km_ref[0]  # (1, bk)
-    q = q_ref[0, 0]  # (bq, D)
-    do = do_ref[0, 0]
-    lse = lse_ref[0, 0]  # (bq, 1)
-    delta = delta_ref[0, 0]
-    s = jax.lax.dot_general(
-        q, kb, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale
-    s = s + (km - 1.0) * -_NEG_BIG
-    p = jnp.exp(s - lse) * km  # (bq, bk) f32
-    dv_scr[...] += jax.lax.dot_general(
-        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    dp = jax.lax.dot_general(
-        do, vb, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    ds = (p * (dp - delta) * scale).astype(q.dtype)
-    dk_scr[...] += jax.lax.dot_general(
-        ds, q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+    bq, bk = q_ref.shape[2], k_ref.shape[2]
+
+    def _compute():
+        kb = k_ref[0, 0]  # (bk, D)
+        vb = v_ref[0, 0]
+        keep = km_ref[0]  # (1, bk)
+        if causal:
+            keep = keep * _causal_keep(i, j, bq, bk)
+        q = q_ref[0, 0]  # (bq, D)
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]  # (bq, 1)
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        s = s + (keep - 1.0) * -_NEG_BIG
+        p = jnp.exp(s - lse) * keep  # (bq, bk) f32
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(j * bk < (i + 1) * bq)(_compute)
+    else:
+        _compute()
 
     @pl.when(i == nq - 1)
     def _finalize():
@@ -245,12 +300,12 @@ def _bwd_dkv_kernel(
 # ---------------------------------------------------------------------------
 
 
-def _fwd_call(q, k, v, km, block_q, block_k, interpret):
+def _fwd_call(q, k, v, km, block_q, block_k, interpret, causal):
     b, h, tq, d = q.shape
     tk = k.shape[2]
     nq, nk = tq // block_q, tk // block_k
     scale = 1.0 / (d ** 0.5)
-    kernel = functools.partial(_fwd_kernel, scale=scale)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal)
     return pl.pallas_call(
         kernel,
         grid=(b, h, nq, nk),
@@ -288,14 +343,15 @@ def _fwd_call(q, k, v, km, block_q, block_k, interpret):
     )(q, k, v, km)
 
 
-def _bwd_call(q, k, v, km, do, lse, delta, block_q, block_k, interpret):
+def _bwd_call(q, k, v, km, do, lse, delta, block_q, block_k, interpret,
+              causal):
     b, h, tq, d = q.shape
     tk = k.shape[2]
     nq, nk = tq // block_q, tk // block_k
     scale = 1.0 / (d ** 0.5)
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale),
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal),
         grid=(b, h, nq, nk),
         in_specs=[
             pl.BlockSpec(
@@ -328,7 +384,7 @@ def _bwd_call(q, k, v, km, do, lse, delta, block_q, block_k, interpret):
     )(q, k, v, km, do, lse, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale),
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal),
         grid=(b, h, nk, nq),
         in_specs=[
             pl.BlockSpec(
@@ -378,25 +434,25 @@ def _bwd_call(q, k, v, km, do, lse, delta, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash_core(q, k, v, km, block_q, block_k, interpret):
-    o, _ = _fwd_call(q, k, v, km, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_core(q, k, v, km, block_q, block_k, interpret, causal):
+    o, _ = _fwd_call(q, k, v, km, block_q, block_k, interpret, causal)
     return o
 
 
-def _flash_core_fwd(q, k, v, km, block_q, block_k, interpret):
-    o, lse = _fwd_call(q, k, v, km, block_q, block_k, interpret)
+def _flash_core_fwd(q, k, v, km, block_q, block_k, interpret, causal):
+    o, lse = _fwd_call(q, k, v, km, block_q, block_k, interpret, causal)
     return o, (q, k, v, km, o, lse)
 
 
-def _flash_core_bwd(block_q, block_k, interpret, res, g):
+def _flash_core_bwd(block_q, block_k, interpret, causal, res, g):
     q, k, v, km, o, lse = res
     do = g.astype(jnp.float32)
     # (B, H, Tq, 1) — trailing singleton keeps TPU block shapes legal.
     delta = jnp.sum(do * o.astype(jnp.float32), axis=-1, keepdims=True)
     dq, dk, dv = _bwd_call(
         q, k, v, km, do.astype(q.dtype), lse, delta,
-        block_q, block_k, interpret,
+        block_q, block_k, interpret, causal,
     )
     return dq, dk, dv, jnp.zeros_like(km)
 
@@ -415,6 +471,7 @@ def flash_attention(
     v,
     key_mask=None,
     *,
+    causal: bool = False,
     block_q: int = 512,
     block_k: int = 1024,
     interpret: bool | None = None,
@@ -447,7 +504,7 @@ def flash_attention(
         v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
         km = jnp.pad(km, ((0, 0), (0, 0), (0, pad_k)))
 
-    out = _flash_core(q, k, v, km, block_q, block_k, interpret)
+    out = _flash_core(q, k, v, km, block_q, block_k, interpret, causal)
     if pad_q:
         out = out[:, :, :tq]
     return out

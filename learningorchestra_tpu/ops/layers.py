@@ -36,6 +36,7 @@ class MultiHeadSelfAttention(nn.Module):
     qkv_features: int
     dtype: jnp.dtype = jnp.float32
     use_flash: bool | None = None
+    causal: bool = False
 
     @nn.compact
     def __call__(self, x, key_mask=None):
@@ -55,7 +56,7 @@ class MultiHeadSelfAttention(nn.Module):
         if use_flash is None:
             use_flash = jax.default_backend() == "tpu"
         attend = flash_attention if use_flash else mha_reference
-        out = attend(q, k, v, key_mask)  # (B, H, T, hd)
+        out = attend(q, k, v, key_mask, causal=self.causal)  # (B,H,T,hd)
         out = out.transpose(0, 2, 1, 3).reshape(b, t, self.qkv_features)
         return nn.DenseGeneral(
             self.qkv_features, dtype=self.dtype, name="out"

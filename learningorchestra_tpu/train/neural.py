@@ -308,10 +308,19 @@ class NeuralEstimator(Estimator):
                 per = optax.softmax_cross_entropy_with_integer_labels(
                     logits, y
                 )
+                correct = (jnp.argmax(logits, -1) == y).astype(jnp.float32)
+                if per.ndim == 2:
+                    # Sequence outputs (language models): logits
+                    # (B, T, V), y (B, T) — average over NON-PAD target
+                    # tokens (pad id 0, the zoo-wide convention) so a
+                    # padded batch neither trains on nor scores pad
+                    # positions; the per-SAMPLE mask applies unchanged.
+                    tok = (y != 0).astype(jnp.float32)
+                    denom = jnp.maximum(tok.sum(-1), 1.0)
+                    per = (per * tok).sum(-1) / denom
+                    correct = (correct * tok).sum(-1) / denom
                 loss = jnp.sum(per * mask) / msum
-                acc = jnp.sum(
-                    (jnp.argmax(logits, -1) == y).astype(jnp.float32) * mask
-                ) / msum
+                acc = jnp.sum(correct * mask) / msum
                 return loss, {"loss": loss, "accuracy": acc}
             if loss_kind == "sigmoid_ce":
                 per = optax.sigmoid_binary_cross_entropy(
@@ -460,9 +469,14 @@ class NeuralEstimator(Estimator):
             metrics["epoch_time"] = time.perf_counter() - t0
             if validation_data is not None:
                 vx, vy = validation_data
+                vy = np.asarray(vy)
+                # Only flatten single-column matrices — sequence targets
+                # (B, T) keep their shape (the LM loss path).
+                if vy.ndim == 2 and vy.shape[1] == 1:
+                    vy = vy.reshape(-1)
                 vmetrics = self._evaluate_arrays(
-                    params, np.asarray(as_array(vx)),
-                    np.asarray(vy).reshape(-1), batch_size, loss_kind,
+                    params, np.asarray(as_array(vx)), vy,
+                    batch_size, loss_kind,
                 )
                 metrics.update({f"val_{k}": v for k, v in vmetrics.items()})
             self.history.append(metrics)

@@ -200,3 +200,65 @@ def test_new_vision_models_train_step(cls_name):
     est.fit(x, y, epochs=1, batch_size=4)
     assert np.isfinite(est.history["loss"][-1])
     assert est.predict(x).shape == (8, 3)
+
+
+def test_decoder_lm_learns_and_generates():
+    """DecoderLM: causal next-token training on a deterministic cyclic
+    sequence; greedy generate must continue the cycle."""
+    from learningorchestra_tpu.models.text import DecoderLM
+
+    period = 5
+    seq = 16
+    n = 64
+    rng = np.random.default_rng(0)
+    starts = rng.integers(0, period, n)
+    base = (starts[:, None] + np.arange(seq + 1)[None, :]) % period + 1
+    x, y = base[:, :-1].astype(np.int32), base[:, 1:].astype(np.int32)
+
+    est = DecoderLM(
+        vocab_size=8, hidden_dim=32, num_layers=2, num_heads=4,
+        max_len=seq, learning_rate=3e-3,
+    )
+    est.fit(x, y, epochs=60, batch_size=16, shuffle=True)
+    assert est.history["accuracy"][-1] > 0.95
+
+    gen = est.generate(x[:4, :8], max_new_tokens=4)
+    expect = (base[:4, 8:12]).astype(np.int32)
+    np.testing.assert_array_equal(gen[:, 8:], expect)
+
+
+def test_decoder_lm_registered():
+    from learningorchestra_tpu.toolkit import registry
+
+    assert registry.exists("learningorchestra_tpu.models.text", "DecoderLM")
+
+
+def test_decoder_lm_validation_and_pad_masking():
+    """Sequence-target validation keeps (B, T) shape, and padded target
+    positions neither train nor count toward accuracy."""
+    from learningorchestra_tpu.models.text import DecoderLM
+
+    period = 4
+    seq = 12
+    n = 48
+    rng = np.random.default_rng(1)
+    starts = rng.integers(0, period, n)
+    base = (starts[:, None] + np.arange(seq + 1)[None, :]) % period + 1
+    x, y = base[:, :-1].astype(np.int32), base[:, 1:].astype(np.int32)
+    # Right-pad half of each target with pad id 0.
+    y_padded = y.copy()
+    y_padded[:, seq // 2:] = 0
+
+    est = DecoderLM(
+        vocab_size=8, hidden_dim=32, num_layers=1, num_heads=4,
+        max_len=seq, learning_rate=3e-3,
+    )
+    est.fit(
+        x, y_padded, epochs=30, batch_size=16, shuffle=True,
+        validation_data=(x[:8], y_padded[:8]),
+    )
+    # Validation path ran with 2-D targets (would crash pre-fix).
+    assert "val_loss" in est.history
+    # Pad-masked accuracy reflects only real positions; the cyclic task
+    # on the unpadded half is learnable to high accuracy.
+    assert est.history["accuracy"][-1] > 0.9
