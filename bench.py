@@ -37,24 +37,31 @@ def _prior_best() -> float | None:
     return best
 
 
-def _probe_backend(timeout_s: float = 180.0) -> bool:
+def _probe_backend(timeout_s: float = 150.0, attempts: int = 2) -> bool:
     """True if the default (TPU) backend initializes in a subprocess.
 
     The axon TPU tunnel can be down, in which case ``jax.devices()``
     hangs indefinitely — probing in-process would hang the whole bench.
+    The tunnel also flaps transiently, so one retry is worth its 150 s
+    before settling for a CPU fallback number.
     """
     import subprocess
     import sys
 
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            capture_output=True,
-            timeout=timeout_s,
-        )
-        return probe.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    for attempt in range(attempts):
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                capture_output=True,
+                timeout=timeout_s,
+            )
+            if probe.returncode == 0:
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+        if attempt + 1 < attempts:
+            time.sleep(10)
+    return False
 
 
 def _force_cpu() -> None:

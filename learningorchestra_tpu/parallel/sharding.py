@@ -53,9 +53,19 @@ def leaf_spec(path: tuple, shape: tuple, mesh: Mesh) -> P:
     if len(shape) == 4:  # conv HWIO
         out = "tp" if _divisible(shape[3], tp) else None
         return P(None, None, None, out)
-    if len(shape) == 3:  # e.g. attention (heads, head_dim, hidden) fused
+    if len(shape) == 3:
+        # Attention QKV DenseGeneral: (hidden, heads, head_dim) — shard
+        # by HEADS (Megatron attention-parallel: each tp shard owns
+        # whole heads, so the attention itself needs no collective).
+        # Gate on the layer NAME, not just divisibility, so a future
+        # 3-D kernel with a different axis layout never silently gets
+        # heads-style placement.
+        is_qkv = any(t in name for t in ("query", "key", "value", "qkv"))
+        if is_qkv and _divisible(shape[1], tp):
+            inn = "fsdp" if _divisible(shape[0], fsdp) else None
+            return P(inn, "tp", None)
         out = "tp" if _divisible(shape[-1], tp) else None
-        return P(*([None] * (len(shape) - 1)), out)
+        return P(None, None, out)
     return P()
 
 

@@ -518,3 +518,39 @@ class TestDistributedCheckpointing:
             str(ckdir), {"params": est2.params, "opt_state": est2.opt_state}
         )
         assert loaded[1] == 4
+
+
+class TestAttentionHeadSharding:
+    def test_qkv_kernels_shard_by_heads_over_tp(self):
+        """Megatron attention-parallel: 3-D QKV DenseGeneral kernels
+        (hidden, heads, head_dim) place HEADS on tp, so each shard owns
+        whole heads and attention runs collective-free."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from learningorchestra_tpu.models.text import TransformerClassifier
+        from learningorchestra_tpu.parallel.mesh import MeshSpec, build_mesh
+        from learningorchestra_tpu.parallel.sharding import param_shardings
+
+        est = TransformerClassifier(
+            vocab_size=64, hidden_dim=16, num_layers=1, num_heads=4,
+            max_len=16, num_classes=2,
+        )
+        est._init_params(np.zeros((1, 8), np.int32))
+        mesh = build_mesh(MeshSpec(tp=2, fsdp=2),
+                          devices=jax.devices()[:4])
+        shardings = param_shardings(est.params, mesh)
+        param_flat = dict(
+            jax.tree_util.tree_flatten_with_path(est.params)[0]
+        )
+        flat = jax.tree_util.tree_flatten_with_path(shardings)[0]
+        # Select the 3-D QKV KERNELS themselves (not their 2-D biases),
+        # so a regression to replicated kernels fails loudly.
+        qkv = [
+            (path, s) for path, s in flat
+            if "query" in "/".join(str(p) for p in path).lower()
+            and param_flat[path].ndim == 3
+        ]
+        assert qkv, "no 3-D query kernels found"
+        for path, sharding in qkv:
+            assert sharding.spec[1] == "tp", (path, sharding.spec)
