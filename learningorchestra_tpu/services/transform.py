@@ -27,6 +27,22 @@ from learningorchestra_tpu.toolkit import registry
 PROJECTION_TYPE = "transform/projection"
 
 
+def _compact_best_effort(documents, name: str) -> None:
+    """WAL compaction is maintenance, never the job's outcome: a failed
+    rewrite (transient disk/permission issue) must not fail a job whose
+    actual work already committed."""
+    if not hasattr(documents, "compact"):
+        return
+    try:
+        documents.compact(name)
+    except Exception as exc:  # noqa: BLE001 — maintenance only
+        from learningorchestra_tpu.log import get_logger
+
+        get_logger("store").warning(
+            "compact(%s) failed (ignored): %r", name, exc
+        )
+
+
 class TransformService:
     def __init__(self, ctx: ServiceContext):
         self.ctx = ctx
@@ -95,6 +111,8 @@ class TransformService:
                 # (the reference runs this as a Spark job over the
                 # mongo connector; projection_image/projection.py:20-48).
                 n = self.ctx.documents.project(parent_name, name, fields)
+                if replace:
+                    _compact_best_effort(self.ctx.documents, name)
                 return {"rows": n, "fields": fields}
             docs = self.ctx.documents.find(
                 parent_name,
@@ -104,6 +122,10 @@ class TransformService:
                 {f: d.get(f) for f in fields} for d in docs
             )
             n = self.ctx.documents.insert_many(name, out)
+            if replace:
+                # A replace wrote delete+insert WAL entries for every
+                # row; fold the log back to current state.
+                _compact_best_effort(self.ctx.documents, name)
             return {"rows": n, "fields": fields}
 
         self.ctx.engine.submit(
@@ -131,6 +153,7 @@ class TransformService:
         self.ctx.artifacts.metadata.restart(parent_name)
 
         def cast():
+            n_updates = 0
             docs = self.ctx.documents.find(
                 parent_name,
                 query={"_id": {"$gte": 1}, "docType": {"$ne": "execution"}},
@@ -152,6 +175,11 @@ class TransformService:
                     self.ctx.documents.update_one(
                         parent_name, doc["_id"], updates
                     )
+                    n_updates += 1
+            if n_updates:
+                # The cast appended one update entry per document; fold
+                # the WAL back to current state.
+                _compact_best_effort(self.ctx.documents, parent_name)
             return {"cast": list(fields)}
 
         self.ctx.engine.submit(

@@ -316,7 +316,13 @@ class DocumentStore:
     # -- maintenance ----------------------------------------------------------
 
     def compact(self, name: str) -> None:
-        """Rewrite a collection's WAL to current state."""
+        """Rewrite a collection's WAL to current state.
+
+        Durability matches the append path: the rewritten file is
+        fsync'd BEFORE it replaces the live log (and the directory
+        entry after), so a crash mid-compaction can never surface an
+        empty/partial collection where a durable one stood.
+        """
         coll = self._get(name)
         with coll.lock:
             tmp = coll.path.with_suffix(".wal.tmp")
@@ -324,8 +330,15 @@ class DocumentStore:
                 fh.write(json.dumps({"op": "n", "v": coll.next_id}) + "\n")
                 for doc in coll.docs.values():
                     fh.write(json.dumps({"op": "i", "d": doc}, default=str) + "\n")
+                fh.flush()
+                os.fsync(fh.fileno())
             coll._fh.close()
             os.replace(tmp, coll.path)
+            dir_fd = os.open(coll.path.parent, os.O_RDONLY)
+            try:
+                os.fsync(dir_fd)
+            finally:
+                os.close(dir_fd)
             coll._open_log()
 
     def close(self) -> None:

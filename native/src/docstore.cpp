@@ -27,6 +27,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <dirent.h>
+#include <fcntl.h>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -841,6 +842,12 @@ int lods_compact(int64_t h, const char *name) {
     std::string line = "{\"op\":\"i\",\"d\":" + kv.second + "}\n";
     fwrite(line.data(), 1, line.size(), tmp);
   }
+  // Durability parity with the append path: fsync the rewritten file
+  // BEFORE it replaces the live log, and the directory entry after —
+  // a crash mid-compaction must never leave an empty collection where
+  // a durable one stood.
+  fflush(tmp);
+  fsync(fileno(tmp));
   fclose(tmp);
   fclose(coll->fh);
   coll->fh = nullptr;
@@ -848,6 +855,12 @@ int lods_compact(int64_t h, const char *name) {
     set_error(std::string("rename failed: ") + strerror(errno));
     coll->open_log();
     return -1;
+  }
+  std::string dir = coll->path.substr(0, coll->path.find_last_of('/'));
+  int dfd = open(dir.empty() ? "." : dir.c_str(), O_RDONLY);
+  if (dfd >= 0) {
+    fsync(dfd);
+    close(dfd);
   }
   return coll->open_log() ? 0 : -1;
 }
