@@ -156,6 +156,35 @@ class APIServer:
             "limit": _int_param(query, "limit", 20),
         }
 
+    # URL tool → stored artifact-type prefix, where they differ: the
+    # reference's gateway maps /train/horovod onto type=train/tensorflow
+    # and /builder/{tensorflow,pytorch} onto type=builder/horovod
+    # (krakend.json backend query params), so collection GETs must list
+    # the type the POST actually stored.
+    _TYPE_ALIASES = {
+        ("train", "horovod"): "train/tensorflow",
+        ("train", "distributed"): "train/tensorflow",
+        ("builder", "tensorflow"): "builder/horovod",
+        ("builder", "pytorch"): "builder/horovod",
+    }
+
+    def _list_handler(self, service: str, tool: str | None = None):
+        """Collection-GET handler: list a family's metadata docs.
+
+        ``tool=None`` reads the tool from the matched URL."""
+
+        def handler(m, b, q):
+            t = tool if tool is not None else m.group("tool")
+            prefix = self._TYPE_ALIASES.get(
+                (service, t), f"{service}/{t}" if t else service
+            )
+            docs = self.dataset.list_metadata(prefix)
+            # Internal coordinator artifacts (builder runs) are not
+            # client-facing.
+            return 200, [d for d in docs if not d.get("hidden")]
+
+        return handler
+
     # -- route table (SURVEY §2.2) -------------------------------------------
 
     def _register_routes(self) -> None:
@@ -177,12 +206,7 @@ class APIServer:
             return self._created(f"dataset/{kind}", meta)
 
         add("POST", rf"/dataset/{TOOL}", dataset_create)
-        add(
-            "GET", rf"/dataset/{TOOL}",
-            lambda m, b, q: (
-                200, self.dataset.list_metadata(f"dataset/{m.group('tool')}")
-            ),
-        )
+        add("GET", rf"/dataset/{TOOL}", self._list_handler("dataset"))
         add(
             "GET", rf"/dataset/{TOOL}/{NAME}",
             lambda m, b, q: (
@@ -215,6 +239,8 @@ class APIServer:
             return 200, {"metadata": meta}
 
         add("POST", r"/transform/projection", projection_create)
+        add("GET", r"/transform/projection",
+            self._list_handler("transform", "projection"))
         # Reference: PATCH /transform/projection carries the name in the
         # body (krakend.json transform block); also accept /{name}.
         add("PATCH", r"/transform/projection", projection_update)
@@ -253,6 +279,20 @@ class APIServer:
             return 200, {"metadata": meta}
 
         add("PATCH", r"/transform/dataType", datatype_patch)
+        # Reference routes the dataType collection GET onto the dataset
+        # service (krakend.json transform block → databaseapi /files);
+        # per-name GET/DELETE resolve via the generic /transform/{t}
+        # routes below.
+        add(
+            "GET", r"/transform/dataType",
+            lambda m, b, q: (
+                200,
+                [
+                    d for d in self.dataset.list_metadata("dataset/")
+                    if not d.get("hidden")
+                ],
+            ),
+        )
 
         # ---- Transform: generic (scikitlearn | tensorflow) ----
         def transform_create(m, body, query):
@@ -279,6 +319,7 @@ class APIServer:
             return 200, {"metadata": meta}
 
         add("POST", rf"/transform/{TOOL}", transform_create)
+        add("GET", rf"/transform/{TOOL}", self._list_handler("transform"))
         add("PATCH", rf"/transform/{TOOL}/{NAME}", transform_update)
         add(
             "GET", rf"/transform/{TOOL}/{NAME}",
@@ -305,6 +346,8 @@ class APIServer:
             return self._created("explore/histogram", meta)
 
         add("POST", r"/explore/histogram", histogram_create)
+        add("GET", r"/explore/histogram",
+            self._list_handler("explore", "histogram"))
         add(
             "GET", r"/explore/histogram/" + NAME,
             lambda m, b, q: (
@@ -339,6 +382,7 @@ class APIServer:
             return 200, {"metadata": meta}
 
         add("POST", rf"/explore/{TOOL}", explore_create)
+        add("GET", rf"/explore/{TOOL}", self._list_handler("explore"))
         add("PATCH", rf"/explore/{TOOL}/{NAME}", explore_update)
         # GET {name} returns the PNG; {name}/metadata returns docs
         # (reference: krakend.json explore block, SURVEY §2.2).
@@ -388,6 +432,7 @@ class APIServer:
             return 200, {"metadata": meta}
 
         add("POST", rf"/model/{TOOL}", model_create)
+        add("GET", rf"/model/{TOOL}", self._list_handler("model"))
         add("PATCH", rf"/model/{TOOL}/{NAME}", model_update)
         add(
             "GET", rf"/model/{TOOL}/{NAME}",
@@ -504,6 +549,10 @@ class APIServer:
 
         for service in ("tune", "train", "evaluate", "predict"):
             add("POST", rf"/{service}/{TOOL}", exec_create(service))
+            add(
+                "GET", rf"/{service}/{TOOL}",
+                self._list_handler(service),
+            )
             add("PATCH", rf"/{service}/{TOOL}/{NAME}", exec_update)
             add(
                 "GET", rf"/{service}/{TOOL}/{NAME}",
@@ -559,12 +608,20 @@ class APIServer:
             }
 
         add("POST", rf"/builder/{TOOL}", builder_create)
+        add("GET", rf"/builder/{TOOL}", self._list_handler("builder"))
         add(
             "GET", rf"/builder/{TOOL}/{NAME}",
             lambda m, b, q: (
                 200,
                 self.dataset.read_page(m.group("name"), **self._page_args(q)),
             ),
+        )
+        add(
+            "DELETE", rf"/builder/{TOOL}/{NAME}",
+            lambda m, b, q: (
+                self.executor.delete(m.group("name")),
+                (200, {"result": "deleted"}),
+            )[1],
         )
 
         # ---- Function ----
@@ -587,6 +644,8 @@ class APIServer:
             return 200, {"metadata": meta}
 
         add("POST", r"/function/python", function_create)
+        add("GET", r"/function/python",
+            self._list_handler("function", "python"))
         add("PATCH", r"/function/python/" + NAME, function_update)
         add(
             "GET", r"/function/python/" + NAME,

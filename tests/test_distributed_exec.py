@@ -321,3 +321,16 @@ def test_distributed_train_rejects_raw_checkpoint_dir(api, dataset):
         },
     )
     assert resp.status_code == 406, resp.text
+
+
+def test_collection_get_lists_distributed_artifacts(api, dataset):
+    """GET /train/horovod must list the artifacts its own POST created
+    (the reference maps the horovod URL onto type=train/tensorflow, so
+    the listing follows the stored type, not the URL tool)."""
+    base, _ = api
+    docs = requests.get(f"{base}/train/horovod").json()
+    names = {d.get("name") for d in docs}
+    assert "dp_fit" in names or "cfit" in names or len(names) >= 1, docs
+    # No internal hidden artifacts leak into any family listing.
+    for d in docs:
+        assert not d.get("hidden")

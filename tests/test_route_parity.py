@@ -1,0 +1,50 @@
+"""Route-table parity proof: every route in the reference's OWN gateway
+config (microservices/krakend/krakend.json — the §2.2 contract) must
+resolve to a handler here.  This is the line-by-line inventory check the
+component map (PARITY.md) claims, executed mechanically."""
+
+import json
+import os
+from pathlib import Path
+
+import pytest
+
+KRAKEND = Path(
+    os.environ.get("LO_REFERENCE_ROOT", "/root/reference")
+) / "microservices" / "krakend" / "krakend.json"
+
+
+def _reference_routes():
+    cfg = json.loads(KRAKEND.read_text())
+    return sorted({
+        (e.get("method", "GET"), e["endpoint"]) for e in cfg["endpoints"]
+    })
+
+
+@pytest.mark.skipif(not KRAKEND.exists(), reason="reference not mounted")
+def test_every_reference_route_resolves(tmp_path):
+    from learningorchestra_tpu.api import APIServer
+    from learningorchestra_tpu.config import Config
+
+    cfg = Config()
+    cfg.store.root = str(tmp_path / "store")
+    cfg.store.volume_root = str(tmp_path / "volumes")
+    server = APIServer(cfg)
+    try:
+        missing = []
+        for verb, endpoint in _reference_routes():
+            path = (
+                endpoint
+                .replace("{filename}", "x")
+                .replace("{modelName}", "x")
+                .replace("{name}", "x")
+            )
+            handler, _m, _key, flags = server.router.resolve(verb, path)
+            if handler is None:
+                missing.append(f"{verb} {endpoint}")
+        assert not missing, (
+            f"{len(missing)} reference routes unhandled:\n"
+            + "\n".join(missing)
+        )
+    finally:
+        server.shutdown()
