@@ -176,7 +176,7 @@ class APIServer:
         def handler(m, b, q):
             t = tool if tool is not None else m.group("tool")
             prefix = self._TYPE_ALIASES.get(
-                (service, t), f"{service}/{t}" if t else service
+                (service, t), f"{service}/{t}" if t else f"{service}/"
             )
             docs = self.dataset.list_metadata(prefix)
             # Internal coordinator artifacts (builder runs) are not
@@ -239,8 +239,6 @@ class APIServer:
             return 200, {"metadata": meta}
 
         add("POST", r"/transform/projection", projection_create)
-        add("GET", r"/transform/projection",
-            self._list_handler("transform", "projection"))
         # Reference: PATCH /transform/projection carries the name in the
         # body (krakend.json transform block); also accept /{name}.
         add("PATCH", r"/transform/projection", projection_update)
@@ -282,17 +280,10 @@ class APIServer:
         # Reference routes the dataType collection GET onto the dataset
         # service (krakend.json transform block → databaseapi /files);
         # per-name GET/DELETE resolve via the generic /transform/{t}
-        # routes below.
-        add(
-            "GET", r"/transform/dataType",
-            lambda m, b, q: (
-                200,
-                [
-                    d for d in self.dataset.list_metadata("dataset/")
-                    if not d.get("hidden")
-                ],
-            ),
-        )
+        # routes below.  _list_handler("dataset", "") lists the whole
+        # dataset family (prefix "dataset").
+        add("GET", r"/transform/dataType",
+            self._list_handler("dataset", ""))
 
         # ---- Transform: generic (scikitlearn | tensorflow) ----
         def transform_create(m, body, query):
@@ -346,8 +337,6 @@ class APIServer:
             return self._created("explore/histogram", meta)
 
         add("POST", r"/explore/histogram", histogram_create)
-        add("GET", r"/explore/histogram",
-            self._list_handler("explore", "histogram"))
         add(
             "GET", r"/explore/histogram/" + NAME,
             lambda m, b, q: (

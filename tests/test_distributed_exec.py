@@ -328,9 +328,32 @@ def test_collection_get_lists_distributed_artifacts(api, dataset):
     (the reference maps the horovod URL onto type=train/tensorflow, so
     the listing follows the stored type, not the URL tool)."""
     base, _ = api
-    docs = requests.get(f"{base}/train/horovod").json()
-    names = {d.get("name") for d in docs}
-    assert "dp_fit" in names or "cfit" in names or len(names) >= 1, docs
-    # No internal hidden artifacts leak into any family listing.
-    for d in docs:
-        assert not d.get("hidden")
+    requests.post(
+        f"{base}/model/tensorflow",
+        json={
+            "name": "lmodel",
+            "modulePath": "learningorchestra_tpu.models.mlp",
+            "class": "MLPClassifier",
+            "classParameters": {"hidden_layer_sizes": [4],
+                                "num_classes": 2},
+        },
+    )
+    poll(base, "/model/tensorflow/lmodel")
+    resp = requests.post(
+        f"{base}/train/horovod",
+        json={
+            "name": "ltrain",
+            "parentName": "lmodel",
+            "trainingParameters": {
+                "x": "$dd_X", "y": "$dd.label",
+                "epochs": 1, "batch_size": 16,
+            },
+        },
+    )
+    assert resp.status_code == 201, resp.text
+    poll(base, "/train/horovod/ltrain")
+    for family in ("train/horovod", "train/tensorflow"):
+        docs = requests.get(f"{base}/{family}").json()
+        names = {d.get("name") for d in docs}
+        assert "ltrain" in names, (family, names)
+        assert not any(d.get("hidden") for d in docs)
