@@ -472,8 +472,8 @@ def flash_attention(
     key_mask=None,
     *,
     causal: bool = False,
-    block_q: int = 512,
-    block_k: int = 1024,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ):
     """Blockwise attention. q,k,v: (B, H, T, D); key_mask: (B, Tk) bool.
@@ -481,12 +481,18 @@ def flash_attention(
     Sequences are padded to block multiples internally; padded keys are
     masked out, padded query rows are sliced off the output.
 
-    Default blocks were tuned on TPU v5e at D=64: (512, 1024) reaches
-    2.8x XLA's fused attention at T=32k (36 vs 13 TF/s); 128-sized
-    blocks leave the MXU idle on grid overhead (~4 MFLOP per step).
+    Default blocks follow the TPU v5e sweep (TPU_EVIDENCE.md): (256,
+    512) wins for T <= 8k (1.20x XLA), (512, 1024) for longer (2.80x at
+    T=32k, where XLA OOMs with masks); 128-sized blocks leave the MXU
+    idle on grid overhead (~4 MFLOP per step).
     """
     if interpret is None:
         interpret = _auto_interpret()
+    t_longest = max(q.shape[2], k.shape[2])
+    if block_q is None:
+        block_q = 256 if t_longest <= 8192 else 512
+    if block_k is None:
+        block_k = 512 if t_longest <= 8192 else 1024
     b, h, tq, d = q.shape
     tk = k.shape[2]
     block_q = min(block_q, max(8, tq))
