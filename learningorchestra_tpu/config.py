@@ -78,6 +78,7 @@ class MeshConfig:
       - ``tp``: tensor parallelism (feature/head sharding)
       - ``sp``: sequence/context parallelism (ring attention)
       - ``pp``: pipeline stages
+      - ``ep``: expert parallelism (MoE expert sharding)
     A dimension of 0 means "auto": fill with remaining devices on dp.
     """
 
@@ -86,11 +87,12 @@ class MeshConfig:
     tp: int = 1
     sp: int = 1
     pp: int = 1
+    ep: int = 1
 
-    axis_names: tuple = ("dp", "fsdp", "pp", "tp", "sp")
+    axis_names: tuple = ("dp", "fsdp", "pp", "ep", "tp", "sp")
 
     def shape(self, n_devices: int) -> dict:
-        fixed = self.fsdp * self.tp * self.sp * self.pp
+        fixed = self.fsdp * self.tp * self.sp * self.pp * self.ep
         dp = self.dp
         if dp == 0:
             if n_devices % max(fixed, 1) != 0:
@@ -102,6 +104,7 @@ class MeshConfig:
             "dp": dp,
             "fsdp": self.fsdp,
             "pp": self.pp,
+            "ep": self.ep,
             "tp": self.tp,
             "sp": self.sp,
         }

@@ -19,11 +19,16 @@ from learningorchestra_tpu.models.vision import (
     VGG16,
 )
 from learningorchestra_tpu.models.text import (
+    DecoderLM,
     LSTMClassifier,
     TransformerClassifier,
     BertModel,
 )
 from learningorchestra_tpu.models.longcontext import LongContextTransformer
+from learningorchestra_tpu.models.moe import (
+    MoEDecoderLM,
+    MoETransformerClassifier,
+)
 
 __all__ = [
     "MLPClassifier",
@@ -36,5 +41,8 @@ __all__ = [
     "LSTMClassifier",
     "TransformerClassifier",
     "BertModel",
+    "DecoderLM",
     "LongContextTransformer",
+    "MoEDecoderLM",
+    "MoETransformerClassifier",
 ]

@@ -1,0 +1,236 @@
+"""Mixture-of-experts transformer family (expert parallelism).
+
+Beyond-parity headroom: the reference zoo is dense keras/sklearn only
+(reference: microservices/model_image/model.py:92-162 instantiates
+``keras.applications`` classes; binary_executor_image ships dense keras
+JSON) — it has no conditional-compute models.  These pair the routed
+expert FFN (ops/moe.py) with the framework's attention stack: MoE
+blocks interleave with dense blocks (GShard's every-other-layer
+pattern), experts shard over the ``ep`` mesh axis, tokens reach them
+via XLA-inserted all_to_all.
+
+Scaling shape: parameters grow with ``num_experts`` while per-token
+FLOPs stay ~constant (top-k of E experts run per token), so the model
+family covers the "more capacity, same step time" axis the dense zoo
+cannot.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from learningorchestra_tpu.models.text import (
+    GreedyDecodeMixin,
+    TransformerBlock,
+)
+from learningorchestra_tpu.ops.layers import MultiHeadSelfAttention
+from learningorchestra_tpu.ops.moe import MoEMlp
+from learningorchestra_tpu.toolkit.registry import register
+from learningorchestra_tpu.train.neural import NeuralEstimator
+
+_MODULE = "learningorchestra_tpu.models.moe"
+
+
+class MoETransformerBlock(nn.Module):
+    """Pre-LN transformer block whose FFN is a routed expert layer."""
+
+    hidden_dim: int
+    num_heads: int
+    mlp_dim: int
+    num_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.5
+    dtype: jnp.dtype = jnp.float32
+    use_flash: bool | None = None
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, x, key_mask=None):
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = MultiHeadSelfAttention(
+            num_heads=self.num_heads,
+            qkv_features=self.hidden_dim,
+            dtype=self.dtype,
+            use_flash=self.use_flash,
+            causal=self.causal,
+        )(y, key_mask=key_mask)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = MoEMlp(
+            num_experts=self.num_experts,
+            hidden_dim=self.hidden_dim,
+            mlp_dim=self.mlp_dim,
+            top_k=self.top_k,
+            capacity_factor=self.capacity_factor,
+            dtype=self.dtype,
+        )(y)
+        return x + y
+
+
+class _MoETransformer(nn.Module):
+    """Encoder/decoder trunk with MoE FFNs every ``moe_every`` blocks.
+
+    ``head``: 'cls' pools position 0 through a tanh head (classifier),
+    'lm' emits per-token vocab logits (causal LM).
+    """
+
+    vocab_size: int
+    hidden_dim: int
+    num_layers: int
+    num_heads: int
+    mlp_dim: int
+    max_len: int
+    num_experts: int
+    num_classes: int
+    head: str = "cls"
+    moe_every: int = 2
+    top_k: int = 2
+    capacity_factor: float = 1.5
+    dtype: jnp.dtype = jnp.float32
+    use_flash: bool | None = None
+
+    @nn.compact
+    def __call__(self, tokens):
+        tokens = tokens.astype(jnp.int32)
+        seq = tokens.shape[1]
+        causal = self.head == "lm"
+        x = nn.Embed(self.vocab_size, self.hidden_dim, dtype=self.dtype)(
+            tokens
+        ) + nn.Embed(self.max_len, self.hidden_dim, dtype=self.dtype)(
+            jnp.arange(seq)[None, :]
+        )
+        pad_mask = tokens != 0
+        for i in range(self.num_layers):
+            # MoE on the LAST block of each moe_every group so a
+            # 1-layer net is still dense-first (router sees features).
+            if (i + 1) % self.moe_every == 0:
+                x = MoETransformerBlock(
+                    hidden_dim=self.hidden_dim,
+                    num_heads=self.num_heads,
+                    mlp_dim=self.mlp_dim,
+                    num_experts=self.num_experts,
+                    top_k=self.top_k,
+                    capacity_factor=self.capacity_factor,
+                    dtype=self.dtype,
+                    use_flash=self.use_flash,
+                    causal=causal,
+                    name=f"MoEBlock_{i}",
+                )(x, key_mask=pad_mask)
+            else:
+                x = TransformerBlock(
+                    hidden_dim=self.hidden_dim,
+                    num_heads=self.num_heads,
+                    mlp_dim=self.mlp_dim,
+                    dtype=self.dtype,
+                    use_flash=self.use_flash,
+                    causal=causal,
+                    name=f"TransformerBlock_{i}",
+                )(x, key_mask=pad_mask)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        if self.head == "lm":
+            return nn.Dense(self.vocab_size, dtype=self.dtype)(x)
+        cls = jnp.tanh(nn.Dense(self.hidden_dim)(x[:, 0]))
+        return nn.Dense(self.num_classes)(cls)
+
+
+@register(_MODULE)
+class MoETransformerClassifier(NeuralEstimator):
+    """Sequence classifier with routed-expert FFNs."""
+
+    def __init__(
+        self,
+        vocab_size: int = 20000,
+        hidden_dim: int = 128,
+        num_layers: int = 2,
+        num_heads: int = 4,
+        mlp_dim: int | None = None,
+        max_len: int = 256,
+        num_experts: int = 8,
+        top_k: int = 2,
+        capacity_factor: float = 1.5,
+        moe_every: int = 2,
+        num_classes: int = 2,
+        learning_rate: float = 1e-3,
+        seed: int = 0,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_dim = hidden_dim
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.mlp_dim = mlp_dim or hidden_dim * 4
+        self.max_len = max_len
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.moe_every = moe_every
+        self.num_classes = num_classes
+        super().__init__(
+            _MoETransformer(
+                vocab_size=vocab_size,
+                hidden_dim=hidden_dim,
+                num_layers=num_layers,
+                num_heads=num_heads,
+                mlp_dim=self.mlp_dim,
+                max_len=max_len,
+                num_experts=num_experts,
+                num_classes=num_classes,
+                head="cls",
+                moe_every=moe_every,
+                top_k=top_k,
+                capacity_factor=capacity_factor,
+            ),
+            loss="softmax_ce",
+            learning_rate=learning_rate,
+            seed=seed,
+        )
+
+
+@register(_MODULE)
+class MoEDecoderLM(GreedyDecodeMixin, NeuralEstimator):
+    """Causal LM with routed-expert FFNs (sparse GPT shape)."""
+
+    def __init__(
+        self,
+        vocab_size: int = 32000,
+        hidden_dim: int = 256,
+        num_layers: int = 4,
+        num_heads: int = 8,
+        mlp_dim: int | None = None,
+        max_len: int = 1024,
+        num_experts: int = 8,
+        top_k: int = 2,
+        capacity_factor: float = 1.5,
+        moe_every: int = 2,
+        learning_rate: float = 3e-4,
+        seed: int = 0,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_dim = hidden_dim
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.mlp_dim = mlp_dim or hidden_dim * 4
+        self.max_len = max_len
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.moe_every = moe_every
+        super().__init__(
+            _MoETransformer(
+                vocab_size=vocab_size,
+                hidden_dim=hidden_dim,
+                num_layers=num_layers,
+                num_heads=num_heads,
+                mlp_dim=self.mlp_dim,
+                max_len=max_len,
+                num_experts=num_experts,
+                num_classes=vocab_size,
+                head="lm",
+                moe_every=moe_every,
+                top_k=top_k,
+                capacity_factor=capacity_factor,
+            ),
+            loss="softmax_ce",
+            learning_rate=learning_rate,
+            seed=seed,
+        )

@@ -276,8 +276,37 @@ class _DecoderLM(nn.Module):
         return nn.Dense(self.vocab_size, dtype=self.dtype)(x)  # (B,T,V)
 
 
+class GreedyDecodeMixin:
+    """Greedy autoregressive decoding for any estimator whose module
+    maps token ids (B, T) to per-token vocab logits (B, T, V)."""
+
+    def generate(self, prompts, max_new_tokens: int = 32):
+        """Greedy continuation of int32 prompts (B, T0).
+
+        Decodes in a FIXED-shape buffer (right-padded with pad id 0, so
+        causal masking + the model's own pad key-mask make the padded
+        tail inert) — one XLA compile for the whole decode, instead of a
+        retrace per new sequence length."""
+        import jax
+        import numpy as np
+
+        prompts = np.asarray(prompts, dtype=np.int32)
+        bsz, t0 = prompts.shape
+        total = min(self.max_len, t0 + max_new_tokens)
+        if self._apply_fn is None:
+            self._apply_fn = jax.jit(self.module.apply)
+        buf = np.zeros((bsz, total), np.int32)
+        buf[:, :t0] = prompts
+        for cur in range(t0, total):
+            logits = self._apply_fn(self.params, jnp.asarray(buf))
+            buf[:, cur] = np.asarray(
+                jnp.argmax(logits[:, cur - 1], axis=-1)
+            )
+        return buf
+
+
 @register(_MODULE)
-class DecoderLM(NeuralEstimator):
+class DecoderLM(GreedyDecodeMixin, NeuralEstimator):
     """Causal (decoder-only) language model — beyond-parity headroom:
     the reference has no attention at all (SURVEY §5.7); this pairs the
     causal Pallas flash kernel with the keras-fit surface.
@@ -321,27 +350,3 @@ class DecoderLM(NeuralEstimator):
             learning_rate=learning_rate,
             seed=seed,
         )
-
-    def generate(self, prompts, max_new_tokens: int = 32):
-        """Greedy continuation of int32 prompts (B, T0).
-
-        Decodes in a FIXED-shape buffer (right-padded with pad id 0, so
-        causal masking + the model's own pad key-mask make the padded
-        tail inert) — one XLA compile for the whole decode, instead of a
-        retrace per new sequence length."""
-        import jax
-        import numpy as np
-
-        prompts = np.asarray(prompts, dtype=np.int32)
-        bsz, t0 = prompts.shape
-        total = min(self.max_len, t0 + max_new_tokens)
-        if self._apply_fn is None:
-            self._apply_fn = jax.jit(self.module.apply)
-        buf = np.zeros((bsz, total), np.int32)
-        buf[:, :t0] = prompts
-        for cur in range(t0, total):
-            logits = self._apply_fn(self.params, jnp.asarray(buf))
-            buf[:, cur] = np.asarray(
-                jnp.argmax(logits[:, cur - 1], axis=-1)
-            )
-        return buf

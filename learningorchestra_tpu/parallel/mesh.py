@@ -8,10 +8,15 @@ ring (reference: microservices/binary_executor_image/server.py:16-17 —
 - ``dp``   — data parallelism: batch split, gradients psum'd over ICI;
 - ``fsdp`` — data parallelism with parameters sharded along it (ZeRO-3
   style), all-gathered per layer by XLA when used;
+- ``pp``   — pipeline parallelism: layer stages (axis reserved; the
+  staged executor lands with parallel/pipeline.py — until then
+  validate_spec rejects pp > 1 rather than silently replicating);
+- ``ep``   — expert parallelism: MoE expert weights sharded along it,
+  tokens all_to_all'd to their experts (ops/moe.py);
 - ``tp``   — tensor parallelism: feature-dim matmul sharding;
 - ``sp``   — sequence/context parallelism: ring attention over this axis.
 
-All four axes always exist (size 1 when unused) so any strategy is a
+All six axes always exist (size 1 when unused) so any strategy is a
 sharding annotation, never a rewrite — SURVEY §2.4's design requirement.
 """
 
@@ -23,7 +28,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("dp", "fsdp", "tp", "sp")
+AXES = ("dp", "fsdp", "pp", "ep", "tp", "sp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -32,12 +37,14 @@ class MeshSpec:
 
     dp: int = 1
     fsdp: int = 1
+    pp: int = 1
+    ep: int = 1
     tp: int = 1
     sp: int = 1
 
     @property
     def size(self) -> int:
-        return self.dp * self.fsdp * self.tp * self.sp
+        return self.dp * self.fsdp * self.pp * self.ep * self.tp * self.sp
 
     def axis_sizes(self) -> dict[str, int]:
         return {a: getattr(self, a) for a in AXES}
@@ -57,13 +64,16 @@ def default_spec(n_devices: int | None = None) -> MeshSpec:
 def build_mesh(
     spec: MeshSpec | None = None, devices: list | None = None
 ) -> Mesh:
-    """Arrange devices into a 4-axis named mesh.
+    """Arrange devices into a 6-axis named mesh.
 
-    Axis order is (dp, fsdp, tp, sp) from outermost to innermost:
-    ``jax.devices()`` enumerates devices in ICI-neighbor order, so inner
-    axes (tp/sp — latency-sensitive, per-layer collectives) land on
-    ICI-adjacent chips, while dp (one psum per step, bandwidth-tolerant)
-    spans the outer dimension and, multi-slice, the DCN boundary.
+    Axis order is (dp, fsdp, pp, ep, tp, sp) from outermost to
+    innermost: ``jax.devices()`` enumerates devices in ICI-neighbor
+    order, so inner axes (tp/sp — latency-sensitive, per-layer
+    collectives — and ep's per-MoE-layer all_to_all) land on
+    ICI-adjacent chips; pp communicates only microbatch activations at
+    stage boundaries and sits outside them; dp (one psum per step,
+    bandwidth-tolerant) spans the outer dimension and, multi-slice, the
+    DCN boundary.
     """
     devs = np.asarray(devices if devices is not None else jax.devices())
     spec = spec or default_spec(devs.size)
@@ -102,3 +112,8 @@ def validate_spec(spec: MeshSpec) -> None:
     # permutation balanced on physical ICI tori.
     if spec.sp > 1 and spec.sp & (spec.sp - 1):
         raise ValueError("sp axis should be a power of two")
+    if spec.pp > 1:
+        raise ValueError(
+            "pp axis is reserved: pipeline-parallel execution is not "
+            "wired yet, and pp > 1 would silently replicate all work"
+        )

@@ -47,6 +47,11 @@ def leaf_spec(path: tuple, shape: tuple, mesh: Mesh) -> P:
             return P("tp", None)
         return P()
     if len(shape) == 2:
+        # Per-expert biases (experts, features): experts over ep with
+        # the expert kernels they belong to.
+        ep = mesh.shape.get("ep", 1)
+        if "expert" in name and _divisible(shape[0], ep):
+            return P("ep", "tp" if _divisible(shape[1], tp) else None)
         out = "tp" if _divisible(shape[1], tp) else None
         inn = "fsdp" if _divisible(shape[0], fsdp) else None
         return P(inn, out)
@@ -54,6 +59,14 @@ def leaf_spec(path: tuple, shape: tuple, mesh: Mesh) -> P:
         out = "tp" if _divisible(shape[3], tp) else None
         return P(None, None, None, out)
     if len(shape) == 3:
+        # MoE expert kernels (ops/moe.py): (experts, in, out) — experts
+        # over ``ep`` (each ep shard owns whole experts; tokens reach
+        # them via the dispatch einsum's all_to_all), out-features over
+        # ``tp`` within each expert.  Name-gated like QKV below.
+        ep = mesh.shape.get("ep", 1)
+        if "expert" in name and _divisible(shape[0], ep):
+            out = "tp" if _divisible(shape[2], tp) else None
+            return P("ep", None, out)
         # Attention QKV DenseGeneral: (hidden, heads, head_dim) — shard
         # by HEADS (Megatron attention-parallel: each tp shard owns
         # whole heads, so the attention itself needs no collective).

@@ -68,6 +68,21 @@ def _batch_data(x: np.ndarray, y: np.ndarray, batch_size: int, rng):
     return xb, yb, mb
 
 
+def _apply_with_aux(module, p, xb):
+    """Apply the module collecting sown auxiliary losses.
+
+    Modules may ``sow('losses', name, value)`` extra differentiable
+    objective terms (the MoE load-balancing loss, ops/moe.py); dense
+    modules sow nothing and the collection comes back empty.  Returns
+    ``(f32 logits, f32 aux-loss sum)``.
+    """
+    logits, var = module.apply(p, xb, mutable="losses")
+    aux = jnp.asarray(0.0, jnp.float32)
+    for leaf in jax.tree_util.tree_leaves(var):
+        aux = aux + jnp.sum(leaf).astype(jnp.float32)
+    return logits.astype(jnp.float32), aux
+
+
 def build_device_epoch(
     module, optimizer, loss_fn, dtype, *, n, batch_size, shuffle
 ):
@@ -113,8 +128,9 @@ def build_device_epoch(
             bx, by, bm = batch
 
             def objective(p):
-                logits = module.apply(p, _cast(bx)).astype(jnp.float32)
-                return loss_fn(logits, by, bm)
+                logits, aux = _apply_with_aux(module, p, _cast(bx))
+                loss, metrics = loss_fn(logits, by, bm)
+                return loss + aux, metrics
 
             grads, metrics = jax.grad(objective, has_aux=True)(params)
             updates, opt_state = optimizer.update(grads, opt_state, params)
@@ -143,8 +159,9 @@ def _cast_for(dtype):
 def _make_step(module, optimizer, loss_fn, _cast):
     def step(params, opt_state, xb, yb, mb):
         def objective(p):
-            logits = module.apply(p, _cast(xb)).astype(jnp.float32)
-            return loss_fn(logits, yb, mb)
+            logits, aux = _apply_with_aux(module, p, _cast(xb))
+            loss, metrics = loss_fn(logits, yb, mb)
+            return loss + aux, metrics
 
         grads, metrics = jax.grad(objective, has_aux=True)(params)
         updates, opt_state = optimizer.update(grads, opt_state, params)

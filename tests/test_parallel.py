@@ -41,7 +41,9 @@ def test_default_spec_uses_all_devices():
 
 def test_build_mesh_shapes():
     mesh = build_mesh(MeshSpec(dp=2, tp=2, sp=2))
-    assert dict(mesh.shape) == {"dp": 2, "fsdp": 1, "tp": 2, "sp": 2}
+    assert dict(mesh.shape) == {
+        "dp": 2, "fsdp": 1, "pp": 1, "ep": 1, "tp": 2, "sp": 2,
+    }
 
 
 def test_build_mesh_folds_spare_devices_into_dp():
