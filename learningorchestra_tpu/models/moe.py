@@ -23,6 +23,8 @@ from flax import linen as nn
 from learningorchestra_tpu.models.text import (
     GreedyDecodeMixin,
     TransformerBlock,
+    cls_head,
+    embed_tokens,
 )
 from learningorchestra_tpu.ops.layers import MultiHeadSelfAttention
 from learningorchestra_tpu.ops.moe import MoEMlp
@@ -93,12 +95,10 @@ class _MoETransformer(nn.Module):
     @nn.compact
     def __call__(self, tokens):
         tokens = tokens.astype(jnp.int32)
-        seq = tokens.shape[1]
         causal = self.head == "lm"
-        x = nn.Embed(self.vocab_size, self.hidden_dim, dtype=self.dtype)(
-            tokens
-        ) + nn.Embed(self.max_len, self.hidden_dim, dtype=self.dtype)(
-            jnp.arange(seq)[None, :]
+        x = embed_tokens(
+            tokens, self.vocab_size, self.hidden_dim, self.max_len,
+            self.dtype,
         )
         pad_mask = tokens != 0
         for i in range(self.num_layers):
@@ -130,8 +130,7 @@ class _MoETransformer(nn.Module):
         x = nn.LayerNorm(dtype=self.dtype)(x)
         if self.head == "lm":
             return nn.Dense(self.vocab_size, dtype=self.dtype)(x)
-        cls = jnp.tanh(nn.Dense(self.hidden_dim)(x[:, 0]))
-        return nn.Dense(self.num_classes)(cls)
+        return cls_head(x, self.hidden_dim, self.num_classes)
 
 
 @register(_MODULE)

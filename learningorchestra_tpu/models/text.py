@@ -68,6 +68,30 @@ class LSTMClassifier(NeuralEstimator):
         )
 
 
+def embed_tokens(tokens, vocab_size, hidden_dim, max_len, dtype):
+    """Token + learned positional embedding (pad id 0 convention).
+
+    A helper, not a submodule: called inside a ``@nn.compact``
+    ``__call__`` the two ``nn.Embed`` layers auto-name in the CALLER's
+    scope (``Embed_0``/``Embed_1``), so every transformer family —
+    BERT, decoder LM, MoE, pipelined — shares one embedding definition
+    without perturbing existing parameter trees.
+    """
+    seq = tokens.shape[-1]
+    tok = nn.Embed(vocab_size, hidden_dim, dtype=dtype)(tokens)
+    pos = nn.Embed(max_len, hidden_dim, dtype=dtype)(
+        jnp.arange(seq)[None, :]
+    )
+    return tok + pos
+
+
+def cls_head(x, hidden_dim, num_classes):
+    """[CLS]-pool position 0 through a tanh projection + classifier.
+    Same call-site-scoping contract as :func:`embed_tokens`."""
+    cls = jnp.tanh(nn.Dense(hidden_dim)(x[:, 0]))
+    return nn.Dense(num_classes)(cls)
+
+
 class TransformerBlock(nn.Module):
     """Pre-LN block over the framework's own attention layer: the Pallas
     flash kernel on TPU (ops/attention.py), jnp reference elsewhere —
@@ -118,14 +142,10 @@ class BertEncoder(nn.Module):
     @nn.compact
     def __call__(self, tokens):
         tokens = tokens.astype(jnp.int32)
-        seq = tokens.shape[1]
-        tok = nn.Embed(self.vocab_size, self.hidden_dim, dtype=self.dtype)(
-            tokens
+        x = embed_tokens(
+            tokens, self.vocab_size, self.hidden_dim, self.max_len,
+            self.dtype,
         )
-        pos = nn.Embed(self.max_len, self.hidden_dim, dtype=self.dtype)(
-            jnp.arange(seq)[None, :]
-        )
-        x = tok + pos
         # Key-side padding mask (pad id 0).  Key-side masking is exact
         # for every non-pad query row; pad query rows produce values no
         # one reads — the [CLS] head pools position 0 only.
@@ -156,9 +176,7 @@ class _BertClassifier(nn.Module):
     @nn.compact
     def __call__(self, tokens):
         x = self.encoder(tokens)
-        cls = x[:, 0]  # [CLS] pooling
-        cls = jnp.tanh(nn.Dense(self.encoder.hidden_dim)(cls))
-        return nn.Dense(self.num_classes)(cls)
+        return cls_head(x, self.encoder.hidden_dim, self.num_classes)
 
 
 @register(_MODULE)
@@ -251,14 +269,10 @@ class _DecoderLM(nn.Module):
     @nn.compact
     def __call__(self, tokens):
         tokens = tokens.astype(jnp.int32)
-        seq = tokens.shape[1]
-        tok = nn.Embed(self.vocab_size, self.hidden_dim, dtype=self.dtype)(
-            tokens
+        x = embed_tokens(
+            tokens, self.vocab_size, self.hidden_dim, self.max_len,
+            self.dtype,
         )
-        pos = nn.Embed(self.max_len, self.hidden_dim, dtype=self.dtype)(
-            jnp.arange(seq)[None, :]
-        )
-        x = tok + pos
         pad_mask = tokens != 0  # (B, T), pad id 0
         block_cls = nn.remat(TransformerBlock) if self.remat \
             else TransformerBlock

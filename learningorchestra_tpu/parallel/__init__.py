@@ -35,3 +35,6 @@ from learningorchestra_tpu.parallel.distributed import (  # noqa: F401
 from learningorchestra_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
 )
+from learningorchestra_tpu.parallel.pipeline import (  # noqa: F401
+    PipelinedTransformer,
+)

@@ -60,6 +60,14 @@ class DistributedTrainer:
     ):
         self.estimator = estimator
         self.mesh = mesh if mesh is not None else build_mesh(spec)
+        if self.mesh.shape.get("pp", 1) > 1:
+            # Nothing in this trainer shards over pp, so pp > 1 would
+            # replicate every rank's work pp-fold with no speedup.
+            raise ValueError(
+                "DistributedTrainer does not use the pp axis; "
+                "pipeline parallelism is parallel.pipeline."
+                "PipelinedTransformer"
+            )
         self.shard_sequence = shard_sequence
         self._bind_depth = 0
         self.history = TrainHistory()

@@ -8,9 +8,8 @@ ring (reference: microservices/binary_executor_image/server.py:16-17 —
 - ``dp``   — data parallelism: batch split, gradients psum'd over ICI;
 - ``fsdp`` — data parallelism with parameters sharded along it (ZeRO-3
   style), all-gathered per layer by XLA when used;
-- ``pp``   — pipeline parallelism: layer stages (axis reserved; the
-  staged executor lands with parallel/pipeline.py — until then
-  validate_spec rejects pp > 1 rather than silently replicating);
+- ``pp``   — pipeline parallelism: GPipe microbatch stages, activations
+  ppermute'd between ICI neighbours (parallel/pipeline.py);
 - ``ep``   — expert parallelism: MoE expert weights sharded along it,
   tokens all_to_all'd to their experts (ops/moe.py);
 - ``tp``   — tensor parallelism: feature-dim matmul sharding;
@@ -112,8 +111,3 @@ def validate_spec(spec: MeshSpec) -> None:
     # permutation balanced on physical ICI tori.
     if spec.sp > 1 and spec.sp & (spec.sp - 1):
         raise ValueError("sp axis should be a power of two")
-    if spec.pp > 1:
-        raise ValueError(
-            "pp axis is reserved: pipeline-parallel execution is not "
-            "wired yet, and pp > 1 would silently replicate all work"
-        )
