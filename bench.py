@@ -137,6 +137,57 @@ def _flash_check() -> dict:
     return {"flash_on_tpu": "ok", "flash_max_err": round(err, 5)}
 
 
+def _fused_throughput(est, x, y, batch_size, k: int = 4) -> float:
+    """Steady-state samples/s measured tunnel-immune.
+
+    The per-epoch runner pays one dispatch+readback round-trip per
+    epoch; the axon tunnel's RT has been observed anywhere from 7 ms to
+    seconds, which dominates sub-100 ms epochs.  Run k and 3k epochs as
+    ONE jitted call each (build_fused_epochs) and time the difference —
+    the constant per-call round-trip cancels exactly.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from learningorchestra_tpu.train.neural import build_fused_epochs
+
+    n = len(x)
+    loss_kind = est._resolve_loss(y)
+    loss_fn = est._loss_and_metrics(loss_kind)
+    dtype = jnp.bfloat16 if est.compute_dtype == "bfloat16" else None
+
+    runners = {
+        m: build_fused_epochs(
+            est.module, est.optimizer, loss_fn, dtype,
+            n=n, batch_size=batch_size, shuffle=True, epochs=m,
+        )
+        for m in (k, 3 * k)
+    }
+    xd, yd = jnp.asarray(x), jnp.asarray(y.astype("int32"))
+    params, opt = est.params, est.opt_state
+    key = jax.random.PRNGKey(0)
+
+    def run(m):  # one dispatch; the scalar readback is the sync point
+        nonlocal params, opt
+        params, opt, metrics = runners[m](params, opt, xd, yd, key)
+        return float(metrics["loss"][-1])
+
+    best = 0.0
+    run(k), run(3 * k)  # compile both
+    for _ in range(2):
+        t0 = time.perf_counter()
+        run(k)
+        t1 = time.perf_counter()
+        run(3 * k)
+        t2 = time.perf_counter()
+        dt = (t2 - t1) - (t1 - t0)
+        if dt > 0:
+            best = max(best, 2 * k * n / dt)
+    if best <= 0:
+        raise RuntimeError("fused timing produced non-positive delta")
+    return best
+
+
 def main() -> None:
     if not _probe_backend():
         _force_cpu()  # record a CPU number rather than hang the driver
@@ -162,11 +213,14 @@ def main() -> None:
 
     est = MnistCNN()
     est._init_params(jnp.asarray(x[:1]))
-    # Epoch 1 pays compile; measure steady-state epochs only.
-    est.fit(x, y, epochs=epochs, batch_size=batch_size, shuffle=True)
-    epoch_times = est.history["epoch_time"][1:]
-    best_epoch = min(epoch_times)
-    throughput = n_samples / best_epoch
+    if platform == "tpu":
+        throughput = _fused_throughput(est, x, y, batch_size)
+    else:
+        # Epoch 1 pays compile; measure steady-state epochs only.
+        est.fit(x, y, epochs=epochs, batch_size=batch_size, shuffle=True)
+        epoch_times = est.history["epoch_time"][1:]
+        best_epoch = min(epoch_times)
+        throughput = n_samples / best_epoch
 
     extra: dict = {}
     peak = _peak_flops(platform)

@@ -56,7 +56,7 @@ class DistributedTrainer:
         estimator: NeuralEstimator,
         spec: MeshSpec | None = None,
         mesh: Mesh | None = None,
-        shard_sequence: bool = False,
+        shard_sequence: bool | None = None,
     ):
         self.estimator = estimator
         self.mesh = mesh if mesh is not None else build_mesh(spec)
@@ -68,6 +68,10 @@ class DistributedTrainer:
                 "pipeline parallelism is parallel.pipeline."
                 "PipelinedTransformer"
             )
+        if shard_sequence is None:
+            # Auto: an sp>1 mesh only means anything if the token axis
+            # is actually sharded.
+            shard_sequence = self.mesh.shape.get("sp", 1) > 1
         self.shard_sequence = shard_sequence
         self._bind_depth = 0
         self.history = TrainHistory()

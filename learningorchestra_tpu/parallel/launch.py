@@ -158,7 +158,11 @@ def multihost_fit(
         est.compile(**dsl.resolve_params(compile_spec, _NoStore()))
 
     spec = MeshSpec.from_dict(mesh or {"dp": jax.device_count()})
-    trainer = DistributedTrainer(est, mesh=build_mesh(spec))
+    shard_seq = (mesh or {}).get("shardSequence")
+    trainer = DistributedTrainer(
+        est, mesh=build_mesh(spec),
+        shard_sequence=None if shard_seq is None else bool(shard_seq),
+    )
 
     x = np.load(data["x"], allow_pickle=False)
     y = np.load(data["y"], allow_pickle=False)

@@ -223,7 +223,14 @@ class DistributedExecutorService:
                     **dsl.resolve_params(compile_spec, self.ctx.loader)
                 )
             spec = MeshSpec.from_dict(mesh) if mesh else None
-            trainer = DistributedTrainer(instance, spec=spec)
+            # shard_sequence=None → trainer auto-default (on iff sp>1);
+            # the mesh body can force it with "shardSequence".
+            shard_seq = (mesh or {}).get("shardSequence")
+            trainer = DistributedTrainer(
+                instance, spec=spec,
+                shard_sequence=None if shard_seq is None
+                else bool(shard_seq),
+            )
             # Managed in-loop checkpoints (train/checkpoint.py).  The
             # directory is always the managed one — raw paths were
             # rejected at the route.  resume defaults by request kind:

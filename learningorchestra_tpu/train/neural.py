@@ -83,19 +83,12 @@ def _apply_with_aux(module, p, xb):
     return logits.astype(jnp.float32), aux
 
 
-def build_device_epoch(
+def _device_epoch_raw(
     module, optimizer, loss_fn, dtype, *, n, batch_size, shuffle
 ):
-    """Jitted whole-epoch step over a DEVICE-RESIDENT dataset.
-
-    The dataset is uploaded once; each epoch is one jitted call that
-    permutes indices on device (``jax.random.permutation``), gathers
-    batches in HBM and scans the train step — host traffic per epoch is
-    one PRNG key and the metrics scalars, vs. the host-side reshuffle +
-    full re-upload per epoch of the generic path (the reference pays
-    keras' per-batch Python dispatch on top, train_function.py:84-87).
-    (params, opt_state) are donated so updates happen in place.
-    """
+    """Unjitted whole-epoch function over a device-resident dataset —
+    shared by the per-epoch runner (jitted directly) and the fused
+    multi-epoch runner (scanned)."""
     n_batches = max(1, -(-n // batch_size))
     pad = n_batches * batch_size - n
 
@@ -142,7 +135,62 @@ def build_device_epoch(
         )
         return params, opt_state, jax.tree_util.tree_map(jnp.mean, metrics)
 
+    return epoch
+
+
+def build_device_epoch(
+    module, optimizer, loss_fn, dtype, *, n, batch_size, shuffle
+):
+    """Jitted whole-epoch step over a DEVICE-RESIDENT dataset.
+
+    The dataset is uploaded once; each epoch is one jitted call that
+    permutes indices on device (``jax.random.permutation``), gathers
+    batches in HBM and scans the train step — host traffic per epoch is
+    one PRNG key and the metrics scalars, vs. the host-side reshuffle +
+    full re-upload per epoch of the generic path (the reference pays
+    keras' per-batch Python dispatch on top, train_function.py:84-87).
+    (params, opt_state) are donated so updates happen in place.
+    """
+    epoch = _device_epoch_raw(
+        module, optimizer, loss_fn, dtype,
+        n=n, batch_size=batch_size, shuffle=shuffle,
+    )
     return jax.jit(epoch, donate_argnums=(0, 1))
+
+
+def build_fused_epochs(
+    module, optimizer, loss_fn, dtype, *, n, batch_size, shuffle, epochs
+):
+    """ALL epochs in one jitted call: ``lax.scan`` over the device
+    epoch, per-epoch keys folded in on device, metrics stacked and read
+    back once at the end.
+
+    This exists for high-dispatch-latency links (the remote-TPU tunnel
+    pays ~10-100 ms per dispatch/readback): the per-epoch runner costs
+    one round-trip per epoch, which dominates sub-100 ms epochs and
+    corrupts throughput measurements; here K epochs cost exactly one.
+    No per-epoch host work is possible inside (checkpointing/verbose
+    callbacks need the per-epoch runner).
+    """
+    epoch_raw = _device_epoch_raw(
+        module, optimizer, loss_fn, dtype,
+        n=n, batch_size=batch_size, shuffle=shuffle,
+    )
+
+    def fused(params, opt_state, x, y, key):
+        def body(carry, e):
+            params, opt_state = carry
+            params, opt_state, metrics = epoch_raw(
+                params, opt_state, x, y, jax.random.fold_in(key, e)
+            )
+            return (params, opt_state), metrics
+
+        (params, opt_state), metrics = jax.lax.scan(
+            body, (params, opt_state), jnp.arange(epochs)
+        )
+        return params, opt_state, metrics  # metrics: (epochs,) per key
+
+    return jax.jit(fused, donate_argnums=(0, 1))
 
 
 def _cast_for(dtype):
