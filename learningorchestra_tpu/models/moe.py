@@ -46,6 +46,7 @@ class MoETransformerBlock(nn.Module):
     dtype: jnp.dtype = jnp.float32
     use_flash: bool | None = None
     causal: bool = False
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, key_mask=None):
@@ -56,6 +57,7 @@ class MoETransformerBlock(nn.Module):
             dtype=self.dtype,
             use_flash=self.use_flash,
             causal=self.causal,
+            decode=self.decode,
         )(y, key_mask=key_mask)
         x = x + y
         y = nn.LayerNorm(dtype=self.dtype)(x)
@@ -91,16 +93,18 @@ class _MoETransformer(nn.Module):
     capacity_factor: float = 1.5
     dtype: jnp.dtype = jnp.float32
     use_flash: bool | None = None
+    decode: bool = False
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, positions=None, key_mask=None):
         tokens = tokens.astype(jnp.int32)
         causal = self.head == "lm"
         x = embed_tokens(
             tokens, self.vocab_size, self.hidden_dim, self.max_len,
-            self.dtype,
+            self.dtype, positions=positions,
         )
-        pad_mask = tokens != 0
+        if key_mask is None:
+            key_mask = tokens != 0
         for i in range(self.num_layers):
             # MoE on the LAST block of each moe_every group so a
             # 1-layer net is still dense-first (router sees features).
@@ -115,8 +119,9 @@ class _MoETransformer(nn.Module):
                     dtype=self.dtype,
                     use_flash=self.use_flash,
                     causal=causal,
+                    decode=self.decode,
                     name=f"MoEBlock_{i}",
-                )(x, key_mask=pad_mask)
+                )(x, key_mask=key_mask)
             else:
                 x = TransformerBlock(
                     hidden_dim=self.hidden_dim,
@@ -125,8 +130,9 @@ class _MoETransformer(nn.Module):
                     dtype=self.dtype,
                     use_flash=self.use_flash,
                     causal=causal,
+                    decode=self.decode,
                     name=f"TransformerBlock_{i}",
-                )(x, key_mask=pad_mask)
+                )(x, key_mask=key_mask)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         if self.head == "lm":
             return nn.Dense(self.vocab_size, dtype=self.dtype)(x)

@@ -68,7 +68,8 @@ class LSTMClassifier(NeuralEstimator):
         )
 
 
-def embed_tokens(tokens, vocab_size, hidden_dim, max_len, dtype):
+def embed_tokens(tokens, vocab_size, hidden_dim, max_len, dtype,
+                 positions=None):
     """Token + learned positional embedding (pad id 0 convention).
 
     A helper, not a submodule: called inside a ``@nn.compact``
@@ -76,12 +77,14 @@ def embed_tokens(tokens, vocab_size, hidden_dim, max_len, dtype):
     scope (``Embed_0``/``Embed_1``), so every transformer family —
     BERT, decoder LM, MoE, pipelined — shares one embedding definition
     without perturbing existing parameter trees.
+
+    ``positions`` overrides the default ``arange`` positions — KV-cache
+    decoding feeds one token at a time at its true buffer position.
     """
-    seq = tokens.shape[-1]
+    if positions is None:
+        positions = jnp.arange(tokens.shape[-1])[None, :]
     tok = nn.Embed(vocab_size, hidden_dim, dtype=dtype)(tokens)
-    pos = nn.Embed(max_len, hidden_dim, dtype=dtype)(
-        jnp.arange(seq)[None, :]
-    )
+    pos = nn.Embed(max_len, hidden_dim, dtype=dtype)(positions)
     return tok + pos
 
 
@@ -104,6 +107,7 @@ class TransformerBlock(nn.Module):
     dtype: jnp.dtype = jnp.float32
     use_flash: bool | None = None  # None = auto by backend
     causal: bool = False  # decoder blocks mask future positions
+    decode: bool = False  # KV-cache autoregressive inference
 
     @nn.compact
     def __call__(self, x, key_mask=None):
@@ -114,6 +118,7 @@ class TransformerBlock(nn.Module):
             dtype=self.dtype,
             use_flash=self.use_flash,
             causal=self.causal,
+            decode=self.decode,
         )(y, key_mask=key_mask)
         x = x + y
         y = nn.LayerNorm(dtype=self.dtype)(x)
@@ -265,15 +270,17 @@ class _DecoderLM(nn.Module):
     dtype: jnp.dtype = jnp.float32
     use_flash: bool | None = None
     remat: bool = False
+    decode: bool = False
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, positions=None, key_mask=None):
         tokens = tokens.astype(jnp.int32)
         x = embed_tokens(
             tokens, self.vocab_size, self.hidden_dim, self.max_len,
-            self.dtype,
+            self.dtype, positions=positions,
         )
-        pad_mask = tokens != 0  # (B, T), pad id 0
+        if key_mask is None:
+            key_mask = tokens != 0  # (B, T), pad id 0
         block_cls = nn.remat(TransformerBlock) if self.remat \
             else TransformerBlock
         for i in range(self.num_layers):
@@ -284,39 +291,85 @@ class _DecoderLM(nn.Module):
                 dtype=self.dtype,
                 use_flash=self.use_flash,
                 causal=True,
+                decode=self.decode,
                 name=f"TransformerBlock_{i}",
-            )(x, key_mask=pad_mask)
+            )(x, key_mask=key_mask)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         return nn.Dense(self.vocab_size, dtype=self.dtype)(x)  # (B,T,V)
 
 
 class GreedyDecodeMixin:
     """Greedy autoregressive decoding for any estimator whose module
-    maps token ids (B, T) to per-token vocab logits (B, T, V)."""
+    maps token ids (B, T) to per-token vocab logits (B, T, V) and
+    supports ``decode=True`` KV caching."""
 
     def generate(self, prompts, max_new_tokens: int = 32):
         """Greedy continuation of int32 prompts (B, T0).
 
-        Decodes in a FIXED-shape buffer (right-padded with pad id 0, so
-        causal masking + the model's own pad key-mask make the padded
-        tail inert) — one XLA compile for the whole decode, instead of a
-        retrace per new sequence length."""
+        KV-cache decoding: the whole generation (prompt prefill +
+        continuation) is ONE jitted ``lax.scan`` over buffer positions
+        — each step embeds a single token at its true position, attends
+        against the per-layer K/V cache, and appends the argmax.  Cost
+        per new token is O(T·H) instead of the O(T²·H) full re-forward
+        of the naive loop, and the device round-trip count is 1, not T
+        (the remote-TPU tunnel pays ~10-100 ms per round trip)."""
         import jax
         import numpy as np
+        from jax import lax
 
         prompts = np.asarray(prompts, dtype=np.int32)
         bsz, t0 = prompts.shape
         total = min(self.max_len, t0 + max_new_tokens)
-        if self._apply_fn is None:
-            self._apply_fn = jax.jit(self.module.apply)
-        buf = np.zeros((bsz, total), np.int32)
-        buf[:, :t0] = prompts
-        for cur in range(t0, total):
-            logits = self._apply_fn(self.params, jnp.asarray(buf))
-            buf[:, cur] = np.asarray(
-                jnp.argmax(logits[:, cur - 1], axis=-1)
-            )
-        return buf
+        decode_mod = self.module.clone(decode=True)
+        # Cache shapes via eval_shape (no real forward, no throwaway
+        # params); the trained params drive the scan.
+        cache_shapes = jax.eval_shape(
+            decode_mod.init, jax.random.PRNGKey(0),
+            jnp.zeros((bsz, total), jnp.int32),
+        )["cache"]
+        cache0 = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
+        )
+
+        # One jitted scan per (bsz, total, t0) shape, cached across
+        # calls; params enter as an argument, not a baked-in constant.
+        fns = getattr(self, "_decode_fns", None)
+        if fns is None:
+            fns = self._decode_fns = {}
+        decode = fns.get((bsz, total, t0))
+        if decode is None:
+            def decode(variables, cache, buf):
+                def step(carry, i):
+                    cache, buf = carry
+                    tok = lax.dynamic_slice(buf, (0, i), (bsz, 1))
+                    pos = jnp.full((bsz, 1), i, jnp.int32)
+                    # Valid keys: non-pad tokens at positions already
+                    # fed to the cache (prompt tokens beyond i are in
+                    # the buffer but not yet cached).
+                    kmask = (jnp.arange(total)[None, :] <= i) \
+                        & (buf != 0)
+                    logits, mut = decode_mod.apply(
+                        {**variables, "cache": cache}, tok,
+                        positions=pos, key_mask=kmask,
+                        mutable=["cache"],
+                    )
+                    nxt = jnp.argmax(logits[:, 0], -1).astype(jnp.int32)
+                    prev = lax.dynamic_slice(buf, (0, i + 1), (bsz, 1))
+                    col = jnp.where(i + 1 >= t0, nxt[:, None], prev)
+                    buf = lax.dynamic_update_slice(buf, col, (0, i + 1))
+                    return (mut["cache"], buf), None
+
+                (cache, buf), _ = lax.scan(
+                    step, (cache, buf), jnp.arange(total - 1)
+                )
+                return buf
+
+            decode = fns[(bsz, total, t0)] = jax.jit(decode)
+
+        buf0 = jnp.zeros((bsz, total), jnp.int32).at[:, :t0].set(
+            jnp.asarray(prompts)
+        )
+        return np.asarray(decode(dict(self.params), cache0, buf0))
 
 
 @register(_MODULE)

@@ -37,6 +37,12 @@ class MultiHeadSelfAttention(nn.Module):
     dtype: jnp.dtype = jnp.float32
     use_flash: bool | None = None
     causal: bool = False
+    # Autoregressive inference: cache K/V per position in a 'cache'
+    # variable collection (apply with mutable=['cache']).  Initialize
+    # by running the module on a FULL-length input (flax convention:
+    # the uninitialized pass behaves as a normal forward and sizes the
+    # cache); then feed one position at a time.
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, key_mask=None):
@@ -52,6 +58,42 @@ class MultiHeadSelfAttention(nn.Module):
             return y.transpose(0, 2, 1, 3)  # (B, H, T, hd)
 
         q, k, v = proj("query"), proj("key"), proj("value")
+
+        if self.decode:
+            # Flax decode convention: the variables are declared once;
+            # an uninitialized pass (module.init / eval_shape on the
+            # FULL-length input) merely sizes them and falls through to
+            # the normal forward below.
+            is_initialized = self.has_variable("cache", "cached_key")
+            ck = self.variable("cache", "cached_key", jnp.zeros,
+                               k.shape, k.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               v.shape, v.dtype)
+            ci = self.variable(
+                "cache", "cache_index",
+                lambda: jnp.zeros((), jnp.int32),
+            )
+            if is_initialized:
+                idx = ci.value
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, k, (0, 0, idx, 0)
+                )
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, v, (0, 0, idx, 0)
+                )
+                ci.value = idx + t
+                # The caller's key_mask covers the whole buffer (False
+                # beyond the current position), so causality is already
+                # in the mask; flash brings nothing for T_q == 1
+                # queries.
+                out = mha_reference(q, ck.value, cv.value, key_mask)
+                out = out.transpose(0, 2, 1, 3).reshape(
+                    b, t, self.qkv_features
+                )
+                return nn.DenseGeneral(
+                    self.qkv_features, dtype=self.dtype, name="out"
+                )(out)
+
         use_flash = self.use_flash
         if use_flash is None:
             use_flash = jax.default_backend() == "tpu"

@@ -635,6 +635,7 @@ class NeuralEstimator(Estimator):
     def __getstate__(self):
         """dill support: drop jitted closures, keep module + host arrays."""
         d = dict(self.__dict__)
+        d.pop("_decode_fns", None)  # jitted decode scans (GreedyDecodeMixin)
         d["_step_fn"] = None
         d["_eval_fn"] = None
         d["_apply_fn"] = None
