@@ -320,24 +320,24 @@ class GreedyDecodeMixin:
         prompts = np.asarray(prompts, dtype=np.int32)
         bsz, t0 = prompts.shape
         total = min(self.max_len, t0 + max_new_tokens)
-        decode_mod = self.module.clone(decode=True)
-        # Cache shapes via eval_shape (no real forward, no throwaway
-        # params); the trained params drive the scan.
-        cache_shapes = jax.eval_shape(
-            decode_mod.init, jax.random.PRNGKey(0),
-            jnp.zeros((bsz, total), jnp.int32),
-        )["cache"]
-        cache0 = jax.tree_util.tree_map(
-            lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
-        )
 
-        # One jitted scan per (bsz, total, t0) shape, cached across
-        # calls; params enter as an argument, not a baked-in constant.
+        # One (jitted scan, cache shapes) pair per prompt shape, cached
+        # across calls; params enter as an argument, not a baked-in
+        # constant, and the model-wide eval_shape trace runs once per
+        # shape, not per call.
         fns = getattr(self, "_decode_fns", None)
         if fns is None:
             fns = self._decode_fns = {}
-        decode = fns.get((bsz, total, t0))
-        if decode is None:
+        entry = fns.get((bsz, total, t0))
+        if entry is None:
+            decode_mod = self.module.clone(decode=True)
+            # Cache shapes via eval_shape (no real forward, no
+            # throwaway params); the trained params drive the scan.
+            cache_shapes = jax.eval_shape(
+                decode_mod.init, jax.random.PRNGKey(0),
+                jnp.zeros((bsz, total), jnp.int32),
+            )["cache"]
+
             def decode(variables, cache, buf):
                 def step(carry, i):
                     cache, buf = carry
@@ -364,8 +364,14 @@ class GreedyDecodeMixin:
                 )
                 return buf
 
-            decode = fns[(bsz, total, t0)] = jax.jit(decode)
+            entry = fns[(bsz, total, t0)] = (
+                jax.jit(decode), cache_shapes
+            )
 
+        decode, cache_shapes = entry
+        cache0 = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
+        )
         buf0 = jnp.zeros((bsz, total), jnp.int32).at[:, :t0].set(
             jnp.asarray(prompts)
         )

@@ -74,6 +74,16 @@ class MultiHeadSelfAttention(nn.Module):
                 lambda: jnp.zeros((), jnp.int32),
             )
             if is_initialized:
+                if t != 1:
+                    # Multi-token chunks would need an intra-chunk
+                    # causal mask (the per-batch key_mask has no
+                    # per-query component) — without one, position 0 of
+                    # the chunk would attend to positions 1..t-1.
+                    raise ValueError(
+                        "decode mode feeds ONE position per step; got "
+                        f"a {t}-token chunk (prefill runs through the "
+                        "scan one token at a time)"
+                    )
                 idx = ci.value
                 ck.value = jax.lax.dynamic_update_slice(
                     ck.value, k, (0, 0, idx, 0)

@@ -1,0 +1,120 @@
+"""On-chip validation of round-2 additions — run when the TPU tunnel is up.
+
+Covers: ring-flash attention (compile + correctness + timing vs the jnp
+ring on ONE chip via a single-device sp=1... not meaningful -> skipped;
+ring needs multi-chip), causal flash timing (looped), MoE + pipeline
+models training a step on the chip, and the fused-epoch bench runner.
+
+All timing uses the looped methodology (TPU_EVIDENCE.md): N iterations
+inside one jitted fori_loop, one scalar readback.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+assert jax.devices()[0].platform == "tpu", jax.devices()
+print("device:", jax.devices()[0], flush=True)
+
+
+def onchip_time(fn, args, est_ms, budget_ms=1500):
+    iters = max(4, int(budget_ms / max(est_ms, 0.01)))
+
+    @jax.jit
+    def looped(*a):
+        def body(i, acc):
+            o = fn(*a)
+            if isinstance(o, tuple):
+                o = o[0]
+            return acc + jnp.sum(o.reshape(-1)[:1].astype(jnp.float32))
+        return lax.fori_loop(0, iters, body, 0.0)
+
+    float(looped(*args))
+    t0 = time.perf_counter()
+    float(looped(*args))
+    return (time.perf_counter() - t0) / iters
+
+
+# -- 1. MoE transformer train step on chip ---------------------------------
+from learningorchestra_tpu.models.moe import MoETransformerClassifier  # noqa: E402
+
+rng = np.random.default_rng(0)
+x = rng.integers(1, 1000, (64, 128), dtype=np.int32)
+y = rng.integers(0, 2, (64,), dtype=np.int32)
+est = MoETransformerClassifier(
+    vocab_size=1000, hidden_dim=256, num_layers=4, num_heads=8,
+    max_len=128, num_experts=8, mlp_dim=1024,
+)
+t0 = time.perf_counter()
+est.fit(x, y, epochs=3, batch_size=32, verbose=0)
+print(f"MoE train 3 epochs ok, loss={est.history['loss'][-1]:.4f} "
+      f"({time.perf_counter()-t0:.1f}s incl compile)", flush=True)
+
+# -- 2. KV-cache generate on chip ------------------------------------------
+from learningorchestra_tpu.models.text import DecoderLM  # noqa: E402
+
+lm = DecoderLM(vocab_size=1000, hidden_dim=256, num_layers=4,
+               num_heads=8, max_len=256)
+xs = rng.integers(1, 1000, (16, 64), dtype=np.int32)
+tg = np.concatenate([xs[:, 1:], np.zeros((16, 1), np.int32)], 1)
+lm.fit(xs, tg, epochs=1, batch_size=16, verbose=0)
+t0 = time.perf_counter()
+out = lm.generate(xs[:4, :32], max_new_tokens=96)  # compile + run
+t1 = time.perf_counter()
+out = lm.generate(xs[:4, :32], max_new_tokens=96)  # cached fn
+t2 = time.perf_counter()
+assert out.shape == (4, 128)
+print(f"KV-cache generate 96 tok ok: first {t1-t0:.1f}s (compile), "
+      f"second {t2-t1:.2f}s -> {(t2-t1)/96*1e3:.1f} ms/token incl tunnel",
+      flush=True)
+
+# -- 3. causal flash timing (fills the causal table) -----------------------
+from learningorchestra_tpu.ops.attention import flash_attention  # noqa: E402
+
+for (b, h, t, d, est_ms) in [(1, 8, 4096, 64, 0.4), (1, 2, 32768, 64, 3)]:
+    q = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.bfloat16)
+    g = jax.grad(lambda q, k, v: jnp.sum(
+        flash_attention(q, k, v, causal=True, interpret=False
+                        ).astype(jnp.float32)), argnums=(0, 1, 2))
+    tb = onchip_time(lambda q, k, v: g(q, k, v)[0], (q, k, v), est_ms * 3)
+    fl = 4 * b * h * t * t * d
+    print(f"causal bwd B{b} H{h} T{t} D{d}: {tb*1e3:.2f} ms "
+          f"({2.5*fl/2/tb/1e12:.0f} TF/s causal-effective)", flush=True)
+
+# -- 3b. ring-flash on the chip (sp=1 degenerate ring: proves the
+# shard_map + Pallas composition compiles and matches on real hardware;
+# the multi-chip ring itself is validated on the virtual mesh) ---------
+from learningorchestra_tpu.parallel.mesh import MeshSpec, build_mesh  # noqa: E402
+from learningorchestra_tpu.parallel.ring_attention import (  # noqa: E402
+    reference_attention,
+    ring_flash_attention,
+)
+
+mesh1 = build_mesh(MeshSpec(dp=1, sp=1))
+b, t, h, d = 2, 2048, 4, 64
+q = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.bfloat16)
+k = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.bfloat16)
+v = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.bfloat16)
+km = jnp.asarray(rng.random((b, t)) > 0.1)
+o = ring_flash_attention(q, k, v, mesh=mesh1, kmask=km, causal=True)
+ref = reference_attention(
+    q.astype(jnp.float32), k.astype(jnp.float32),
+    v.astype(jnp.float32), kmask=km, causal=True,
+)
+err = float(jnp.max(jnp.abs(o.astype(jnp.float32) - ref)))
+print(f"ring-flash (sp=1) on chip: max err {err:.4f}", flush=True)
+assert err < 0.05, err
+
+# -- 4. fused-epoch bench runner -------------------------------------------
+import subprocess, sys, os  # noqa: E402
+r = subprocess.run([sys.executable, os.path.join(
+    os.path.dirname(__file__), "..", "bench.py")],
+    capture_output=True, text=True, timeout=900)
+print("bench.py:", r.stdout.strip().splitlines()[-1] if r.stdout else r.stderr[-500:],
+      flush=True)
+print("ALL ON-CHIP CHECKS DONE", flush=True)

@@ -1,0 +1,21 @@
+"""Shared oracle for KV-cache decode tests: the naive greedy loop that
+re-runs the FULL forward per generated token."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def naive_greedy_decode(est, prompts, total):
+    """Greedy continuation by full re-forward — the reference the
+    cached scan in GreedyDecodeMixin.generate must match exactly."""
+    prompts = np.asarray(prompts, np.int32)
+    bsz, t0 = prompts.shape
+    buf = np.zeros((bsz, total), np.int32)
+    buf[:, :t0] = prompts
+    apply = jax.jit(est.module.apply)
+    for cur in range(t0, total):
+        logits = apply(est.params, jnp.asarray(buf))
+        buf[:, cur] = np.asarray(jnp.argmax(logits[:, cur - 1], -1))
+    return buf
