@@ -33,7 +33,7 @@ class _LongBlock(nn.Module):
     mlp_dim: int
     mesh: Mesh | None
     causal: bool
-    dtype: jnp.dtype = jnp.float32
+    dtype: jnp.dtype | None = None  # None = promote (bf16 when the train step casts params)
 
     @nn.compact
     def __call__(self, x, kmask=None):

@@ -43,7 +43,7 @@ class MoETransformerBlock(nn.Module):
     num_experts: int
     top_k: int = 2
     capacity_factor: float = 1.5
-    dtype: jnp.dtype = jnp.float32
+    dtype: jnp.dtype | None = None  # None = promote (bf16 when the train step casts params)
     use_flash: bool | None = None
     causal: bool = False
     decode: bool = False
@@ -91,7 +91,7 @@ class _MoETransformer(nn.Module):
     moe_every: int = 2
     top_k: int = 2
     capacity_factor: float = 1.5
-    dtype: jnp.dtype = jnp.float32
+    dtype: jnp.dtype | None = None  # None = promote (bf16 when the train step casts params)
     use_flash: bool | None = None
     decode: bool = False
 

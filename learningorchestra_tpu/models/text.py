@@ -65,6 +65,10 @@ class LSTMClassifier(NeuralEstimator):
             loss="softmax_ce",
             learning_rate=learning_rate,
             seed=seed,
+            # The LSTM recurrence accumulates across T steps; bf16
+            # cell-state drift is the classic failure mode, so this
+            # family opts out of the zoo-wide mixed precision.
+            compute_dtype="float32",
         )
 
 
@@ -104,7 +108,7 @@ class TransformerBlock(nn.Module):
     hidden_dim: int
     num_heads: int
     mlp_dim: int
-    dtype: jnp.dtype = jnp.float32
+    dtype: jnp.dtype | None = None  # None = promote (bf16 when the train step casts params)
     use_flash: bool | None = None  # None = auto by backend
     causal: bool = False  # decoder blocks mask future positions
     decode: bool = False  # KV-cache autoregressive inference
@@ -137,7 +141,7 @@ class BertEncoder(nn.Module):
     num_heads: int = 12
     mlp_dim: int = 3072
     max_len: int = 512
-    dtype: jnp.dtype = jnp.float32
+    dtype: jnp.dtype | None = None  # None = promote (bf16 when the train step casts params)
     use_flash: bool | None = None
     # jax.checkpoint each block: activations rematerialize in the
     # backward pass — trades ~1 extra forward of FLOPs for O(layers)
@@ -267,7 +271,7 @@ class _DecoderLM(nn.Module):
     num_heads: int
     mlp_dim: int
     max_len: int
-    dtype: jnp.dtype = jnp.float32
+    dtype: jnp.dtype | None = None  # None = promote (bf16 when the train step casts params)
     use_flash: bool | None = None
     remat: bool = False
     decode: bool = False

@@ -34,7 +34,7 @@ class MultiHeadSelfAttention(nn.Module):
 
     num_heads: int
     qkv_features: int
-    dtype: jnp.dtype = jnp.float32
+    dtype: jnp.dtype | None = None  # None = promote (bf16 when the train step casts params)
     use_flash: bool | None = None
     causal: bool = False
     # Autoregressive inference: cache K/V per position in a 'cache'

@@ -51,7 +51,7 @@ class MoEMlp(nn.Module):
     capacity_factor: float = 1.5
     aux_loss_weight: float = 1e-2
     router_z_weight: float = 1e-3
-    dtype: jnp.dtype = jnp.float32
+    dtype: jnp.dtype | None = None  # None = promote (bf16 when the train step casts params)
 
     @nn.compact
     def __call__(self, x):
@@ -124,7 +124,7 @@ class MoEMlp(nn.Module):
         )
         b2 = self.param("expert_b2", nn.initializers.zeros, (e, h))
 
-        dt = self.dtype
+        dt = self.dtype if self.dtype is not None else x.dtype
         xe = jnp.einsum(
             "btec,bth->ebch", dispatch.astype(dt), x.astype(dt)
         )  # (E, B, C, H)

@@ -57,7 +57,7 @@ class _Embed(nn.Module):
     vocab_size: int
     hidden_dim: int
     max_len: int
-    dtype: Any = jnp.float32
+    dtype: Any = None  # None = promote (bf16 when the step casts params)
 
     @nn.compact
     def __call__(self, tokens):
@@ -79,7 +79,7 @@ class _Stage(nn.Module):
     mlp_dim: int
     layers_per_stage: int
     causal: bool
-    dtype: Any = jnp.float32
+    dtype: Any = None  # None = promote (bf16 when the step casts params)
 
     @nn.compact
     def __call__(self, x, key_mask):
@@ -225,6 +225,7 @@ class PipelinedTransformer:
         seed: int = 0,
         mesh: Mesh | None = None,
         pp: int | None = None,
+        compute_dtype: str = "bfloat16",
     ):
         self.vocab_size = vocab_size
         self.hidden_dim = hidden_dim
@@ -236,6 +237,7 @@ class PipelinedTransformer:
         self.head = head
         self.learning_rate = learning_rate
         self.seed = seed
+        self.compute_dtype = compute_dtype
         if mesh is None:
             n = jax.device_count()
             if pp is not None:
@@ -328,9 +330,17 @@ class PipelinedTransformer:
             out_specs=(P(), P()),
         )
 
+        from learningorchestra_tpu.train.neural import _param_cast_for
+
+        _pcast = _param_cast_for(
+            jnp.bfloat16 if self.compute_dtype == "bfloat16" else None
+        )
+
         def step(params, opt_state, xb, yb, mb):
             def objective(ps):
-                loss, metrics = smapped(*ps, xb, yb, mb)
+                # Mixed precision: bf16 compute copy, f32 master
+                # weights in the optimizer (train/neural.py contract).
+                loss, metrics = smapped(*_pcast(ps), xb, yb, mb)
                 return loss, metrics
 
             grads, metrics = jax.grad(objective, has_aux=True)(params)

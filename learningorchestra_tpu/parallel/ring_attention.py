@@ -453,7 +453,7 @@ class RingSelfAttention(nn.Module):
     num_heads: int
     mesh: Mesh | None = None
     causal: bool = False
-    dtype: jnp.dtype = jnp.float32
+    dtype: jnp.dtype | None = None  # None = promote (bf16 when the train step casts params)
     # None = auto: the Pallas ring-flash path on TPU (O(block) memory,
     # bf16 MXU matmuls), the jnp ring elsewhere.
     use_flash: bool | None = None
@@ -475,8 +475,11 @@ class RingSelfAttention(nn.Module):
                 q, k, v, mesh=self.mesh, kmask=kmask, causal=self.causal
             )
         else:
+            # reference_attention already returns q.dtype — no cast
+            # (astype(None) would force f32 and pin the whole residual
+            # stream there, defeating mixed precision).
             o = reference_attention(
                 q, k, v, kmask=kmask, causal=self.causal
-            ).astype(self.dtype)
+            )
         o = o.reshape(b, t, hidden)
         return nn.Dense(hidden, dtype=self.dtype, name="out")(o)

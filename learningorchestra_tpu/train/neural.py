@@ -83,6 +83,38 @@ def _apply_with_aux(module, p, xb):
     return logits.astype(jnp.float32), aux
 
 
+def _param_cast_for(dtype):
+    """Mixed precision, the TPU-standard way: the OPTIMIZER holds f32
+    master weights; the forward/backward run on a low-precision COPY of
+    the params cast inside the objective (so the cast is part of the
+    differentiated graph and grads come back f32).
+
+    Casting inputs alone is not enough: flax modules with
+    ``dtype=None`` promote inputs against their f32 params, which
+    silently pins every matmul to f32 — half MXU rate.  The MoE router
+    is exempted below (full-precision weights); it also declares an
+    explicit f32 compute dtype in ops/moe.py.
+    """
+    if dtype is None:
+        return lambda p: p
+
+    def _leaf(path, l):
+        # The MoE router must see full-precision WEIGHTS, not just f32
+        # compute (ops/moe.py design note: bf16-rounded router kernels
+        # flip near-tied top-k choices).
+        name = "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+        ).lower()
+        if "router" in name:
+            return l
+        return l.astype(dtype) if l.dtype == jnp.float32 else l
+
+    def cast(p):
+        return jax.tree_util.tree_map_with_path(_leaf, p)
+
+    return cast
+
+
 def _device_epoch_raw(
     module, optimizer, loss_fn, dtype, *, n, batch_size, shuffle
 ):
@@ -91,13 +123,8 @@ def _device_epoch_raw(
     multi-epoch runner (scanned)."""
     n_batches = max(1, -(-n // batch_size))
     pad = n_batches * batch_size - n
-
-    def _cast(xb):
-        return (
-            xb.astype(dtype)
-            if dtype and jnp.issubdtype(xb.dtype, jnp.floating)
-            else xb
-        )
+    _pcast = _param_cast_for(dtype)
+    _cast = _cast_for(dtype)
 
     def epoch(params, opt_state, x, y, key):
         order = (
@@ -121,7 +148,9 @@ def _device_epoch_raw(
             bx, by, bm = batch
 
             def objective(p):
-                logits, aux = _apply_with_aux(module, p, _cast(bx))
+                logits, aux = _apply_with_aux(
+                    module, _pcast(p), _cast(bx)
+                )
                 loss, metrics = loss_fn(logits, by, bm)
                 return loss + aux, metrics
 
@@ -204,10 +233,10 @@ def _cast_for(dtype):
     return _cast
 
 
-def _make_step(module, optimizer, loss_fn, _cast):
+def _make_step(module, optimizer, loss_fn, _cast, _pcast):
     def step(params, opt_state, xb, yb, mb):
         def objective(p):
-            logits, aux = _apply_with_aux(module, p, _cast(xb))
+            logits, aux = _apply_with_aux(module, _pcast(p), _cast(xb))
             loss, metrics = loss_fn(logits, yb, mb)
             return loss + aux, metrics
 
@@ -227,7 +256,8 @@ def build_epoch_fns(module, optimizer, loss_fn, dtype, *, donate=False):
     happen in place in HBM (the distributed path's steady state).
     """
     _cast = _cast_for(dtype)
-    step = _make_step(module, optimizer, loss_fn, _cast)
+    _pcast = _param_cast_for(dtype)
+    step = _make_step(module, optimizer, loss_fn, _cast, _pcast)
 
     def epoch(params, opt_state, xs, ys, ms):
         def body(carry, batch):
@@ -241,6 +271,8 @@ def build_epoch_fns(module, optimizer, loss_fn, dtype, *, donate=False):
         return params, opt_state, jax.tree_util.tree_map(jnp.mean, metrics)
 
     def evaluate(params, xs, ys, ms):
+        params = _pcast(params)  # same numerics (and MXU rate) as train
+
         def body(_, batch):
             xb, yb, mb = batch
             logits = module.apply(params, _cast(xb)).astype(jnp.float32)
@@ -272,7 +304,8 @@ def build_resident_epoch_fns(
     batch-sharded array would all-gather the dataset every epoch).
     """
     _cast = _cast_for(dtype)
-    step = _make_step(module, optimizer, loss_fn, _cast)
+    _pcast = _param_cast_for(dtype)
+    step = _make_step(module, optimizer, loss_fn, _cast, _pcast)
 
     def epoch(params, opt_state, xs, ys, ms, key):
         nb = xs.shape[0]
@@ -297,6 +330,8 @@ def build_resident_epoch_fns(
         return params, opt_state, jax.tree_util.tree_map(jnp.mean, metrics)
 
     def evaluate(params, xs, ys, ms):
+        params = _pcast(params)  # same numerics (and MXU rate) as train
+
         def body(_, batch):
             xb, yb, mb = batch
             logits = module.apply(params, _cast(xb)).astype(jnp.float32)
