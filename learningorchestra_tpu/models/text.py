@@ -111,6 +111,7 @@ class TransformerBlock(nn.Module):
     dtype: jnp.dtype | None = None  # None = promote (bf16 when the train step casts params)
     use_flash: bool | None = None  # None = auto by backend
     causal: bool = False  # decoder blocks mask future positions
+    window: int | None = None  # sliding-window attention (causal only)
     decode: bool = False  # KV-cache autoregressive inference
 
     @nn.compact
@@ -122,6 +123,7 @@ class TransformerBlock(nn.Module):
             dtype=self.dtype,
             use_flash=self.use_flash,
             causal=self.causal,
+            window=self.window,
             decode=self.decode,
         )(y, key_mask=key_mask)
         x = x + y
@@ -275,6 +277,7 @@ class _DecoderLM(nn.Module):
     use_flash: bool | None = None
     remat: bool = False
     decode: bool = False
+    window: int | None = None  # sliding-window attention
 
     @nn.compact
     def __call__(self, tokens, positions=None, key_mask=None):
@@ -295,6 +298,7 @@ class _DecoderLM(nn.Module):
                 dtype=self.dtype,
                 use_flash=self.use_flash,
                 causal=True,
+                window=self.window,
                 decode=self.decode,
                 name=f"TransformerBlock_{i}",
             )(x, key_mask=key_mask)
@@ -349,7 +353,9 @@ class GreedyDecodeMixin:
                     pos = jnp.full((bsz, 1), i, jnp.int32)
                     # Valid keys: non-pad tokens at positions already
                     # fed to the cache (prompt tokens beyond i are in
-                    # the buffer but not yet cached).
+                    # the buffer but not yet cached).  Sliding-window
+                    # models narrow this further inside the attention
+                    # layer itself (ops/layers.py decode branch).
                     kmask = (jnp.arange(total)[None, :] <= i) \
                         & (buf != 0)
                     logits, mut = decode_mod.apply(
@@ -405,6 +411,7 @@ class DecoderLM(GreedyDecodeMixin, NeuralEstimator):
         learning_rate: float = 3e-4,
         seed: int = 0,
         remat: bool = False,
+        attention_window: int | None = None,
     ):
         self.vocab_size = vocab_size
         self.hidden_dim = hidden_dim
@@ -413,6 +420,7 @@ class DecoderLM(GreedyDecodeMixin, NeuralEstimator):
         self.mlp_dim = mlp_dim or hidden_dim * 4
         self.max_len = max_len
         self.remat = remat
+        self.attention_window = attention_window
         super().__init__(
             _DecoderLM(
                 vocab_size=vocab_size,
@@ -422,6 +430,7 @@ class DecoderLM(GreedyDecodeMixin, NeuralEstimator):
                 mlp_dim=self.mlp_dim,
                 max_len=max_len,
                 remat=remat,
+                window=attention_window,
             ),
             loss="softmax_ce",
             learning_rate=learning_rate,

@@ -51,12 +51,22 @@ def _auto_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _validate_window(window, causal) -> None:
+    if window is None:
+        return
+    if not causal:
+        raise ValueError("window requires causal=True")
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+
+
 # ---------------------------------------------------------------------------
 # Reference implementation (jnp) — ground truth for tests and CPU fallback.
 # ---------------------------------------------------------------------------
 
 
-def mha_reference(q, k, v, key_mask=None, causal: bool = False):
+def mha_reference(q, k, v, key_mask=None, causal: bool = False,
+                  window: int | None = None):
     """Plain multi-head attention. q,k,v: (B, H, T, D); key_mask: (B, Tk).
 
     Fully-masked rows output exactly 0 with exactly-0 gradients.  The
@@ -64,8 +74,10 @@ def mha_reference(q, k, v, key_mask=None, causal: bool = False):
     live value on either the forward or backward path (a single ``where``
     after ``exp`` leaves NaN-producing -1e30 arithmetic on the grad path).
     ``causal=True`` additionally masks keys beyond each query's position
-    (decoder self-attention; Tq must equal Tk).
+    (decoder self-attention; Tq must equal Tk); ``window`` restricts each
+    query to its last ``window`` positions (sliding-window attention).
     """
+    _validate_window(window, causal)
     scale = 1.0 / (q.shape[-1] ** 0.5)
     s = jnp.einsum(
         "bhqd,bhkd->bhqk",
@@ -77,10 +89,14 @@ def mha_reference(q, k, v, key_mask=None, causal: bool = False):
     if key_mask is not None:
         maskb = key_mask.astype(bool)[:, None, None, :]
     if causal:
-        tri = (
-            jnp.arange(tk)[None, :] <= jnp.arange(tq)[:, None]
-        )[None, None]
-        maskb = tri if maskb is None else (maskb & tri)
+        rows = jnp.arange(tq)[:, None]
+        cols = jnp.arange(tk)[None, :]
+        tri = cols <= rows
+        if window is not None:
+            tri = tri & (cols > rows - window)
+        maskb = tri[None, None] if maskb is None else (
+            maskb & tri[None, None]
+        )
     if maskb is None:
         p = jax.nn.softmax(s, axis=-1)
     else:
@@ -101,17 +117,48 @@ def mha_reference(q, k, v, key_mask=None, causal: bool = False):
 # ---------------------------------------------------------------------------
 
 
-def _causal_keep(i, j, bq, bk):
+def _causal_keep(i, j, bq, bk, window=None):
     """(bq, bk) multiplicative mask for the causal region of block
-    (i, j): 1.0 where global col <= global row."""
+    (i, j): 1.0 where global col <= global row — and, with a sliding
+    ``window``, col > row - window (each query sees its last ``window``
+    positions only, Mistral-style banded attention)."""
     rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return (cols <= rows).astype(jnp.float32)
+    keep = cols <= rows
+    if window is not None:
+        keep = keep & (cols > rows - window)
+    return keep.astype(jnp.float32)
+
+
+def _block_live(i, j, bq, bk, causal, window):
+    """Predicate builder: should block (i, j) compute at all?  Causal
+    kills blocks fully above the diagonal; a window additionally kills
+    blocks fully left of the band."""
+    live = True
+    if causal:
+        live = j * bk < (i + 1) * bq
+    if window is not None:
+        live = live & ((j + 1) * bk + window - 1 > i * bq)
+    return live
+
+
+def _win_lo(i, bq, bk, window):
+    """First k-block that can intersect q-block ``i``'s band."""
+    return jnp.maximum(0, (i * bq - (window - 1)) // bk)
+
+
+def _win_k_slots(bq, bk, window, nk):
+    """Grid length of the streamed k axis under a window: the band of
+    one q block spans bq + window - 1 columns -> a CONSTANT number of
+    k blocks, so HBM traffic is O(T·window), not O(T²).  (Without
+    this, pl.when would skip the MXU work but the BlockSpec pipeline
+    would still DMA every K/V block.)"""
+    return min(nk, (bq + window - 1 + bk - 1) // bk + 1)
 
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-    *, scale, causal,
+    *, scale, causal, window,
 ):
     """One (q-block, k-block) grid step.  The k axis is the innermost,
     sequential grid dimension: the online-softmax running state lives in
@@ -120,16 +167,18 @@ def _fwd_kernel(
     overlaps the next block's DMA with this block's MXU work.  Causal
     blocks fully above the diagonal skip their compute entirely."""
     i = pl.program_id(2)
-    j = pl.program_id(3)
+    jj = pl.program_id(3)
     nk = pl.num_programs(3)
+    bq, bk = q_ref.shape[2], k_ref.shape[2]
+    # Windowed grids stream only the band's k blocks; jj is an offset
+    # from the band's first block, not an absolute block index.
+    j = jj if window is None else _win_lo(i, bq, bk, window) + jj
 
-    @pl.when(j == 0)
+    @pl.when(jj == 0)
     def _init():
         m_scr[...] = jnp.full_like(m_scr, _NEG_BIG)
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
-
-    bq, bk = q_ref.shape[2], k_ref.shape[2]
 
     def _compute():
         # Matmul inputs stay in their storage dtype (bf16 on the
@@ -141,7 +190,7 @@ def _fwd_kernel(
         vb = v_ref[0, 0]
         keep = km_ref[0]  # (1, bk) float32, 1=keep
         if causal:
-            keep = keep * _causal_keep(i, j, bq, bk)  # (bq, bk)
+            keep = keep * _causal_keep(i, j, bq, bk, window)  # (bq, bk)
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -161,11 +210,11 @@ def _fwd_kernel(
         )
 
     if causal:
-        pl.when(j * bk < (i + 1) * bq)(_compute)
+        pl.when(_block_live(i, j, bq, bk, causal, window))(_compute)
     else:
         _compute()
 
-    @pl.when(j == nk - 1)
+    @pl.when(jj == nk - 1)
     def _finalize():
         l = l_scr[...]
         nonempty = l > 0.0
@@ -187,19 +236,19 @@ def _fwd_kernel(
 
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    dq_scr, *, scale, causal,
+    dq_scr, *, scale, causal, window,
 ):
     """dQ pass: grid (b, h, nq, nk) — same streamed K/V layout as the
     forward; dq accumulates in VMEM scratch across the sequential k axis."""
     i = pl.program_id(2)
-    j = pl.program_id(3)
+    jj = pl.program_id(3)
     nk = pl.num_programs(3)
+    bq, bk = q_ref.shape[2], k_ref.shape[2]
+    j = jj if window is None else _win_lo(i, bq, bk, window) + jj
 
-    @pl.when(j == 0)
+    @pl.when(jj == 0)
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
-
-    bq, bk = q_ref.shape[2], k_ref.shape[2]
 
     def _compute():
         q = q_ref[0, 0]
@@ -210,7 +259,7 @@ def _bwd_dq_kernel(
         vb = v_ref[0, 0]
         keep = km_ref[0]
         if causal:
-            keep = keep * _causal_keep(i, j, bq, bk)
+            keep = keep * _causal_keep(i, j, bq, bk, window)
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -228,38 +277,39 @@ def _bwd_dq_kernel(
         )
 
     if causal:
-        pl.when(j * bk < (i + 1) * bq)(_compute)
+        pl.when(_block_live(i, j, bq, bk, causal, window))(_compute)
     else:
         _compute()
 
-    @pl.when(j == nk - 1)
+    @pl.when(jj == nk - 1)
     def _finalize():
         dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, delta_ref,
-    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, window, nq_total,
 ):
     """dK/dV pass: grid (b, h, nk, nq) — one K/V block is resident while
     Q/dO/lse/delta blocks stream along the sequential inner q axis."""
     j = pl.program_id(2)
-    i = pl.program_id(3)
+    ii = pl.program_id(3)
     nq = pl.num_programs(3)
+    bq, bk = q_ref.shape[2], k_ref.shape[2]
+    # Windowed grids stream only the band's q blocks for this k block.
+    i = ii if window is None else (j * bk) // bq + ii
 
-    @pl.when(i == 0)
+    @pl.when(ii == 0)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
-
-    bq, bk = q_ref.shape[2], k_ref.shape[2]
 
     def _compute():
         kb = k_ref[0, 0]  # (bk, D)
         vb = v_ref[0, 0]
         keep = km_ref[0]  # (1, bk)
         if causal:
-            keep = keep * _causal_keep(i, j, bq, bk)
+            keep = keep * _causal_keep(i, j, bq, bk, window)
         q = q_ref[0, 0]  # (bq, D)
         do = do_ref[0, 0]
         lse = lse_ref[0, 0]  # (bq, 1)
@@ -284,12 +334,15 @@ def _bwd_dkv_kernel(
             preferred_element_type=jnp.float32,
         )
 
+    live = _block_live(i, j, bq, bk, causal, window)
+    if window is not None:
+        live = live & (i < nq_total)
     if causal:
-        pl.when(j * bk < (i + 1) * bq)(_compute)
+        pl.when(live)(_compute)
     else:
         _compute()
 
-    @pl.when(i == nq - 1)
+    @pl.when(ii == nq - 1)
     def _finalize():
         dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
@@ -300,26 +353,49 @@ def _bwd_dkv_kernel(
 # ---------------------------------------------------------------------------
 
 
-def _fwd_call(q, k, v, km, block_q, block_k, interpret, causal):
+def _k_index_maps(block_q, block_k, window, nk):
+    """(4-D K/V map, 3-D mask map) for the streamed k axis.  Windowed
+    grids translate the per-band offset jj to an absolute block index,
+    clipped into range — the clipped duplicates at the edges are DMA'd
+    but skipped by the kernel's live predicate."""
+    if window is None:
+        return (lambda bb, hh, i, j: (bb, hh, j, 0)), (
+            lambda bb, hh, i, j: (bb, 0, j))
+
+    def kv(bb, hh, i, jj):
+        j = _win_lo(i, block_q, block_k, window) + jj
+        return (bb, hh, jnp.clip(j, 0, nk - 1), 0)
+
+    def mask(bb, hh, i, jj):
+        j = _win_lo(i, block_q, block_k, window) + jj
+        return (bb, 0, jnp.clip(j, 0, nk - 1))
+
+    return kv, mask
+
+
+def _fwd_call(q, k, v, km, block_q, block_k, interpret, causal,
+              window=None):
     b, h, tq, d = q.shape
     tk = k.shape[2]
     nq, nk = tq // block_q, tk // block_k
+    nk_grid = nk if window is None else _win_k_slots(
+        block_q, block_k, window, nk
+    )
+    kv_map, mask_map = _k_index_maps(block_q, block_k, window, nk)
     scale = 1.0 / (d ** 0.5)
-    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, window=window
+    )
     return pl.pallas_call(
         kernel,
-        grid=(b, h, nq, nk),
+        grid=(b, h, nq, nk_grid),
         in_specs=[
             pl.BlockSpec(
                 (1, 1, block_q, d), lambda bb, hh, i, j: (bb, hh, i, 0)
             ),
-            pl.BlockSpec(
-                (1, 1, block_k, d), lambda bb, hh, i, j: (bb, hh, j, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, block_k, d), lambda bb, hh, i, j: (bb, hh, j, 0)
-            ),
-            pl.BlockSpec((1, 1, block_k), lambda bb, hh, i, j: (bb, 0, j)),
+            pl.BlockSpec((1, 1, block_k, d), kv_map),
+            pl.BlockSpec((1, 1, block_k, d), kv_map),
+            pl.BlockSpec((1, 1, block_k), mask_map),
         ],
         out_specs=[
             pl.BlockSpec(
@@ -344,26 +420,28 @@ def _fwd_call(q, k, v, km, block_q, block_k, interpret, causal):
 
 
 def _bwd_call(q, k, v, km, do, lse, delta, block_q, block_k, interpret,
-              causal):
+              causal, window=None):
     b, h, tq, d = q.shape
     tk = k.shape[2]
     nq, nk = tq // block_q, tk // block_k
+    nk_grid = nk if window is None else _win_k_slots(
+        block_q, block_k, window, nk
+    )
+    kv_map, mask_map = _k_index_maps(block_q, block_k, window, nk)
     scale = 1.0 / (d ** 0.5)
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal),
-        grid=(b, h, nq, nk),
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal, window=window
+        ),
+        grid=(b, h, nq, nk_grid),
         in_specs=[
             pl.BlockSpec(
                 (1, 1, block_q, d), lambda bb, hh, i, j: (bb, hh, i, 0)
             ),
-            pl.BlockSpec(
-                (1, 1, block_k, d), lambda bb, hh, i, j: (bb, hh, j, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, block_k, d), lambda bb, hh, i, j: (bb, hh, j, 0)
-            ),
-            pl.BlockSpec((1, 1, block_k), lambda bb, hh, i, j: (bb, 0, j)),
+            pl.BlockSpec((1, 1, block_k, d), kv_map),
+            pl.BlockSpec((1, 1, block_k, d), kv_map),
+            pl.BlockSpec((1, 1, block_k), mask_map),
             pl.BlockSpec(
                 (1, 1, block_q, d), lambda bb, hh, i, j: (bb, hh, i, 0)
             ),
@@ -383,13 +461,26 @@ def _bwd_call(q, k, v, km, do, lse, delta, block_q, block_k, interpret,
         interpret=interpret,
     )(q, k, v, km, do, lse, delta)
 
+    if window is None:
+        nq_grid = nq
+        q_map = lambda bb, hh, j, i: (bb, hh, i, 0)  # noqa: E731
+    else:
+        # One k block's band spans bk + window - 1 rows of q.
+        nq_grid = min(nq, (block_k + window - 1 + block_q - 1)
+                      // block_q + 1)
+
+        def q_map(bb, hh, j, ii):
+            i = (j * block_k) // block_q + ii
+            return (bb, hh, jnp.clip(i, 0, nq - 1), 0)
+
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal),
-        grid=(b, h, nk, nq),
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal, window=window,
+            nq_total=nq,
+        ),
+        grid=(b, h, nk, nq_grid),
         in_specs=[
-            pl.BlockSpec(
-                (1, 1, block_q, d), lambda bb, hh, j, i: (bb, hh, i, 0)
-            ),
+            pl.BlockSpec((1, 1, block_q, d), q_map),
             pl.BlockSpec(
                 (1, 1, block_k, d), lambda bb, hh, j, i: (bb, hh, j, 0)
             ),
@@ -397,15 +488,9 @@ def _bwd_call(q, k, v, km, do, lse, delta, block_q, block_k, interpret,
                 (1, 1, block_k, d), lambda bb, hh, j, i: (bb, hh, j, 0)
             ),
             pl.BlockSpec((1, 1, block_k), lambda bb, hh, j, i: (bb, 0, j)),
-            pl.BlockSpec(
-                (1, 1, block_q, d), lambda bb, hh, j, i: (bb, hh, i, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, block_q, 1), lambda bb, hh, j, i: (bb, hh, i, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, block_q, 1), lambda bb, hh, j, i: (bb, hh, i, 0)
-            ),
+            pl.BlockSpec((1, 1, block_q, d), q_map),
+            pl.BlockSpec((1, 1, block_q, 1), q_map),
+            pl.BlockSpec((1, 1, block_q, 1), q_map),
         ],
         out_specs=[
             pl.BlockSpec(
@@ -434,25 +519,31 @@ def _bwd_call(q, k, v, km, do, lse, delta, block_q, block_k, interpret,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_core(q, k, v, km, block_q, block_k, interpret, causal):
-    o, _ = _fwd_call(q, k, v, km, block_q, block_k, interpret, causal)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_core(q, k, v, km, block_q, block_k, interpret, causal,
+                window):
+    o, _ = _fwd_call(
+        q, k, v, km, block_q, block_k, interpret, causal, window
+    )
     return o
 
 
-def _flash_core_fwd(q, k, v, km, block_q, block_k, interpret, causal):
-    o, lse = _fwd_call(q, k, v, km, block_q, block_k, interpret, causal)
+def _flash_core_fwd(q, k, v, km, block_q, block_k, interpret, causal,
+                    window):
+    o, lse = _fwd_call(
+        q, k, v, km, block_q, block_k, interpret, causal, window
+    )
     return o, (q, k, v, km, o, lse)
 
 
-def _flash_core_bwd(block_q, block_k, interpret, causal, res, g):
+def _flash_core_bwd(block_q, block_k, interpret, causal, window, res, g):
     q, k, v, km, o, lse = res
     do = g.astype(jnp.float32)
     # (B, H, Tq, 1) — trailing singleton keeps TPU block shapes legal.
     delta = jnp.sum(do * o.astype(jnp.float32), axis=-1, keepdims=True)
     dq, dk, dv = _bwd_call(
         q, k, v, km, do.astype(q.dtype), lse, delta,
-        block_q, block_k, interpret, causal,
+        block_q, block_k, interpret, causal, window,
     )
     return dq, dk, dv, jnp.zeros_like(km)
 
@@ -472,6 +563,7 @@ def flash_attention(
     key_mask=None,
     *,
     causal: bool = False,
+    window: int | None = None,
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
@@ -488,6 +580,7 @@ def flash_attention(
     """
     if interpret is None:
         interpret = _auto_interpret()
+    _validate_window(window, causal)
     t_longest = max(q.shape[2], k.shape[2])
     if block_q is None:
         block_q = 256 if t_longest <= 8192 else 512
@@ -510,7 +603,9 @@ def flash_attention(
         v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
         km = jnp.pad(km, ((0, 0), (0, 0), (0, pad_k)))
 
-    out = _flash_core(q, k, v, km, block_q, block_k, interpret, causal)
+    out = _flash_core(
+        q, k, v, km, block_q, block_k, interpret, causal, window
+    )
     if pad_q:
         out = out[:, :, :tq]
     return out

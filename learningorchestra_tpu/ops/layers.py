@@ -37,6 +37,10 @@ class MultiHeadSelfAttention(nn.Module):
     dtype: jnp.dtype | None = None  # None = promote (bf16 when the train step casts params)
     use_flash: bool | None = None
     causal: bool = False
+    # Sliding-window (banded causal) attention: each query sees its
+    # last ``window`` positions.  O(T*window) cost on the flash path —
+    # off-diagonal blocks outside the band skip compute entirely.
+    window: int | None = None
     # Autoregressive inference: cache K/V per position in a 'cache'
     # variable collection (apply with mutable=['cache']).  Initialize
     # by running the module on a FULL-length input (flax convention:
@@ -95,7 +99,16 @@ class MultiHeadSelfAttention(nn.Module):
                 # The caller's key_mask covers the whole buffer (False
                 # beyond the current position), so causality is already
                 # in the mask; flash brings nothing for T_q == 1
-                # queries.
+                # queries.  The sliding window is enforced HERE — the
+                # layer owns the invariant — not by each decode loop.
+                if self.window is not None:
+                    tk_cache = ck.value.shape[2]
+                    win = jnp.arange(tk_cache)[None, :] > (
+                        idx - self.window
+                    )
+                    key_mask = win if key_mask is None else (
+                        key_mask & win
+                    )
                 out = mha_reference(q, ck.value, cv.value, key_mask)
                 out = out.transpose(0, 2, 1, 3).reshape(
                     b, t, self.qkv_features
@@ -108,7 +121,9 @@ class MultiHeadSelfAttention(nn.Module):
         if use_flash is None:
             use_flash = jax.default_backend() == "tpu"
         attend = flash_attention if use_flash else mha_reference
-        out = attend(q, k, v, key_mask, causal=self.causal)  # (B,H,T,hd)
+        out = attend(
+            q, k, v, key_mask, causal=self.causal, window=self.window
+        )  # (B,H,T,hd)
         out = out.transpose(0, 2, 1, 3).reshape(b, t, self.qkv_features)
         return nn.DenseGeneral(
             self.qkv_features, dtype=self.dtype, name="out"
