@@ -46,6 +46,7 @@ class MoETransformerBlock(nn.Module):
     dtype: jnp.dtype | None = None  # None = promote (bf16 when the train step casts params)
     use_flash: bool | None = None
     causal: bool = False
+    window: int | None = None  # sliding-window attention (causal only)
     decode: bool = False
 
     @nn.compact
@@ -57,6 +58,7 @@ class MoETransformerBlock(nn.Module):
             dtype=self.dtype,
             use_flash=self.use_flash,
             causal=self.causal,
+            window=self.window,
             decode=self.decode,
         )(y, key_mask=key_mask)
         x = x + y
@@ -94,6 +96,7 @@ class _MoETransformer(nn.Module):
     dtype: jnp.dtype | None = None  # None = promote (bf16 when the train step casts params)
     use_flash: bool | None = None
     decode: bool = False
+    window: int | None = None  # sliding-window attention (lm head only)
 
     @nn.compact
     def __call__(self, tokens, positions=None, key_mask=None):
@@ -119,6 +122,7 @@ class _MoETransformer(nn.Module):
                     dtype=self.dtype,
                     use_flash=self.use_flash,
                     causal=causal,
+                    window=self.window if causal else None,
                     decode=self.decode,
                     name=f"MoEBlock_{i}",
                 )(x, key_mask=key_mask)
@@ -130,6 +134,7 @@ class _MoETransformer(nn.Module):
                     dtype=self.dtype,
                     use_flash=self.use_flash,
                     causal=causal,
+                    window=self.window if causal else None,
                     decode=self.decode,
                     name=f"TransformerBlock_{i}",
                 )(x, key_mask=key_mask)
@@ -209,6 +214,7 @@ class MoEDecoderLM(GreedyDecodeMixin, NeuralEstimator):
         moe_every: int = 2,
         learning_rate: float = 3e-4,
         seed: int = 0,
+        attention_window: int | None = None,
     ):
         self.vocab_size = vocab_size
         self.hidden_dim = hidden_dim
@@ -220,6 +226,7 @@ class MoEDecoderLM(GreedyDecodeMixin, NeuralEstimator):
         self.top_k = top_k
         self.capacity_factor = capacity_factor
         self.moe_every = moe_every
+        self.attention_window = attention_window
         super().__init__(
             _MoETransformer(
                 vocab_size=vocab_size,
@@ -234,6 +241,7 @@ class MoEDecoderLM(GreedyDecodeMixin, NeuralEstimator):
                 moe_every=moe_every,
                 top_k=top_k,
                 capacity_factor=capacity_factor,
+                window=attention_window,
             ),
             loss="softmax_ce",
             learning_rate=learning_rate,

@@ -307,24 +307,39 @@ class _DecoderLM(nn.Module):
 
 
 class GreedyDecodeMixin:
-    """Greedy autoregressive decoding for any estimator whose module
-    maps token ids (B, T) to per-token vocab logits (B, T, V) and
-    supports ``decode=True`` KV caching."""
+    """Autoregressive decoding for any estimator whose module maps
+    token ids (B, T) to per-token vocab logits (B, T, V) and supports
+    ``decode=True`` KV caching."""
 
-    def generate(self, prompts, max_new_tokens: int = 32):
-        """Greedy continuation of int32 prompts (B, T0).
+    def generate(self, prompts, max_new_tokens: int = 32,
+                 temperature: float | None = None,
+                 top_k: int | None = None, seed: int = 0):
+        """Continuation of int32 prompts (B, T0): greedy by default,
+        sampled with ``temperature`` (optionally ``top_k``-truncated).
 
         KV-cache decoding: the whole generation (prompt prefill +
         continuation) is ONE jitted ``lax.scan`` over buffer positions
         — each step embeds a single token at its true position, attends
-        against the per-layer K/V cache, and appends the argmax.  Cost
-        per new token is O(T·H) instead of the O(T²·H) full re-forward
-        of the naive loop, and the device round-trip count is 1, not T
-        (the remote-TPU tunnel pays ~10-100 ms per round trip)."""
+        against the per-layer K/V cache, and appends the next token.
+        Cost per new token is O(T·H) instead of the O(T²·H) full
+        re-forward of the naive loop, and the device round-trip count
+        is 1, not T (the remote-TPU tunnel pays ~10-100 ms per round
+        trip).  ``temperature`` is a runtime argument (no recompile);
+        ``top_k`` changes the compiled graph and keys the fn cache."""
         import jax
         import numpy as np
         from jax import lax
 
+        sample = temperature is not None and temperature > 0.0
+        if top_k is not None and not sample:
+            raise ValueError(
+                "top_k requires a positive temperature (top_k without "
+                "sampling silently degrades to greedy)"
+            )
+        if top_k == 1:
+            # Deterministic by definition — use the greedy path (also
+            # sidesteps tie-breaking drift vs argmax in low precision).
+            sample, top_k = False, None
         prompts = np.asarray(prompts, dtype=np.int32)
         bsz, t0 = prompts.shape
         total = min(self.max_len, t0 + max_new_tokens)
@@ -336,7 +351,7 @@ class GreedyDecodeMixin:
         fns = getattr(self, "_decode_fns", None)
         if fns is None:
             fns = self._decode_fns = {}
-        entry = fns.get((bsz, total, t0))
+        entry = fns.get((bsz, total, t0, sample, top_k))
         if entry is None:
             decode_mod = self.module.clone(decode=True)
             # Cache shapes via eval_shape (no real forward, no
@@ -346,7 +361,7 @@ class GreedyDecodeMixin:
                 jnp.zeros((bsz, total), jnp.int32),
             )["cache"]
 
-            def decode(variables, cache, buf):
+            def decode(variables, cache, buf, temp, key):
                 def step(carry, i):
                     cache, buf = carry
                     tok = lax.dynamic_slice(buf, (0, i), (bsz, 1))
@@ -363,7 +378,25 @@ class GreedyDecodeMixin:
                         positions=pos, key_mask=kmask,
                         mutable=["cache"],
                     )
-                    nxt = jnp.argmax(logits[:, 0], -1).astype(jnp.int32)
+                    step_logits = logits[:, 0].astype(jnp.float32)
+                    if not sample:
+                        nxt = jnp.argmax(step_logits, -1)
+                    else:
+                        # Never sample pad id 0: a mid-stream pad would
+                        # be masked out of all later attention
+                        # (buf != 0) and read as end-of-sequence.
+                        step_logits = step_logits.at[:, 0].set(-jnp.inf)
+                        if top_k is not None:
+                            kth = lax.top_k(step_logits, top_k)[0][
+                                ..., -1:]
+                            step_logits = jnp.where(
+                                step_logits < kth, -jnp.inf, step_logits
+                            )
+                        nxt = jax.random.categorical(
+                            jax.random.fold_in(key, i),
+                            step_logits / temp, axis=-1,
+                        )
+                    nxt = nxt.astype(jnp.int32)
                     prev = lax.dynamic_slice(buf, (0, i + 1), (bsz, 1))
                     col = jnp.where(i + 1 >= t0, nxt[:, None], prev)
                     buf = lax.dynamic_update_slice(buf, col, (0, i + 1))
@@ -374,7 +407,7 @@ class GreedyDecodeMixin:
                 )
                 return buf
 
-            entry = fns[(bsz, total, t0)] = (
+            entry = fns[(bsz, total, t0, sample, top_k)] = (
                 jax.jit(decode), cache_shapes
             )
 
@@ -385,7 +418,11 @@ class GreedyDecodeMixin:
         buf0 = jnp.zeros((bsz, total), jnp.int32).at[:, :t0].set(
             jnp.asarray(prompts)
         )
-        return np.asarray(decode(dict(self.params), cache0, buf0))
+        return np.asarray(decode(
+            dict(self.params), cache0, buf0,
+            jnp.float32(temperature if sample else 1.0),
+            jax.random.PRNGKey(seed),
+        ))
 
 
 @register(_MODULE)

@@ -236,3 +236,22 @@ def test_moe_kv_cache_generate_matches_full_forward():
     np.testing.assert_array_equal(
         out, naive_greedy_decode(est, x[:2, :4], 8)
     )
+
+
+def test_moe_windowed_decoder_cache_generate():
+    """Sliding-window MoE decoder: cache decode == naive full forward
+    (drop-free config)."""
+    from tests.lm_oracle import naive_greedy_decode
+
+    rng = np.random.default_rng(2)
+    x = rng.integers(1, 32, (8, 12)).astype(np.int32)
+    tgt = np.concatenate([x[:, 1:], np.zeros((8, 1), np.int32)], 1)
+    est = MoEDecoderLM(
+        vocab_size=32, hidden_dim=32, num_layers=2, num_heads=2,
+        max_len=16, num_experts=2, mlp_dim=16, attention_window=4,
+    )
+    est.fit(x, tgt, epochs=1, batch_size=8, verbose=0)
+    out = est.generate(x[:2, :6], max_new_tokens=4)
+    np.testing.assert_array_equal(
+        out, naive_greedy_decode(est, x[:2, :6], 10)
+    )

@@ -342,3 +342,55 @@ def test_kv_cache_generate_matches_full_forward():
     np.testing.assert_array_equal(
         out, naive_greedy_decode(est, x[:2, :4], 8)
     )
+
+
+def test_generate_sampling_modes():
+    """temperature/top_k sampling: deterministic per seed, reduces to
+    greedy at top_k=1, differs from greedy at high temperature."""
+    from learningorchestra_tpu.models.text import DecoderLM
+
+    rng = np.random.default_rng(0)
+    x = rng.integers(1, 64, (8, 10)).astype(np.int32)
+    tgt = np.concatenate([x[:, 1:], np.zeros((8, 1), np.int32)], 1)
+    est = DecoderLM(
+        vocab_size=64, hidden_dim=32, num_layers=2, num_heads=2,
+        max_len=16,
+    )
+    est.fit(x, tgt, epochs=1, batch_size=8, verbose=0)
+    prompts = x[:2, :4]
+
+    greedy = est.generate(prompts, max_new_tokens=8)
+    # top_k=1 sampling == greedy regardless of temperature.
+    np.testing.assert_array_equal(
+        greedy,
+        est.generate(prompts, max_new_tokens=8, temperature=3.0,
+                     top_k=1, seed=5),
+    )
+    # Same seed -> same sample; it's a real distribution (high
+    # temperature over 64 tokens differs from greedy).
+    s1 = est.generate(prompts, max_new_tokens=8, temperature=5.0, seed=1)
+    s2 = est.generate(prompts, max_new_tokens=8, temperature=5.0, seed=1)
+    np.testing.assert_array_equal(s1, s2)
+    assert not np.array_equal(s1, greedy)
+    # temperature=None (default) stays the greedy path.
+    np.testing.assert_array_equal(
+        greedy, est.generate(prompts, max_new_tokens=8, temperature=None)
+    )
+
+
+def test_generate_sampling_guards():
+    from learningorchestra_tpu.models.text import DecoderLM
+
+    rng = np.random.default_rng(1)
+    x = rng.integers(1, 16, (4, 6)).astype(np.int32)
+    est = DecoderLM(
+        vocab_size=16, hidden_dim=16, num_layers=1, num_heads=2,
+        max_len=12, mlp_dim=16,
+    )
+    est.fit(x, x, epochs=1, batch_size=4, verbose=0)
+    with pytest.raises(ValueError, match="temperature"):
+        est.generate(x[:1, :3], top_k=5)
+    # Sampling never emits pad id 0.
+    out = est.generate(x[:2, :3], max_new_tokens=8, temperature=10.0,
+                       seed=3)
+    assert (out[:, 3:] != 0).all()
