@@ -108,6 +108,7 @@ class TransformerBlock(nn.Module):
     hidden_dim: int
     num_heads: int
     mlp_dim: int
+    num_kv_heads: int | None = None  # grouped-query attention
     dtype: jnp.dtype | None = None  # None = promote (bf16 when the train step casts params)
     use_flash: bool | None = None  # None = auto by backend
     causal: bool = False  # decoder blocks mask future positions
@@ -120,6 +121,7 @@ class TransformerBlock(nn.Module):
         y = MultiHeadSelfAttention(
             num_heads=self.num_heads,
             qkv_features=self.hidden_dim,
+            num_kv_heads=self.num_kv_heads,
             dtype=self.dtype,
             use_flash=self.use_flash,
             causal=self.causal,
@@ -278,6 +280,7 @@ class _DecoderLM(nn.Module):
     remat: bool = False
     decode: bool = False
     window: int | None = None  # sliding-window attention
+    num_kv_heads: int | None = None  # grouped-query attention
 
     @nn.compact
     def __call__(self, tokens, positions=None, key_mask=None):
@@ -295,6 +298,7 @@ class _DecoderLM(nn.Module):
                 hidden_dim=self.hidden_dim,
                 num_heads=self.num_heads,
                 mlp_dim=self.mlp_dim,
+                num_kv_heads=self.num_kv_heads,
                 dtype=self.dtype,
                 use_flash=self.use_flash,
                 causal=True,
@@ -449,6 +453,7 @@ class DecoderLM(GreedyDecodeMixin, NeuralEstimator):
         seed: int = 0,
         remat: bool = False,
         attention_window: int | None = None,
+        num_kv_heads: int | None = None,
     ):
         self.vocab_size = vocab_size
         self.hidden_dim = hidden_dim
@@ -458,6 +463,7 @@ class DecoderLM(GreedyDecodeMixin, NeuralEstimator):
         self.max_len = max_len
         self.remat = remat
         self.attention_window = attention_window
+        self.num_kv_heads = num_kv_heads
         super().__init__(
             _DecoderLM(
                 vocab_size=vocab_size,
@@ -468,6 +474,7 @@ class DecoderLM(GreedyDecodeMixin, NeuralEstimator):
                 max_len=max_len,
                 remat=remat,
                 window=attention_window,
+                num_kv_heads=num_kv_heads,
             ),
             loss="softmax_ce",
             learning_rate=learning_rate,

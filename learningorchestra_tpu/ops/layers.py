@@ -19,6 +19,28 @@ from learningorchestra_tpu.ops.attention import (
 )
 
 
+def _grouped_decode_attend(q, k, v, key_mask):
+    """Single-position attention against a (possibly grouped) KV cache.
+
+    q: (B, H, 1, hd); k/v: (B, H_kv, Tk, hd) with H_kv | H.  Queries
+    attend their group's KV head DIRECTLY — no jnp.repeat widening of
+    the cache, so per-step HBM traffic stays at H_kv (the point of
+    GQA).  key_mask (B, Tk) always marks at least the current position.
+    """
+    b, h, _, hd = q.shape
+    kv_heads, tk = k.shape[1], k.shape[2]
+    gsz = h // kv_heads
+    qg = q.reshape(b, kv_heads, gsz, hd)
+    s = jnp.einsum(
+        "bhgd,bhkd->bhgk",
+        qg.astype(jnp.float32), k.astype(jnp.float32),
+    ) * (1.0 / hd ** 0.5)  # (B, H_kv, G, Tk)
+    s = jnp.where(key_mask[:, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhgk,bhkd->bhgd", p, v.astype(jnp.float32))
+    return out.reshape(b, h, 1, hd).astype(q.dtype)
+
+
 class MultiHeadSelfAttention(nn.Module):
     """Self-attention with a key-side padding mask (B, T).
 
@@ -34,6 +56,11 @@ class MultiHeadSelfAttention(nn.Module):
 
     num_heads: int
     qkv_features: int
+    # Grouped-query attention: project K/V to ``num_kv_heads`` heads
+    # (None = num_heads, plain MHA; 1 = multi-query).  Shrinks the
+    # decode KV cache and K/V projection FLOPs by H/H_kv; each KV head
+    # serves a contiguous group of query heads.
+    num_kv_heads: int | None = None
     dtype: jnp.dtype | None = None  # None = promote (bf16 when the train step casts params)
     use_flash: bool | None = None
     causal: bool = False
@@ -54,14 +81,33 @@ class MultiHeadSelfAttention(nn.Module):
         head_dim = self.qkv_features // self.num_heads
         if head_dim * self.num_heads != self.qkv_features:
             raise ValueError("qkv_features must be divisible by num_heads")
+        kv_heads = self.num_heads if self.num_kv_heads is None \
+            else self.num_kv_heads
+        if kv_heads < 1:
+            raise ValueError(f"num_kv_heads must be >= 1, got {kv_heads}")
+        if self.num_heads % kv_heads:
+            raise ValueError(
+                f"num_heads={self.num_heads} not divisible by "
+                f"num_kv_heads={kv_heads}"
+            )
 
-        def proj(name):
+        def proj(name, heads):
             y = nn.DenseGeneral(
-                (self.num_heads, head_dim), dtype=self.dtype, name=name
+                (heads, head_dim), dtype=self.dtype, name=name
             )(x)
-            return y.transpose(0, 2, 1, 3)  # (B, H, T, hd)
+            return y.transpose(0, 2, 1, 3)  # (B, heads, T, hd)
 
-        q, k, v = proj("query"), proj("key"), proj("value")
+        q = proj("query", self.num_heads)
+        k = proj("key", kv_heads)
+        v = proj("value", kv_heads)
+
+        def widen(kv):
+            # Broadcast each KV head to its query-head group.  The
+            # repeat happens AFTER caching, so the cache (and its HBM
+            # traffic) stays at kv_heads.
+            if kv_heads == self.num_heads:
+                return kv
+            return jnp.repeat(kv, self.num_heads // kv_heads, axis=1)
 
         if self.decode:
             # Flax decode convention: the variables are declared once;
@@ -109,7 +155,9 @@ class MultiHeadSelfAttention(nn.Module):
                     key_mask = win if key_mask is None else (
                         key_mask & win
                     )
-                out = mha_reference(q, ck.value, cv.value, key_mask)
+                out = _grouped_decode_attend(
+                    q, ck.value, cv.value, key_mask
+                )
                 out = out.transpose(0, 2, 1, 3).reshape(
                     b, t, self.qkv_features
                 )
@@ -122,7 +170,8 @@ class MultiHeadSelfAttention(nn.Module):
             use_flash = jax.default_backend() == "tpu"
         attend = flash_attention if use_flash else mha_reference
         out = attend(
-            q, k, v, key_mask, causal=self.causal, window=self.window
+            q, widen(k), widen(v), key_mask,
+            causal=self.causal, window=self.window,
         )  # (B,H,T,hd)
         out = out.transpose(0, 2, 1, 3).reshape(b, t, self.qkv_features)
         return nn.DenseGeneral(

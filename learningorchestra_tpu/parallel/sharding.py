@@ -74,9 +74,16 @@ def leaf_spec(path: tuple, shape: tuple, mesh: Mesh) -> P:
         # 3-D kernel with a different axis layout never silently gets
         # heads-style placement.
         is_qkv = any(t in name for t in ("query", "key", "value", "qkv"))
-        if is_qkv and _divisible(shape[1], tp):
+        if is_qkv:
             inn = "fsdp" if _divisible(shape[0], fsdp) else None
-            return P(inn, "tp", None)
+            if _divisible(shape[1], tp):
+                return P(inn, "tp", None)
+            # GQA K/V kernels whose few heads don't divide tp:
+            # REPLICATE rather than shard head_dim — q stays
+            # heads-sharded, k/v replicated, and the attention still
+            # needs no collective (sharding head_dim would force
+            # per-layer reshards against the heads-sharded q).
+            return P(inn, None, None)
         out = "tp" if _divisible(shape[-1], tp) else None
         return P(None, None, out)
     return P()

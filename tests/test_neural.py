@@ -394,3 +394,43 @@ def test_generate_sampling_guards():
     out = est.generate(x[:2, :3], max_new_tokens=8, temperature=10.0,
                        seed=3)
     assert (out[:, 3:] != 0).all()
+
+
+def test_gqa_decoder_cache_generate():
+    """Grouped-query attention: fewer KV heads, cache shrinks, decode
+    stays exact vs the full-forward oracle; MQA (1 KV head) included."""
+    import jax
+
+    from learningorchestra_tpu.models.text import DecoderLM
+    from tests.lm_oracle import naive_greedy_decode
+
+    rng = np.random.default_rng(6)
+    x = rng.integers(1, 32, (8, 10)).astype(np.int32)
+    tgt = np.concatenate([x[:, 1:], np.zeros((8, 1), np.int32)], 1)
+    for kv_heads in (2, 1):
+        est = DecoderLM(
+            vocab_size=32, hidden_dim=32, num_layers=2, num_heads=4,
+            max_len=16, mlp_dim=16, num_kv_heads=kv_heads,
+        )
+        est.fit(x, tgt, epochs=1, batch_size=8, verbose=0)
+        # KV projection kernels carry kv_heads, not num_heads.
+        kshape = est.params["params"]["TransformerBlock_0"][
+            "MultiHeadSelfAttention_0"]["key"]["kernel"].shape
+        assert kshape[1] == kv_heads, kshape
+        out = est.generate(x[:2, :4], max_new_tokens=4)
+        np.testing.assert_array_equal(
+            out, naive_greedy_decode(est, x[:2, :4], 8)
+        )
+
+
+def test_gqa_invalid_head_split():
+    import jax.numpy as jnp
+
+    from learningorchestra_tpu.models.text import DecoderLM
+
+    est = DecoderLM(
+        vocab_size=16, hidden_dim=16, num_layers=1, num_heads=4,
+        max_len=8, mlp_dim=16, num_kv_heads=3,
+    )
+    with pytest.raises(ValueError, match="divisible"):
+        est._init_params(jnp.zeros((1, 4), jnp.int32))
