@@ -113,6 +113,7 @@ class TransformerBlock(nn.Module):
     use_flash: bool | None = None  # None = auto by backend
     causal: bool = False  # decoder blocks mask future positions
     window: int | None = None  # sliding-window attention (causal only)
+    rope: bool = False  # rotary position embeddings
     decode: bool = False  # KV-cache autoregressive inference
 
     @nn.compact
@@ -126,6 +127,7 @@ class TransformerBlock(nn.Module):
             use_flash=self.use_flash,
             causal=self.causal,
             window=self.window,
+            rope=self.rope,
             decode=self.decode,
         )(y, key_mask=key_mask)
         x = x + y
@@ -281,14 +283,22 @@ class _DecoderLM(nn.Module):
     decode: bool = False
     window: int | None = None  # sliding-window attention
     num_kv_heads: int | None = None  # grouped-query attention
+    positional: str = "learned"  # 'learned' | 'rope'
 
     @nn.compact
     def __call__(self, tokens, positions=None, key_mask=None):
         tokens = tokens.astype(jnp.int32)
-        x = embed_tokens(
-            tokens, self.vocab_size, self.hidden_dim, self.max_len,
-            self.dtype, positions=positions,
-        )
+        if self.positional == "rope":
+            # Rotary encodes position inside attention (ops/layers.py);
+            # no learned table — the model extrapolates past max_len.
+            x = nn.Embed(
+                self.vocab_size, self.hidden_dim, dtype=self.dtype
+            )(tokens)
+        else:
+            x = embed_tokens(
+                tokens, self.vocab_size, self.hidden_dim, self.max_len,
+                self.dtype, positions=positions,
+            )
         if key_mask is None:
             key_mask = tokens != 0  # (B, T), pad id 0
         block_cls = nn.remat(TransformerBlock) if self.remat \
@@ -303,6 +313,7 @@ class _DecoderLM(nn.Module):
                 use_flash=self.use_flash,
                 causal=True,
                 window=self.window,
+                rope=self.positional == "rope",
                 decode=self.decode,
                 name=f"TransformerBlock_{i}",
             )(x, key_mask=key_mask)
@@ -454,7 +465,11 @@ class DecoderLM(GreedyDecodeMixin, NeuralEstimator):
         remat: bool = False,
         attention_window: int | None = None,
         num_kv_heads: int | None = None,
+        positional: str = "learned",
     ):
+        if positional not in ("learned", "rope"):
+            raise ValueError(f"positional must be learned|rope, "
+                             f"got {positional!r}")
         self.vocab_size = vocab_size
         self.hidden_dim = hidden_dim
         self.num_layers = num_layers
@@ -464,6 +479,7 @@ class DecoderLM(GreedyDecodeMixin, NeuralEstimator):
         self.remat = remat
         self.attention_window = attention_window
         self.num_kv_heads = num_kv_heads
+        self.positional = positional
         super().__init__(
             _DecoderLM(
                 vocab_size=vocab_size,
@@ -475,6 +491,7 @@ class DecoderLM(GreedyDecodeMixin, NeuralEstimator):
                 remat=remat,
                 window=attention_window,
                 num_kv_heads=num_kv_heads,
+                positional=positional,
             ),
             loss="softmax_ce",
             learning_rate=learning_rate,

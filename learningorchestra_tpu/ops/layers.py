@@ -19,6 +19,35 @@ from learningorchestra_tpu.ops.attention import (
 )
 
 
+def apply_rope(x, positions, theta: float = 10000.0):
+    """Rotary position embedding on (B, H, T, hd) with positions (T,)
+    or (B, T).  Rotates feature pairs (x[..., :hd/2], x[..., hd/2:])
+    by position-scaled frequencies — attention scores then depend only
+    on RELATIVE distance, so trained models extrapolate past max_len
+    and need no learned position table."""
+    hd = x.shape[-1]
+    if hd % 2:
+        raise ValueError(f"rope needs an even head_dim, got {hd}")
+    half = hd // 2
+    freqs = theta ** (
+        -jnp.arange(0, half, dtype=jnp.float32) / half
+    )  # (half,)
+    pos = jnp.asarray(positions, jnp.float32)
+    angles = pos[..., None] * freqs  # (T, half) or (B, T, half)
+    if angles.ndim == 2:  # (T, half): shared across batch and heads
+        angles = angles[None, None]
+    elif angles.ndim == 3:  # (B, T, half): insert the head axis
+        angles = angles[:, None]
+    else:
+        raise ValueError(f"positions must be (T,) or (B, T)")
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
 def _grouped_decode_attend(q, k, v, key_mask):
     """Single-position attention against a (possibly grouped) KV cache.
 
@@ -35,8 +64,19 @@ def _grouped_decode_attend(q, k, v, key_mask):
         "bhgd,bhkd->bhgk",
         qg.astype(jnp.float32), k.astype(jnp.float32),
     ) * (1.0 / hd ** 0.5)  # (B, H_kv, G, Tk)
-    s = jnp.where(key_mask[:, None, None, :], s, -1e30)
-    p = jax.nn.softmax(s, axis=-1)
+    if key_mask is None:
+        p = jax.nn.softmax(s, axis=-1)
+    else:
+        # Same double-where contract as mha_reference: fully-masked
+        # rows (left-padded prompts at step 0) output exactly 0, not
+        # the mean of the cache buffer.
+        maskb = key_mask.astype(bool)[:, None, None, :]
+        m = jnp.max(jnp.where(maskb, s, -1e30), axis=-1, keepdims=True)
+        m = jnp.where(m > -5e29, m, 0.0)
+        p = jnp.exp(jnp.where(maskb, s - m, -1e30))
+        p = p / jnp.maximum(
+            jnp.sum(p, axis=-1, keepdims=True), 1e-30
+        )
     out = jnp.einsum("bhgk,bhkd->bhgd", p, v.astype(jnp.float32))
     return out.reshape(b, h, 1, hd).astype(q.dtype)
 
@@ -68,6 +108,9 @@ class MultiHeadSelfAttention(nn.Module):
     # last ``window`` positions.  O(T*window) cost on the flash path —
     # off-diagonal blocks outside the band skip compute entirely.
     window: int | None = None
+    # Rotary position embeddings applied to q/k (the model skips its
+    # learned position table when this is on).
+    rope: bool = False
     # Autoregressive inference: cache K/V per position in a 'cache'
     # variable collection (apply with mutable=['cache']).  Initialize
     # by running the module on a FULL-length input (flax convention:
@@ -100,6 +143,13 @@ class MultiHeadSelfAttention(nn.Module):
         q = proj("query", self.num_heads)
         k = proj("key", kv_heads)
         v = proj("value", kv_heads)
+        is_initialized = self.decode and self.has_variable(
+            "cache", "cached_key"
+        )
+        if self.rope and not is_initialized:
+            pos = jnp.arange(t)
+            q = apply_rope(q, pos)
+            k = apply_rope(k, pos)
 
         def widen(kv):
             # Broadcast each KV head to its query-head group.  The
@@ -114,7 +164,6 @@ class MultiHeadSelfAttention(nn.Module):
             # an uninitialized pass (module.init / eval_shape on the
             # FULL-length input) merely sizes them and falls through to
             # the normal forward below.
-            is_initialized = self.has_variable("cache", "cached_key")
             ck = self.variable("cache", "cached_key", jnp.zeros,
                                k.shape, k.dtype)
             cv = self.variable("cache", "cached_value", jnp.zeros,
@@ -124,6 +173,13 @@ class MultiHeadSelfAttention(nn.Module):
                 lambda: jnp.zeros((), jnp.int32),
             )
             if is_initialized:
+                if self.rope:
+                    # Rotate at the CURRENT position before caching —
+                    # the cache holds rotated keys, so lookups need no
+                    # re-rotation.
+                    pos1 = jnp.full((1,), ci.value)
+                    q = apply_rope(q, pos1)
+                    k = apply_rope(k, pos1)
                 if t != 1:
                     # Multi-token chunks would need an intra-chunk
                     # causal mask (the per-batch key_mask has no
