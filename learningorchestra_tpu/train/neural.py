@@ -382,6 +382,12 @@ class NeuralEstimator(Estimator):
         contract, declaratively (train_function.py:75-82)."""
         if optimizer is not None:
             self.optimizer = optimizer
+            # A fresh base optimizer voids any accumulation wrapper and
+            # any state built for the old one.
+            self._base_optimizer = None
+            self._accumulate_steps = 1
+            if self.params is not None:
+                self.opt_state = jax.jit(self.optimizer.init)(self.params)
         if loss is not None:
             self.loss = loss
         self._step_fn = None  # force re-jit with new config
@@ -449,6 +455,30 @@ class NeuralEstimator(Estimator):
         self.params = self.module.init(rng, x0)
         self.opt_state = self.optimizer.init(self.params)
 
+    def _set_accumulation(self, accumulate_steps: int) -> None:
+        """(Un)wrap the optimizer in optax.MultiSteps; rebuilds jitted
+        fns and optimizer state when the setting changes."""
+        if accumulate_steps < 1:
+            raise ValueError(
+                f"accumulate_steps must be >= 1, got {accumulate_steps}"
+            )
+        current = getattr(self, "_accumulate_steps", 1)
+        if accumulate_steps == current:
+            return
+        base = getattr(self, "_base_optimizer", None)
+        if base is None:
+            base = self.optimizer
+        self._base_optimizer = base
+        self.optimizer = base if accumulate_steps == 1 else \
+            optax.MultiSteps(base, every_k_schedule=accumulate_steps)
+        self._accumulate_steps = accumulate_steps
+        self._step_fn = None
+        self._eval_fn = None
+        self._device_epoch = None
+        self._device_epoch_key = None
+        if self.params is not None:
+            self.opt_state = jax.jit(self.optimizer.init)(self.params)
+
     def _build_step(self, loss_kind: str):
         dtype = jnp.bfloat16 if self.compute_dtype == "bfloat16" else None
         return build_epoch_fns(
@@ -475,6 +505,7 @@ class NeuralEstimator(Estimator):
         checkpoint_every: int = 1,
         checkpoint_min_interval_s: float = 60.0,
         resume: bool = True,
+        accumulate_steps: int = 1,
         **_,
     ) -> "NeuralEstimator":
         """keras-fit surface plus managed in-loop checkpointing: with
@@ -485,7 +516,18 @@ class NeuralEstimator(Estimator):
         epoch always saves) — and an interrupted fit resumes from the
         newest checkpoint instead of epoch 0 (``resume=False`` ignores
         existing checkpoints) — the preemption story the reference
-        lacks (SURVEY §5.4)."""
+        lacks (SURVEY §5.4).
+
+        ``accumulate_steps=N`` accumulates gradients over N batches
+        before each optimizer update (``optax.MultiSteps``) — the
+        effective batch is N·batch_size without N× the activation
+        memory.  When the accumulated batches are all full (dataset a
+        multiple of N·batch_size, per-sample masks) the N masked-mean
+        grads average to the large-batch mean and trajectories match
+        large-batch training to compute-dtype rounding; a padded tail
+        batch (or per-token LM masks) weights each batch equally
+        rather than by its mask mass."""
+        self._set_accumulation(accumulate_steps)
         x = np.asarray(as_array(x))
         y_arr = np.asarray(y if not hasattr(y, "to_numpy") else y.to_numpy())
         y_arr = y_arr.reshape(-1) if y_arr.ndim == 2 and y_arr.shape[1] == 1 \
@@ -536,10 +578,19 @@ class NeuralEstimator(Estimator):
         if checkpoint_dir and resume:
             from learningorchestra_tpu.train import checkpoint as ckpt
 
-            loaded = ckpt.load_latest(
-                checkpoint_dir,
-                {"params": self.params, "opt_state": self.opt_state},
-            )
+            try:
+                loaded = ckpt.load_latest(
+                    checkpoint_dir,
+                    {"params": self.params, "opt_state": self.opt_state},
+                )
+            except (ValueError, TypeError) as exc:
+                raise ValueError(
+                    "checkpoint resume failed: the saved optimizer "
+                    "state does not match the current configuration "
+                    "(optimizer or accumulate_steps changed since the "
+                    "checkpoint was written). Re-run with resume=False "
+                    "or the original settings."
+                ) from exc
             if loaded is not None:
                 state, step, past_history = loaded
                 self.params = state["params"]
