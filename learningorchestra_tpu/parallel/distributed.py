@@ -205,7 +205,7 @@ class DistributedTrainer:
         )
 
     def _ensure_fns(self, loss_kind: str, shuffle: bool) -> None:
-        key = (loss_kind, bool(shuffle))
+        key = (loss_kind, bool(shuffle), id(self.estimator.optimizer))
         if self._epoch_fn is None or self._fn_key != key:
             self._epoch_fn, self._eval_fn = self._build(
                 loss_kind, bool(shuffle)
@@ -228,13 +228,23 @@ class DistributedTrainer:
         checkpoint_every: int = 1,
         checkpoint_min_interval_s: float = 60.0,
         resume: bool = True,
+        accumulate_steps: int = 1,
         **_,
     ) -> "DistributedTrainer":
         """Same managed in-loop checkpointing contract as the
         single-device ``NeuralEstimator.fit`` — sharded state gathers to
         host at save points (``jax.device_get``), so a preempted
-        distributed job resumes on any mesh shape."""
+        distributed job resumes on any mesh shape.
+
+        ``accumulate_steps`` mirrors the single-device knob (gradient
+        accumulation via optax.MultiSteps).  Set EXPLICITLY per fit: a
+        prior single-device fit's accumulation never leaks in — the
+        default resets to plain stepping."""
         est = self.estimator
+        # Explicit (re)configuration each fit: no silent inheritance of
+        # a wrapper left by an earlier single-device fit, and the fn
+        # cache below keys on the resulting optimizer identity.
+        est._set_accumulation(accumulate_steps)
         x = np.asarray(as_array(x))
         y_arr = np.asarray(y if not hasattr(y, "to_numpy") else y.to_numpy())
         if y_arr.ndim == 2 and y_arr.shape[1] == 1:

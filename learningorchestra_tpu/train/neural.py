@@ -457,7 +457,9 @@ class NeuralEstimator(Estimator):
 
     def _set_accumulation(self, accumulate_steps: int) -> None:
         """(Un)wrap the optimizer in optax.MultiSteps; rebuilds jitted
-        fns and optimizer state when the setting changes."""
+        fns and re-shapes optimizer state when the setting changes —
+        PRESERVING the inner optimizer's moments, so toggling
+        accumulation mid-training does not reset Adam's warmup."""
         if accumulate_steps < 1:
             raise ValueError(
                 f"accumulate_steps must be >= 1, got {accumulate_steps}"
@@ -469,6 +471,7 @@ class NeuralEstimator(Estimator):
         if base is None:
             base = self.optimizer
         self._base_optimizer = base
+        old_state, was_wrapped = self.opt_state, current > 1
         self.optimizer = base if accumulate_steps == 1 else \
             optax.MultiSteps(base, every_k_schedule=accumulate_steps)
         self._accumulate_steps = accumulate_steps
@@ -476,8 +479,19 @@ class NeuralEstimator(Estimator):
         self._eval_fn = None
         self._device_epoch = None
         self._device_epoch_key = None
-        if self.params is not None:
-            self.opt_state = jax.jit(self.optimizer.init)(self.params)
+        if self.params is None:
+            return
+        if accumulate_steps == 1:
+            # Unwrap: the inner state IS the plain optimizer's state.
+            self.opt_state = old_state.inner_opt_state if was_wrapped \
+                else old_state
+        else:
+            new_state = jax.jit(self.optimizer.init)(self.params)
+            inner = old_state.inner_opt_state if was_wrapped \
+                else old_state
+            if inner is not None:
+                new_state = new_state._replace(inner_opt_state=inner)
+            self.opt_state = new_state
 
     def _build_step(self, loss_kind: str):
         dtype = jnp.bfloat16 if self.compute_dtype == "bfloat16" else None
@@ -711,10 +725,15 @@ class NeuralEstimator(Estimator):
             "params": jax.device_get(self.params),
             "opt_state": jax.device_get(self.opt_state),
             "history": dict(self.history),
+            "accumulate_steps": getattr(self, "_accumulate_steps", 1),
         }
 
     def load_state_dict(self, state: dict) -> None:
         self.params = state["params"]
+        # Restore the accumulation wrapper FIRST so the optimizer and
+        # the restored opt_state structure agree (a MultiSteps state
+        # under a plain optimizer crashes deep inside the jitted scan).
+        self._set_accumulation(state.get("accumulate_steps", 1))
         self.opt_state = state["opt_state"]
         self.history = TrainHistory(state.get("history", {}))
 

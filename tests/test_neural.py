@@ -545,3 +545,72 @@ def test_compile_resets_accumulation():
     # Accumulated fit after compile wraps the NEW optimizer.
     est.fit(x, y, epochs=1, batch_size=4, accumulate_steps=2, verbose=0)
     assert np.isfinite(est.history["loss"][-1])
+
+
+def test_accumulation_preserves_adam_moments():
+    """Toggling accumulate_steps between fits keeps the inner
+    optimizer's moments (no silent warmup reset)."""
+    import jax
+
+    from learningorchestra_tpu.models.mlp import MLPClassifier
+
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((16, 4)).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int32)
+    est = MLPClassifier(hidden_layer_sizes=[4], num_classes=2)
+    est.fit(x, y, epochs=2, batch_size=4, accumulate_steps=2, verbose=0)
+    inner_mu = jax.tree_util.tree_leaves(
+        est.opt_state.inner_opt_state[0].mu
+    )
+    est._set_accumulation(1)
+    plain_mu = jax.tree_util.tree_leaves(est.opt_state[0].mu)
+    for a, b in zip(inner_mu, plain_mu):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # And wrapping again seeds the inner state from the plain moments.
+    est._set_accumulation(4)
+    rewrapped = jax.tree_util.tree_leaves(
+        est.opt_state.inner_opt_state[0].mu
+    )
+    for a, b in zip(plain_mu, rewrapped):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_accumulation_state_dict_roundtrip():
+    """state_dict carries accumulate_steps so a fresh estimator can
+    load and keep fitting without an opt-state structure mismatch."""
+    from learningorchestra_tpu.models.mlp import MLPClassifier
+
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((16, 4)).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int32)
+    est = MLPClassifier(hidden_layer_sizes=[4], num_classes=2)
+    est.fit(x, y, epochs=1, batch_size=4, accumulate_steps=2, verbose=0)
+    state = est.state_dict()
+
+    est2 = MLPClassifier(hidden_layer_sizes=[4], num_classes=2)
+    est2.load_state_dict(state)
+    est2.fit(x, y, epochs=1, batch_size=4, accumulate_steps=2, verbose=0)
+    assert np.isfinite(est2.history["loss"][-1])
+
+
+def test_distributed_fit_resets_accumulation():
+    """A DistributedTrainer fit does not inherit a wrapper left by an
+    earlier single-device accumulated fit."""
+    from learningorchestra_tpu.models.mlp import MLPClassifier
+    from learningorchestra_tpu.parallel import (
+        DistributedTrainer,
+        MeshSpec,
+        build_mesh,
+    )
+
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((32, 4)).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int32)
+    est = MLPClassifier(hidden_layer_sizes=[4], num_classes=2)
+    est.fit(x, y, epochs=1, batch_size=8, accumulate_steps=4, verbose=0)
+    assert est._accumulate_steps == 4
+
+    tr = DistributedTrainer(est, mesh=build_mesh(MeshSpec(dp=8)))
+    tr.fit(x, y, epochs=1, batch_size=8)
+    assert est._accumulate_steps == 1  # explicit default, no leak
+    assert np.isfinite(tr.history["loss"][-1])
