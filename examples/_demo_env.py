@@ -1,0 +1,21 @@
+"""Shared bootstrap for the runnable demos: make the repo importable
+and keep a CPU demo from blocking on an unreachable TPU plugin."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # Site-registered TPU plugins can override JAX_PLATFORMS; drop the
+    # factory so a CPU demo never blocks on an unreachable accelerator.
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    if not _xb._backends:
+        _xb._backend_factories.pop("axon", None)
+        jax.config.update("jax_platforms", "cpu")

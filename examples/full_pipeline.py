@@ -15,24 +15,13 @@ artifact named and re-runnable (PATCH).
 from __future__ import annotations
 
 import os
-import sys
 import tempfile
 
+try:  # repo path + CPU-demo plugin guard, for both invocation styles
+    import _demo_env  # noqa: F401  (python examples/<name>.py)
+except ImportError:
+    from examples import _demo_env  # noqa: F401  (python -m examples.<name>)
 import numpy as np
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__)
-)))
-
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    # Site-registered TPU plugins can override JAX_PLATFORMS; drop the
-    # factory so a CPU demo never blocks on an unreachable accelerator.
-    import jax
-    import jax._src.xla_bridge as _xb
-
-    if not _xb._backends:
-        _xb._backend_factories.pop("axon", None)
-        jax.config.update("jax_platforms", "cpu")
 
 
 def main() -> None:

@@ -366,8 +366,16 @@ class GreedyDecodeMixin:
         fns = getattr(self, "_decode_fns", None)
         if fns is None:
             fns = self._decode_fns = {}
-        entry = fns.get((bsz, total, t0, sample, top_k))
-        if entry is None:
+        key = (bsz, total, t0, sample, top_k)
+        entry = fns.get(key)
+        if entry is not None:
+            fns[key] = fns.pop(key)  # refresh recency (LRU, not FIFO)
+        else:
+            if len(fns) >= 8:
+                # Bound the compiled-scan cache: varied prompt shapes
+                # in a long-lived server must not accumulate
+                # executables without limit (FIFO eviction).
+                fns.pop(next(iter(fns)))
             decode_mod = self.module.clone(decode=True)
             # Cache shapes via eval_shape (no real forward, no
             # throwaway params); the trained params drive the scan.
@@ -422,9 +430,7 @@ class GreedyDecodeMixin:
                 )
                 return buf
 
-            entry = fns[(bsz, total, t0, sample, top_k)] = (
-                jax.jit(decode), cache_shapes
-            )
+            entry = fns[key] = (jax.jit(decode), cache_shapes)
 
         decode, cache_shapes = entry
         cache0 = jax.tree_util.tree_map(

@@ -205,7 +205,10 @@ class DistributedTrainer:
         )
 
     def _ensure_fns(self, loss_kind: str, shuffle: bool) -> None:
-        key = (loss_kind, bool(shuffle), id(self.estimator.optimizer))
+        # _opt_version (not id(optimizer)): object ids can be reused
+        # after GC, which would silently serve a stale compiled step.
+        key = (loss_kind, bool(shuffle),
+               getattr(self.estimator, "_opt_version", 0))
         if self._epoch_fn is None or self._fn_key != key:
             self._epoch_fn, self._eval_fn = self._build(
                 loss_kind, bool(shuffle)

@@ -377,6 +377,15 @@ class NeuralEstimator(Estimator):
 
     # -- keras-compile parity -------------------------------------------------
 
+    def _invalidate_jit(self) -> None:
+        """Drop every compiled closure; the next fit/evaluate re-jits
+        against the current module/optimizer/loss configuration."""
+        self._step_fn = None
+        self._eval_fn = None
+        self._device_epoch = None
+        self._device_epoch_key = None
+        self._opt_version = getattr(self, "_opt_version", 0) + 1
+
     def compile(self, optimizer=None, loss: str | None = None, **_) -> None:
         """Reconfigure optimizer/loss — the reference's ``compile_code``
         contract, declaratively (train_function.py:75-82)."""
@@ -390,10 +399,7 @@ class NeuralEstimator(Estimator):
                 self.opt_state = jax.jit(self.optimizer.init)(self.params)
         if loss is not None:
             self.loss = loss
-        self._step_fn = None  # force re-jit with new config
-        self._eval_fn = None
-        self._device_epoch = None
-        self._device_epoch_key = None
+        self._invalidate_jit()
 
     # -- loss -----------------------------------------------------------------
 
@@ -475,10 +481,7 @@ class NeuralEstimator(Estimator):
         self.optimizer = base if accumulate_steps == 1 else \
             optax.MultiSteps(base, every_k_schedule=accumulate_steps)
         self._accumulate_steps = accumulate_steps
-        self._step_fn = None
-        self._eval_fn = None
-        self._device_epoch = None
-        self._device_epoch_key = None
+        self._invalidate_jit()
         if self.params is None:
             return
         if accumulate_steps == 1:
