@@ -33,6 +33,7 @@ without the mesh — the oracle the tests pin the schedule against.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any
 
 import jax
@@ -294,21 +295,26 @@ class PipelinedTransformer:
             lambda k: self._stage.init(k, h0, km0)
         )(jax.random.split(k1, self.pp))
         hp = self._head.init(k2, h0)
-        # Placement: embed/head replicated, stage stack over pp.
-        mesh = self.mesh
-        rep = NamedSharding(mesh, P())
+        self.params = self._place_params((ep, sp, hp))
+        self.opt_state = jax.jit(
+            self.optimizer.init,
+        )(self.params)
+
+    def _place_params(self, params: tuple) -> tuple:
+        """Placement: embed/head replicated, stage stack over pp."""
+        ep, sp, hp = params
+        rep = NamedSharding(self.mesh, P())
         stage_sh = jax.tree_util.tree_map(
-            lambda l: NamedSharding(mesh, P("pp", *[None] * (l.ndim - 1))),
+            lambda l: NamedSharding(
+                self.mesh, P("pp", *[None] * (l.ndim - 1))
+            ),
             sp,
         )
-        self.params = (
+        return (
             jax.device_put(ep, rep),
             jax.tree_util.tree_map(jax.device_put, sp, stage_sh),
             jax.device_put(hp, rep),
         )
-        self.opt_state = jax.jit(
-            self.optimizer.init,
-        )(self.params)
 
     # -- jitted step ----------------------------------------------------------
 
@@ -359,7 +365,18 @@ class PipelinedTransformer:
     # -- keras-fit surface ----------------------------------------------------
 
     def fit(self, x, y, epochs: int = 1, batch_size: int = 32,
-            shuffle: bool = True, verbose: int = 0, **_):
+            shuffle: bool = True, verbose: int = 0,
+            checkpoint_dir: str | None = None,
+            checkpoint_every: int = 1,
+            checkpoint_min_interval_s: float = 60.0,
+            resume: bool = True, **_):
+        """Same managed in-loop checkpointing contract as
+        ``NeuralEstimator.fit``: with ``checkpoint_dir`` set the
+        (stage-stacked) state persists every ``checkpoint_every``
+        epochs via the shard-aware orbax helper — sharded stage params
+        save without a host gather — and an interrupted fit resumes
+        from the newest checkpoint (the preemption story, SURVEY §5.4).
+        """
         x = np.asarray(x)
         y = np.asarray(y).astype(np.int32)
         # Global batch must split into n_micro microbatches that split
@@ -371,9 +388,52 @@ class PipelinedTransformer:
             self._init_params(jnp.asarray(x[:1]))
         if self._step is None:
             self._build()
+
+        start_epoch = 0
+        if checkpoint_dir and resume:
+            from learningorchestra_tpu.train import checkpoint as ckpt
+
+            loaded = ckpt.resume_or_none(
+                checkpoint_dir,
+                {"params": self.params, "opt_state": self.opt_state},
+            )
+            if loaded is not None:
+                state, step, past_history = loaded
+                # Re-place onto the pipeline shardings: orbax restores
+                # each leaf to the TEMPLATE leaf's placement, and
+                # scalar optimizer counts can come back single-device,
+                # which jit rejects against mesh-placed params.
+                self.params = self._place_params(state["params"])
+                fresh = jax.jit(self.optimizer.init)(self.params)
+                mesh_devices = set(self.mesh.devices.flat)
+
+                def _sh(f):
+                    sh = getattr(f, "sharding", None)
+                    if sh is not None and \
+                            set(sh.device_set) == mesh_devices:
+                        return sh
+                    # Scalar leaves (adam's count) come off the init
+                    # jit on one device; replicate them on the mesh.
+                    return NamedSharding(self.mesh, P())
+
+                self.opt_state = jax.tree_util.tree_map(
+                    lambda r, f: jax.device_put(r, _sh(f)),
+                    state["opt_state"], fresh,
+                )
+                self.history = TrainHistory(past_history)
+                start_epoch = step
+
+        from learningorchestra_tpu.train import checkpoint as ckpt_mod
+
+        last_save = time.monotonic()
         rng = np.random.default_rng(self.seed)
         n = len(x)
-        for _ in range(epochs):
+        if shuffle:
+            # Burn the completed epochs' draws so a resumed run
+            # shuffles exactly as the original would at this epoch.
+            for _ in range(start_epoch):
+                rng.permutation(n)
+        for epoch_i in range(start_epoch, epochs):
             order = rng.permutation(n) if shuffle else np.arange(n)
             epoch_metrics = []
             for lo in range(0, n, batch_size):
@@ -401,6 +461,17 @@ class PipelinedTransformer:
             if verbose:
                 print(f"pipeline epoch: {self.history['loss'][-1]:.4f}",
                       flush=True)
+            if checkpoint_dir and ckpt_mod.should_save(
+                epoch_i, epochs, checkpoint_every,
+                checkpoint_min_interval_s, last_save,
+            ):
+                ckpt_mod.save(
+                    checkpoint_dir, epoch_i + 1,
+                    {"params": self.params,
+                     "opt_state": self.opt_state},
+                    history=dict(self.history),
+                )
+                last_save = time.monotonic()
         return self
 
     _CHUNK = 512  # inference batch: fixed shape -> one compile

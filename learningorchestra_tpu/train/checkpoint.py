@@ -122,3 +122,40 @@ def load_latest(directory: str | Path, template: dict):
     with _checkpointer() as ck:
         state = ck.restore(path, template)
     return state, step, marker.get("history") or {}
+
+
+def resume_or_none(directory, template: dict):
+    """``load_latest`` with configuration-mismatch errors translated to
+    an actionable message — the shared resume front door for every fit
+    surface (NeuralEstimator, PipelinedTransformer, DistributedTrainer
+    uses load_latest directly with a mesh template)."""
+    try:
+        return load_latest(directory, template)
+    except (ValueError, TypeError) as exc:
+        raise ValueError(
+            "checkpoint resume failed: the saved state does not match "
+            "the current configuration (model, optimizer, or "
+            "accumulate_steps changed since the checkpoint was "
+            "written). Re-run with resume=False or the original "
+            "settings."
+        ) from exc
+
+
+def should_save(epoch_i: int, epochs: int, every: int,
+                min_interval_s: float, last_save: float) -> bool:
+    """One save policy for every fit loop: periodic saves every
+    ``every`` epochs (``every <= 0`` disables checkpointing entirely)
+    throttled to one per ``min_interval_s`` (fast epochs on big models
+    must not stall the loop on full-state transfers); the FINAL epoch
+    always saves when checkpointing is enabled."""
+    import time as _time
+
+    if every <= 0:
+        return False
+    return (
+        epoch_i + 1 == epochs
+        or (
+            (epoch_i + 1) % every == 0
+            and _time.monotonic() - last_save >= min_interval_s
+        )
+    )

@@ -595,25 +595,18 @@ class NeuralEstimator(Estimator):
         if checkpoint_dir and resume:
             from learningorchestra_tpu.train import checkpoint as ckpt
 
-            try:
-                loaded = ckpt.load_latest(
-                    checkpoint_dir,
-                    {"params": self.params, "opt_state": self.opt_state},
-                )
-            except (ValueError, TypeError) as exc:
-                raise ValueError(
-                    "checkpoint resume failed: the saved optimizer "
-                    "state does not match the current configuration "
-                    "(optimizer or accumulate_steps changed since the "
-                    "checkpoint was written). Re-run with resume=False "
-                    "or the original settings."
-                ) from exc
+            loaded = ckpt.resume_or_none(
+                checkpoint_dir,
+                {"params": self.params, "opt_state": self.opt_state},
+            )
             if loaded is not None:
                 state, step, past_history = loaded
                 self.params = state["params"]
                 self.opt_state = state["opt_state"]
                 self.history = TrainHistory(past_history)
                 start_epoch = step
+
+        from learningorchestra_tpu.train import checkpoint as ckpt_mod
 
         params, opt_state = self.params, self.opt_state
         last_save = time.monotonic()
@@ -648,14 +641,9 @@ class NeuralEstimator(Estimator):
                 )
                 metrics.update({f"val_{k}": v for k, v in vmetrics.items()})
             self.history.append(metrics)
-            final = epoch_i + 1 == epochs
-            if checkpoint_dir and checkpoint_every > 0 and (
-                final
-                or (
-                    (epoch_i + 1) % checkpoint_every == 0
-                    and time.monotonic() - last_save
-                    >= checkpoint_min_interval_s
-                )
+            if checkpoint_dir and ckpt_mod.should_save(
+                epoch_i, epochs, checkpoint_every,
+                checkpoint_min_interval_s, last_save,
             ):
                 from learningorchestra_tpu.train import checkpoint as ckpt
 
