@@ -454,10 +454,15 @@ class PipelinedTransformer:
                 )
                 epoch_metrics.append(metrics)
             stacked = jax.device_get(epoch_metrics)
-            self.history.append({
+            epoch_row = {
                 k: float(np.mean([m[k] for m in stacked]))
                 for k in stacked[0]
-            })
+            }
+            if "perplexity" in epoch_row:  # raw CE until post-mean exp
+                epoch_row["perplexity"] = float(
+                    np.exp(epoch_row["perplexity"])
+                )
+            self.history.append(epoch_row)
             if verbose:
                 print(f"pipeline epoch: {self.history['loss'][-1]:.4f}",
                       flush=True)
@@ -518,7 +523,10 @@ class PipelinedTransformer:
             for k, v in metrics.items():
                 sums[k] = sums.get(k, 0.0) + float(v) * len(logits)
             total += len(logits)
-        return {k: v / max(total, 1) for k, v in sums.items()}
+        out = {k: v / max(total, 1) for k, v in sums.items()}
+        if "perplexity" in out:  # raw CE until post-mean exp
+            out["perplexity"] = float(np.exp(out["perplexity"]))
+        return out
 
     def predict(self, x, **_):
         x = np.asarray(x)

@@ -83,6 +83,16 @@ def _apply_with_aux(module, p, xb):
     return logits.astype(jnp.float32), aux
 
 
+def _finalize_metrics(metrics):
+    """Batch-mean the stacked per-step metrics, then apply the
+    post-reduction transforms: 'perplexity' arrives as raw per-token CE
+    and becomes exp(mean CE) — exactly exp of the reported loss."""
+    out = jax.tree_util.tree_map(jnp.mean, metrics)
+    if "perplexity" in out:
+        out["perplexity"] = jnp.exp(out["perplexity"])
+    return out
+
+
 def _param_cast_for(dtype):
     """Mixed precision, the TPU-standard way: the OPTIMIZER holds f32
     master weights; the forward/backward run on a low-precision COPY of
@@ -162,7 +172,7 @@ def _device_epoch_raw(
         (params, opt_state), metrics = jax.lax.scan(
             body, (params, opt_state), (xb, yb, mb)
         )
-        return params, opt_state, jax.tree_util.tree_map(jnp.mean, metrics)
+        return params, opt_state, _finalize_metrics(metrics)
 
     return epoch
 
@@ -268,7 +278,7 @@ def build_epoch_fns(module, optimizer, loss_fn, dtype, *, donate=False):
         (params, opt_state), metrics = jax.lax.scan(
             body, (params, opt_state), (xs, ys, ms)
         )
-        return params, opt_state, jax.tree_util.tree_map(jnp.mean, metrics)
+        return params, opt_state, _finalize_metrics(metrics)
 
     def evaluate(params, xs, ys, ms):
         params = _pcast(params)  # same numerics (and MXU rate) as train
@@ -279,7 +289,7 @@ def build_epoch_fns(module, optimizer, loss_fn, dtype, *, donate=False):
             return None, loss_fn(logits, yb, mb)[1]
 
         _, metrics = jax.lax.scan(body, None, (xs, ys, ms))
-        return jax.tree_util.tree_map(jnp.mean, metrics)
+        return _finalize_metrics(metrics)
 
     return (
         jax.jit(epoch, donate_argnums=(0, 1)) if donate else jax.jit(epoch),
@@ -327,7 +337,7 @@ def build_resident_epoch_fns(
         (params, opt_state), metrics = jax.lax.scan(
             body, (params, opt_state), order
         )
-        return params, opt_state, jax.tree_util.tree_map(jnp.mean, metrics)
+        return params, opt_state, _finalize_metrics(metrics)
 
     def evaluate(params, xs, ys, ms):
         params = _pcast(params)  # same numerics (and MXU rate) as train
@@ -338,7 +348,7 @@ def build_resident_epoch_fns(
             return None, loss_fn(logits, yb, mb)[1]
 
         _, metrics = jax.lax.scan(body, None, (xs, ys, ms))
-        return jax.tree_util.tree_map(jnp.mean, metrics)
+        return _finalize_metrics(metrics)
 
     return (
         jax.jit(epoch, donate_argnums=(0, 1)) if donate else jax.jit(epoch),
@@ -421,7 +431,8 @@ class NeuralEstimator(Estimator):
                     logits, y
                 )
                 correct = (jnp.argmax(logits, -1) == y).astype(jnp.float32)
-                if per.ndim == 2:
+                seq_out = per.ndim == 2
+                if seq_out:
                     # Sequence outputs (language models): logits
                     # (B, T, V), y (B, T) — average over NON-PAD target
                     # tokens (pad id 0, the zoo-wide convention) so a
@@ -433,7 +444,15 @@ class NeuralEstimator(Estimator):
                     correct = (correct * tok).sum(-1) / denom
                 loss = jnp.sum(per * mask) / msum
                 acc = jnp.sum(correct * mask) / msum
-                return loss, {"loss": loss, "accuracy": acc}
+                metrics = {"loss": loss, "accuracy": acc}
+                if seq_out:
+                    # Carry the RAW per-token CE here; the epoch/eval
+                    # reducers exponentiate AFTER averaging
+                    # (_finalize_metrics) — exp-then-mean would report
+                    # mean-of-exponentials (Jensen-biased upward) once
+                    # there is more than one batch.
+                    metrics["perplexity"] = loss
+                return loss, metrics
             if loss_kind == "sigmoid_ce":
                 per = optax.sigmoid_binary_cross_entropy(
                     logits[..., 0], y.astype(jnp.float32)

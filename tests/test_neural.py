@@ -614,3 +614,23 @@ def test_distributed_fit_resets_accumulation():
     tr.fit(x, y, epochs=1, batch_size=8)
     assert est._accumulate_steps == 1  # explicit default, no leak
     assert np.isfinite(tr.history["loss"][-1])
+
+
+def test_lm_history_includes_perplexity():
+    """Multi-batch on purpose: perplexity must be exp(mean CE), not the
+    Jensen-biased mean of per-batch exponentials."""
+    from learningorchestra_tpu.models.text import DecoderLM
+
+    rng = np.random.default_rng(9)
+    x = rng.integers(1, 16, (24, 6)).astype(np.int32)
+    tgt = np.concatenate([x[:, 1:], np.zeros((24, 1), np.int32)], 1)
+    est = DecoderLM(vocab_size=16, hidden_dim=16, num_layers=1,
+                    num_heads=2, max_len=8, mlp_dim=16)
+    est.fit(x, tgt, epochs=2, batch_size=8, verbose=0)
+    ppl = est.history["perplexity"]
+    assert len(ppl) == 2
+    np.testing.assert_allclose(
+        ppl, np.exp(est.history["loss"]), rtol=1e-5
+    )
+    ev = est.evaluate(x, tgt)
+    assert "perplexity" in ev and np.isfinite(ev["perplexity"])
