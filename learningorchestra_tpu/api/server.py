@@ -94,6 +94,75 @@ class Router:
         return handler(m, body, query)
 
 
+class _BoundedThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with a hard cap on connection threads.
+
+    The ``max_inflight`` semaphore bounds ADMITTED handlers, but
+    stdlib ThreadingMixIn spawns one thread per accepted connection
+    before a byte of the request is parsed — a slow-loris client
+    trickling request bodies would grow threads without bound
+    underneath the handler cap.  Beyond ``max_connections`` the
+    socket is closed immediately on accept.
+    """
+
+    daemon_threads = True
+
+    def __init__(self, addr, handler, *, max_connections: int = 256):
+        self._conn_slots = (
+            threading.BoundedSemaphore(max_connections)
+            if max_connections > 0 else None
+        )
+        super().__init__(addr, handler)
+
+    def process_request(self, request, client_address):
+        if self._conn_slots is not None and \
+                not self._conn_slots.acquire(blocking=False):
+            self.shutdown_request(request)
+            return
+        try:
+            super().process_request(request, client_address)
+        except BaseException:
+            if self._conn_slots is not None:
+                self._conn_slots.release()
+            raise
+
+    def process_request_thread(self, request, client_address):
+        try:
+            super().process_request_thread(request, client_address)
+        finally:
+            if self._conn_slots is not None:
+                self._conn_slots.release()
+
+
+class _Slot:
+    """One in-flight-request semaphore slot with shared ownership.
+
+    The gateway dispatcher and (for timed-out requests) the abandoned
+    handler worker each own a reference; the underlying semaphore slot
+    frees only when the LAST owner releases.  This is what makes the
+    ``max_inflight`` cap bound real threads: a 504'd request's zombie
+    handler keeps its slot until the handler actually returns.
+    """
+
+    def __init__(self, sem):
+        self._sem = sem
+        self._lock = threading.Lock()
+        self._owners = 1
+
+    def share(self) -> None:
+        with self._lock:
+            self._owners += 1
+
+    def release(self) -> None:
+        if self._sem is None:
+            return
+        with self._lock:
+            self._owners -= 1
+            if self._owners > 0:
+                return
+        self._sem.release()
+
+
 class APIServer:
     """Service wiring + route table + HTTP plumbing."""
 
@@ -118,7 +187,8 @@ class APIServer:
         )
 
         self.monitoring = MonitoringService(
-            _os.path.join(self.config.store.volume_path(), "_monitoring")
+            _os.path.join(self.config.store.volume_path(), "_monitoring"),
+            external_host=self.config.api.monitoring_external_host,
         )
         self.distributed = DistributedExecutorService(
             self.ctx, self.monitoring
@@ -132,6 +202,11 @@ class APIServer:
         self._cache_lock = threading.Lock()
         self._metrics: dict[str, dict] = {}
         self._metrics_lock = threading.Lock()
+        n_inflight = self.config.api.max_inflight
+        self._inflight = (
+            threading.BoundedSemaphore(n_inflight)
+            if n_inflight > 0 else None
+        )
 
     # -- helpers --------------------------------------------------------------
 
@@ -750,6 +825,39 @@ class APIServer:
         import time as _time
 
         t0 = _time.perf_counter()
+        if self._inflight is None:
+            return self._handle_admitted(
+                verb, path, body, query, t0, _Slot(None)
+            )
+        if not self._inflight.acquire(blocking=False):
+            # Saturated: shed load NOW rather than queue behind
+            # max_inflight stuck handlers (a slow-loris of long POSTs
+            # must not grow threads without bound).
+            self._record_metric("saturated", 503, 0.0)
+            return 503, {
+                "error": "gateway saturated "
+                         f"({self.config.api.max_inflight} requests "
+                         "in flight); retry with backoff"
+            }
+        return self._handle_admitted(
+            verb, path, body, query, t0, _Slot(self._inflight)
+        )
+
+    def _handle_admitted(self, verb, path, body, query, t0, slot):
+        try:
+            return self._handle_slotted(
+                verb, path, body, query, t0, slot
+            )
+        finally:
+            # The slot frees only when its LAST owner releases: for a
+            # timed-out request the worker thread co-owns it, so an
+            # abandoned handler keeps its slot until it really ends —
+            # that's what keeps zombie threads BOUNDED by the cap.
+            slot.release()
+
+    def _handle_slotted(self, verb, path, body, query, t0, slot):
+        import time as _time
+
         handler, m, route_key, flags = self.router.resolve(verb, path)
         if handler is None:
             status, payload = self.router.dispatch(verb, path, body, query)
@@ -788,8 +896,14 @@ class APIServer:
             box: dict = {}
 
             def _run():
-                box["result"] = self._handle_raw(handler, m, body, query)
+                try:
+                    box["result"] = self._handle_raw(
+                        handler, m, body, query
+                    )
+                finally:
+                    slot.release()  # holds the slot until REALLY done
 
+            slot.share()  # worker co-owns; slot frees on LAST release
             worker = threading.Thread(
                 target=_run, name="gateway-req", daemon=True
             )
@@ -868,7 +982,10 @@ class APIServer:
 
         host = host or self.config.api.host
         port = self.config.api.port if port is None else port
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd = _BoundedThreadingHTTPServer(
+            (host, port), Handler,
+            max_connections=self.config.api.max_connections,
+        )
         self._httpd.serve_forever()
 
     def start_background(self, host: str = "127.0.0.1",

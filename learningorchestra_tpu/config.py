@@ -52,10 +52,24 @@ class APIConfig:
     # Reference gateway budget: 10s timeout, 300s cache (krakend.json tail).
     request_timeout_s: float = 10.0
     cache_ttl_s: float = 300.0
+    # Concurrency caps: the reference gateway bounds work with its
+    # worker pool; here every ADMITTED handler holds a semaphore slot
+    # and saturation answers 503 immediately (backpressure instead of
+    # unbounded per-request threads), while ``max_connections`` caps
+    # raw connection threads underneath (a slow-loris trickling bodies
+    # never reaches the handler cap).  <=0 disables either cap.
+    max_inflight: int = 64
+    max_connections: int = 256
     # GET pagination cap (reference: database_api_image/constants.py:42-44).
     page_limit_max: int = 100
     page_limit_default: int = 20
     api_prefix: str = "/api/learningOrchestra/v1"
+    # Host advertised in monitoring (TensorBoard) URLs.  The reference
+    # builds these from the box's EXTERNAL IP so a remote client can
+    # open them (binary_executor_image/utils.py:358-361); unset means
+    # bind+advertise 127.0.0.1 (local dev).  The k8s deploy sets this
+    # to the service/node address.
+    monitoring_external_host: str | None = None
 
 
 @dataclasses.dataclass
@@ -156,6 +170,10 @@ class Config:
             cfg.store.xla_cache_dir = env["LO_TPU_XLA_CACHE"]
         if "LO_TPU_API_PORT" in env:
             cfg.api.port = int(env["LO_TPU_API_PORT"])
+        if "LO_TPU_MONITORING_EXTERNAL_HOST" in env:
+            cfg.api.monitoring_external_host = (
+                env["LO_TPU_MONITORING_EXTERNAL_HOST"] or None
+            )
         if "LO_TPU_MAX_WORKERS" in env:
             cfg.jobs.max_workers = int(env["LO_TPU_MAX_WORKERS"])
         if "LO_TPU_TASK_COORDINATOR" in env:

@@ -19,7 +19,6 @@ This engine keeps that durable contract but adds what the reference lacks
 
 from __future__ import annotations
 
-import contextlib
 import io
 import threading
 import time
@@ -27,7 +26,7 @@ import traceback
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable
 
-from learningorchestra_tpu.log import get_logger, kv
+from learningorchestra_tpu.log import capture_thread_stdout, get_logger, kv
 from learningorchestra_tpu.store import ArtifactStore
 
 logger = get_logger("jobs")
@@ -92,10 +91,16 @@ class JobEngine:
             while True:
                 meta.mark_running(name)
                 logger.info(kv(job=name, state="running", method=method))
+                # Rebound by the capture context; the empty default
+                # keeps the except-path buf.getvalue() calls safe if
+                # capture setup itself ever raises.
                 buf = io.StringIO()
                 try:
                     if capture_stdout:
-                        with contextlib.redirect_stdout(buf):
+                        # Thread-scoped: redirect_stdout would capture
+                        # every concurrent thread's prints, not this
+                        # job's (log.capture_thread_stdout docstring).
+                        with capture_thread_stdout() as buf:
                             result = fn()
                     else:
                         result = fn()

@@ -17,9 +17,12 @@ SURVEY §5.5); the logger is the live, leveled stream next to it.
 
 from __future__ import annotations
 
+import contextlib
+import io
 import logging
 import os
 import sys
+import threading
 
 _ROOT = "lo"
 _configured = False
@@ -53,3 +56,68 @@ def get_logger(component: str) -> logging.Logger:
 def kv(**fields) -> str:
     """Format key=value pairs consistently for log lines."""
     return " ".join(f"{k}={v}" for k, v in fields.items())
+
+
+class _StdoutRouter(io.TextIOBase):
+    """Per-thread stdout demultiplexer.
+
+    ``contextlib.redirect_stdout`` swaps ``sys.stdout`` PROCESS-wide:
+    in the multithreaded job engine a captured job steals every other
+    thread's prints for its duration (including the embedding
+    application's).  The router keeps one real stream and sends each
+    write to the calling thread's registered buffer, if any.
+    """
+
+    def __init__(self, real):
+        self.real = real
+        self.buffers: dict[int, io.StringIO] = {}
+
+    def write(self, s):  # hot path: one dict probe
+        return self.buffers.get(
+            threading.get_ident(), self.real
+        ).write(s)
+
+    def flush(self):
+        self.buffers.get(threading.get_ident(), self.real).flush()
+
+    def writable(self):
+        return True
+
+
+_router_lock = threading.Lock()
+
+
+@contextlib.contextmanager
+def capture_thread_stdout():
+    """Capture THIS thread's stdout into a StringIO; other threads keep
+    printing to the real stream.  Yields the buffer.
+
+    Installs the router on ``sys.stdout`` on first use and uninstalls
+    when the last capture exits, so test harnesses that swap stdout
+    themselves (pytest capsys) see their own stream between jobs.
+
+    Scope trade-off: only the registering thread is captured — prints
+    from threads a job spawns internally pass through to the real
+    stream.  The process-wide alternative mis-attributes EVERY
+    concurrent thread's output to whichever job holds the redirect,
+    which is strictly worse in a threaded job engine.
+    """
+    buf = io.StringIO()
+    tid = threading.get_ident()
+    with _router_lock:
+        router = sys.stdout
+        if not isinstance(router, _StdoutRouter):
+            router = _StdoutRouter(sys.stdout)
+            sys.stdout = router
+        prev = router.buffers.get(tid)  # nesting: restore on exit
+        router.buffers[tid] = buf
+    try:
+        yield buf
+    finally:
+        with _router_lock:
+            if prev is not None:
+                router.buffers[tid] = prev
+            else:
+                router.buffers.pop(tid, None)
+            if not router.buffers and sys.stdout is router:
+                sys.stdout = router.real

@@ -357,6 +357,14 @@ class GreedyDecodeMixin:
             sample, top_k = False, None
         prompts = np.asarray(prompts, dtype=np.int32)
         bsz, t0 = prompts.shape
+        if t0 > self.max_len:
+            # Without this, total < t0 below and the buffer scatter
+            # fails with an opaque shape-broadcast trace error.
+            raise ValueError(
+                f"prompt length {t0} exceeds max_len={self.max_len}; "
+                "truncate the prompt or build the model with a larger "
+                "max_len"
+            )
         total = min(self.max_len, t0 + max_new_tokens)
 
         # One (jitted scan, cache shapes) pair per prompt shape, cached
@@ -374,7 +382,8 @@ class GreedyDecodeMixin:
             if len(fns) >= 8:
                 # Bound the compiled-scan cache: varied prompt shapes
                 # in a long-lived server must not accumulate
-                # executables without limit (FIFO eviction).
+                # executables without limit (LRU eviction — hits above
+                # refresh recency, so the front is least-recent).
                 fns.pop(next(iter(fns)))
             decode_mod = self.module.clone(decode=True)
             # Cache shapes via eval_shape (no real forward, no

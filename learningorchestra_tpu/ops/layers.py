@@ -198,19 +198,21 @@ class MultiHeadSelfAttention(nn.Module):
                     cv.value, v, (0, 0, idx, 0)
                 )
                 ci.value = idx + t
-                # The caller's key_mask covers the whole buffer (False
-                # beyond the current position), so causality is already
-                # in the mask; flash brings nothing for T_q == 1
-                # queries.  The sliding window is enforced HERE — the
-                # layer owns the invariant — not by each decode loop.
+                # Causality is enforced HERE — the layer owns
+                # cache_index, so it ANDs a validity mask (slots beyond
+                # the just-written position are zero-initialized cache,
+                # not real keys) into whatever key_mask the caller
+                # passed, including none at all.  Flash brings nothing
+                # for T_q == 1 queries.  The sliding window is likewise
+                # the layer's invariant, not each decode loop's.
+                tk_cache = ck.value.shape[2]
+                slot = jnp.arange(tk_cache)[None, :]
+                valid = slot <= idx
                 if self.window is not None:
-                    tk_cache = ck.value.shape[2]
-                    win = jnp.arange(tk_cache)[None, :] > (
-                        idx - self.window
-                    )
-                    key_mask = win if key_mask is None else (
-                        key_mask & win
-                    )
+                    valid = valid & (slot > (idx - self.window))
+                key_mask = valid if key_mask is None else (
+                    key_mask & valid
+                )
                 out = _grouped_decode_attend(
                     q, ck.value, cv.value, key_mask
                 )

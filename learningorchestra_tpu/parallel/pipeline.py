@@ -380,7 +380,8 @@ class PipelinedTransformer:
         x = np.asarray(x)
         y = np.asarray(y).astype(np.int32)
         # Global batch must split into n_micro microbatches that split
-        # over dp; round it up to the nearest legal multiple.
+        # over dp; round it DOWN to the nearest legal multiple (never
+        # below one quantum) so the effective batch fits the request.
         dp = self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
         quantum = self.n_micro * dp
         batch_size = max(quantum, (batch_size // quantum) * quantum)

@@ -384,7 +384,6 @@ def ring_flash_attention(
     ha = head_axis if head_axis and mesh.shape.get(head_axis, 1) > 1 else None
     qkv_spec = P(batch_axes, axis_name, ha, None)
     mask_spec = P(batch_axes, axis_name)
-    varying = tuple(batch_axes) + (axis_name,) + ((ha,) if ha else ())
     b, t, h_, d = q.shape
     sp = mesh.shape.get(axis_name, 1)
     if t % sp:

@@ -16,10 +16,8 @@ own cluster, not a public service).
 
 from __future__ import annotations
 
-import contextlib
-import io
-
 from learningorchestra_tpu import dsl
+from learningorchestra_tpu.log import capture_thread_stdout
 from learningorchestra_tpu.services.context import (
     ServiceContext,
     ValidationError,
@@ -84,8 +82,10 @@ class FunctionService:
             )
             globs: dict = {"__name__": f"function_{name}"}
             globs.update(params)
-            buf = io.StringIO()
-            with contextlib.redirect_stdout(buf):
+            # Thread-scoped capture: redirect_stdout would swap stdout
+            # for the WHOLE process, stealing concurrent jobs' (and the
+            # server's own) prints into this job's document.
+            with capture_thread_stdout() as buf:
                 exec(code, globs)  # noqa: S102 — the documented escape hatch
             if "response" not in globs:
                 raise ValidationError(

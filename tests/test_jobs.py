@@ -135,3 +135,57 @@ def test_xla_compilation_cache_configured(tmp_path):
         # Global jax config: restore so later tests don't write compile
         # cache entries into this (deleted) tmp dir.
         jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def test_stdout_capture_is_thread_scoped():
+    """A captured job must not steal other threads' prints (found by
+    the round-3 end-to-end drive: the main thread's output vanished
+    into a concurrent function job's document while it ran)."""
+    import sys
+    import threading
+
+    from learningorchestra_tpu.log import capture_thread_stdout
+
+    real = sys.stdout
+    gate = threading.Event()
+    done = threading.Event()
+    out = {}
+
+    def runner():
+        with capture_thread_stdout() as buf:
+            print("job line")
+            gate.set()
+            done.wait(5)
+        out["captured"] = buf.getvalue()
+
+    t = threading.Thread(target=runner)
+    t.start()
+    assert gate.wait(5)
+    # While the job is captured, an UNREGISTERED thread's writes pass
+    # through to the real stream — they must not land in the buffer.
+    assert sys.stdout is not real  # router installed
+    sys.stdout.write("main line\n")
+    done.set()
+    t.join(5)
+    assert out["captured"] == "job line\n"
+    # Router uninstalled after the last capture exits.
+    assert sys.stdout is real
+
+
+def test_stdout_capture_nests():
+    """Nested captures on one thread restore the outer buffer when the
+    inner exits (code-review r3: the first cut popped the registration
+    outright, silently truncating the outer capture)."""
+    import sys
+
+    from learningorchestra_tpu.log import capture_thread_stdout
+
+    real = sys.stdout
+    with capture_thread_stdout() as outer:
+        print("a")
+        with capture_thread_stdout() as inner:
+            print("b")
+        print("c")
+    assert outer.getvalue() == "a\nc\n"
+    assert inner.getvalue() == "b\n"
+    assert sys.stdout is real

@@ -634,3 +634,18 @@ def test_lm_history_includes_perplexity():
     )
     ev = est.evaluate(x, tgt)
     assert "perplexity" in ev and np.isfinite(ev["perplexity"])
+
+
+def test_generate_rejects_overlong_prompt():
+    """ADVICE r2: a prompt longer than max_len must raise a clear
+    ValueError up front, not an opaque shape-broadcast trace error
+    (RoPE models advertise extrapolation, making this easy to hit)."""
+    from learningorchestra_tpu.models.text import DecoderLM
+
+    est = DecoderLM(
+        vocab_size=32, hidden_dim=32, num_layers=1, num_heads=2,
+        max_len=8,
+    )
+    x = np.ones((1, 12), np.int32)
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        est.generate(x, max_new_tokens=4)

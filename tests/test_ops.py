@@ -315,3 +315,72 @@ class TestSlidingWindowAttention:
         assert _win_k_slots(8, 8, 10_000, 4) == 4
         # Tiny window: 2-3 blocks regardless of T.
         assert _win_k_slots(8, 8, 1, 1024) == 2
+
+
+class TestDecodeStandaloneValidity:
+    def test_decode_without_key_mask_matches_causal_forward(self):
+        """ADVICE r2: decode mode with key_mask=None must not hand
+        probability mass to uninitialized (zero) cache slots.  The
+        layer owns cache_index, so it ANDs the validity mask itself —
+        the documented init-then-feed-one-token flow is correct
+        standalone, no caller-side mask required."""
+        from learningorchestra_tpu.ops.layers import MultiHeadSelfAttention
+
+        b, t, f = 2, 6, 8
+        rng = np.random.default_rng(11)
+        x = jnp.asarray(rng.standard_normal((b, t, f)), jnp.float32)
+
+        full = MultiHeadSelfAttention(
+            num_heads=2, qkv_features=f, causal=True, use_flash=False
+        )
+        variables = full.init(jax.random.PRNGKey(0), x)
+        ref = full.apply(variables, x)
+
+        dec = MultiHeadSelfAttention(num_heads=2, qkv_features=f, decode=True)
+        # Same submodule names -> the causal model's params drive the
+        # decode module; init on the full-length input sizes the cache.
+        cache = dec.init(jax.random.PRNGKey(0), x)["cache"]
+        outs = []
+        for i in range(t):
+            out, mut = dec.apply(
+                {"params": variables["params"], "cache": cache},
+                x[:, i:i + 1], mutable=["cache"],
+            )
+            cache = mut["cache"]
+            outs.append(out)
+        got = jnp.concatenate(outs, axis=1)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_decode_window_without_key_mask(self):
+        """Same standalone guarantee for sliding-window decode: the
+        window narrowing composes with the validity mask."""
+        from learningorchestra_tpu.ops.layers import MultiHeadSelfAttention
+
+        b, t, f, w = 2, 8, 8, 3
+        rng = np.random.default_rng(12)
+        x = jnp.asarray(rng.standard_normal((b, t, f)), jnp.float32)
+
+        full = MultiHeadSelfAttention(
+            num_heads=2, qkv_features=f, causal=True, window=w,
+            use_flash=False,
+        )
+        variables = full.init(jax.random.PRNGKey(0), x)
+        ref = full.apply(variables, x)
+
+        dec = MultiHeadSelfAttention(
+            num_heads=2, qkv_features=f, decode=True, causal=True,
+            window=w,
+        )
+        cache = dec.init(jax.random.PRNGKey(0), x)["cache"]
+        outs = []
+        for i in range(t):
+            out, mut = dec.apply(
+                {"params": variables["params"], "cache": cache},
+                x[:, i:i + 1], mutable=["cache"],
+            )
+            cache = mut["cache"]
+            outs.append(out)
+        got = jnp.concatenate(outs, axis=1)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
