@@ -275,7 +275,21 @@ class APIServer:
             if not url:
                 raise ValidationError("missing 'url'")
             if kind == "csv":
-                meta = self.dataset.create_csv(name, url)
+                shard_rows = body.get("shardRows")
+                if shard_rows is not None:
+                    try:
+                        shard_rows = int(shard_rows)
+                    except (TypeError, ValueError):
+                        raise ValidationError(
+                            "'shardRows' must be a positive integer"
+                        ) from None
+                    if shard_rows <= 0:
+                        raise ValidationError(
+                            "'shardRows' must be a positive integer"
+                        )
+                meta = self.dataset.create_csv(
+                    name, url, shard_rows=shard_rows
+                )
             else:
                 meta = self.dataset.create_generic(name, url)
             return self._created(f"dataset/{kind}", meta)
@@ -746,6 +760,48 @@ class APIServer:
 
         # Deliberate long-poll: exempt from the gateway deadline.
         add("GET", r"/observe/" + NAME, observe_wait, no_timeout=True)
+
+        # ---- Observe push (webhooks on state transitions) ----
+        def webhook_register(m, body, query):
+            name = m.group("name")
+            meta = self.ctx.require_existing(name)
+            try:
+                hook = self.ctx.webhooks.register(
+                    name, body.get("url"), body.get("events")
+                )
+            except ValueError as exc:
+                raise ValidationError(str(exc)) from None
+            # Registration raced the job: if the artifact is ALREADY
+            # terminal, the engine's completion path has fired and
+            # will never fire again — deliver now instead of leaving
+            # the client waiting forever.
+            event = None
+            if meta.get("jobState") == "failed":
+                event = "failed"
+            elif meta.get("finished"):
+                event = "finished"
+            if event is not None and event in hook["events"]:
+                self.ctx.webhooks.notify(name, event, meta)
+                hook = {**hook, "firedImmediately": event}
+            return 201, {"result": hook}
+
+        def webhook_list(m, body, query):
+            name = m.group("name")
+            self.ctx.require_existing(name)
+            return 200, {"result": self.ctx.webhooks.list(name)}
+
+        def webhook_delete(m, body, query):
+            ok = self.ctx.webhooks.unregister(
+                m.group("name"), int(m.group("hook"))
+            )
+            if not ok:
+                return 404, {"error": "no such webhook"}
+            return 200, {"result": "deleted"}
+
+        add("POST", rf"/observe/{NAME}/webhook", webhook_register)
+        add("GET", rf"/observe/{NAME}/webhook", webhook_list)
+        add("DELETE", rf"/observe/{NAME}/webhook/(?P<hook>[0-9]+)",
+            webhook_delete)
 
         # ---- Introspection ----
         add(

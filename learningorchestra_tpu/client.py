@@ -166,11 +166,15 @@ class _Dataset(_Service):
         super().__init__(ctx)
         self.service_path = f"dataset/{kind}"
 
-    def insert(self, dataset_name: str, url: str) -> dict:
-        return self.ctx.request(
-            "POST", f"/{self.service_path}",
-            {"datasetName": dataset_name, "url": url},
-        )
+    def insert(self, dataset_name: str, url: str,
+               shard_rows: int | None = None) -> dict:
+        """``shard_rows`` switches to sharded (beyond-host-RAM) ingest:
+        rows land in columnar volume shards the training paths stream
+        (store/sharded.py)."""
+        body = {"datasetName": dataset_name, "url": url}
+        if shard_rows is not None:
+            body["shardRows"] = int(shard_rows)
+        return self.ctx.request("POST", f"/{self.service_path}", body)
 
     def list(self) -> list[dict]:
         return self.ctx.request("GET", f"/{self.service_path}")
@@ -489,10 +493,33 @@ class _Monitoring:
 
 class _Observe:
     """The reference's separate Observe service (collection watch,
-    README.md:71) — here a server-side long poll."""
+    README.md:71) — a server-side long poll (``wait``) plus push
+    webhooks on state transitions (``webhook``/``webhooks``/
+    ``unwatch``)."""
 
     def __init__(self, ctx: Context):
         self.ctx = ctx
 
     def wait(self, name: str, timeout: float = 120.0) -> dict:
         return _wait(self.ctx, name, timeout)
+
+    def webhook(self, name: str, url: str,
+                events: list | None = None) -> dict:
+        """Register ``url`` to be POSTed ``{"name", "event",
+        "metadata"}`` when ``name`` finishes or fails."""
+        body = {"url": url}
+        if events is not None:
+            body["events"] = list(events)
+        return self.ctx.request(
+            "POST", f"/observe/{name}/webhook", body
+        )["result"]
+
+    def webhooks(self, name: str) -> list:
+        return self.ctx.request(
+            "GET", f"/observe/{name}/webhook"
+        )["result"]
+
+    def unwatch(self, name: str, hook_id: int) -> None:
+        self.ctx.request(
+            "DELETE", f"/observe/{name}/webhook/{hook_id}"
+        )

@@ -59,6 +59,20 @@ class JobEngine:
         self._futures: dict[str, Future] = {}
         self._last_tracebacks: dict[str, str] = {}
         self._lock = threading.Lock()
+        # Optional push-notification sink (services/webhooks.py): set
+        # by the service context; completion paths call _notify.
+        self.notifier = None
+
+    def _notify(self, name: str, event: str) -> None:
+        """Fire artifact state-change webhooks; never raises, never
+        blocks (delivery is a daemon thread inside the notifier)."""
+        if self.notifier is None:
+            return
+        try:
+            meta = self.artifacts.metadata.read(name) or {}
+            self.notifier.notify(name, event, meta)
+        except Exception:  # noqa: BLE001 — jobs must finish regardless
+            pass
 
     # -- submission -----------------------------------------------------------
 
@@ -120,6 +134,7 @@ class JobEngine:
                     if attempts <= self.max_preemption_retries:
                         continue
                     meta.mark_failed(name, "Preempted (retries exhausted)")
+                    self._notify(name, "failed")
                     return None
                 except BaseException as exc:  # jobs must never kill workers
                     err = repr(exc)
@@ -140,6 +155,7 @@ class JobEngine:
                     # Keep the traceback reachable for debugging without
                     # crashing the pool thread.
                     self._last_tracebacks[name] = traceback.format_exc()
+                    self._notify(name, "failed")
                     return None
 
                 extra = on_success(result) if on_success else None
@@ -156,6 +172,7 @@ class JobEngine:
                     state=JobState.FINISHED,
                     stdout=buf.getvalue() if capture_stdout else None,
                 )
+                self._notify(name, "finished")
                 return result
 
         future = self.pool.submit(run)

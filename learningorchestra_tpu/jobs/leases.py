@@ -142,3 +142,19 @@ class DeviceLeaser:
                     event="release", job=label, devices=taken,
                     held=f"{t1 - t0:.2f}s",
                 ))
+
+
+def jax_device_for(device_id: str):
+    """Resolve a lease's device id ("tpu:3") back to the jax.Device —
+    the placement step: a job that leased chip k must actually RUN on
+    chip k (``jax.default_device``), not on whatever device 0 is."""
+    import jax
+
+    try:
+        platform, idx = device_id.rsplit(":", 1)
+        for d in jax.devices():
+            if d.platform == platform and d.id == int(idx):
+                return d
+    except Exception:  # noqa: BLE001 — placement is best-effort
+        return None
+    return None

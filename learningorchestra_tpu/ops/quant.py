@@ -134,3 +134,93 @@ def dequantize_rowwise(values, scales, *, interpret: bool | None = None):
         interpret=interpret,
     )(values, scales)
     return out[:n] if pad else out
+
+
+# -- quantized artifact format (pytree level) --------------------------------
+
+
+class QuantizedLeaf:
+    """Host-side container for one int8-quantized parameter tensor.
+
+    The on-disk unit of the quantized artifact format: row-wise int8
+    values + per-row f32 scales + the original shape/dtype.  Plain
+    numpy fields, so dill/pickle round-trips it without this module
+    imported at save time on the reader's side.
+    """
+
+    __slots__ = ("values", "scales", "shape", "dtype")
+
+    def __init__(self, values, scales, shape, dtype):
+        self.values = values
+        self.scales = scales
+        self.shape = tuple(shape)
+        self.dtype = str(dtype)
+
+    def __repr__(self):
+        return (f"QuantizedLeaf(shape={self.shape}, dtype={self.dtype}, "
+                f"int8+scales)")
+
+
+# Below this many elements a tensor stays full precision: biases and
+# norm scales are tiny (no footprint win) and precision-critical.
+_QUANT_MIN_ELEMENTS = 4096
+
+
+def quantize_pytree(tree, *, min_elements: int = _QUANT_MIN_ELEMENTS):
+    """int8-quantize every large float tensor of a (host) pytree.
+
+    >=2-D float leaves with at least ``min_elements`` elements become
+    :class:`QuantizedLeaf` (leading axes flattened so the row-wise
+    kernel sees 2-D); everything else passes through untouched.
+    Rounding is DETERMINISTIC (round-to-nearest): a persistence format
+    must load the same bytes every save — stochastic rounding is for
+    in-training accumulation, not artifacts.
+    """
+    import numpy as np
+
+    def leaf_fn(l):
+        arr = np.asarray(l)
+        if (
+            arr.ndim >= 2
+            and arr.size >= min_elements
+            and np.issubdtype(arr.dtype, np.floating)
+        ):
+            mat = jnp.asarray(
+                arr.astype(np.float32).reshape(-1, arr.shape[-1])
+            )
+            values, scales = quantize_rowwise(mat, stochastic=False)
+            return QuantizedLeaf(
+                np.asarray(values), np.asarray(scales),
+                arr.shape, arr.dtype,
+            )
+        return l
+
+    return jax.tree_util.tree_map(leaf_fn, tree)
+
+
+def dequantize_pytree(tree):
+    """Inverse of :func:`quantize_pytree`: QuantizedLeaf → dense array
+    in the original shape/dtype; other leaves pass through."""
+    import numpy as np
+
+    def leaf_fn(l):
+        if isinstance(l, QuantizedLeaf):
+            mat = dequantize_rowwise(
+                jnp.asarray(l.values), jnp.asarray(l.scales)
+            )
+            return np.asarray(mat).reshape(l.shape).astype(l.dtype)
+        return l
+
+    return jax.tree_util.tree_map(
+        leaf_fn, tree,
+        is_leaf=lambda x: isinstance(x, QuantizedLeaf),
+    )
+
+
+def has_quantized_leaves(tree) -> bool:
+    return any(
+        isinstance(l, QuantizedLeaf)
+        for l in jax.tree_util.tree_leaves(
+            tree, is_leaf=lambda x: isinstance(x, QuantizedLeaf)
+        )
+    )

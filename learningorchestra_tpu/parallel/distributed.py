@@ -243,6 +243,17 @@ class DistributedTrainer:
         accumulation via optax.MultiSteps).  Set EXPLICITLY per fit: a
         prior single-device fit's accumulation never leaks in — the
         default resets to plain stepping."""
+        from learningorchestra_tpu.train.neural import _is_sharded
+
+        if _is_sharded(x) or _is_sharded(y):
+            return self._fit_streaming(
+                x, y, epochs=epochs, batch_size=batch_size,
+                validation_data=validation_data, shuffle=shuffle,
+                verbose=verbose, checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every,
+                checkpoint_min_interval_s=checkpoint_min_interval_s,
+                resume=resume, accumulate_steps=accumulate_steps,
+            )
         est = self.estimator
         # Explicit (re)configuration each fit: no silent inheritance of
         # a wrapper left by an earlier single-device fit, and the fn
@@ -379,6 +390,191 @@ class DistributedTrainer:
             est.params = jax.device_get(params)
             est.opt_state = jax.device_get(opt_state)
         ran = epochs - start_epoch  # epochs executed THIS call
+        n_epochs = len(self.history.get("loss", ()))
+        for i in range(n_epochs - ran, n_epochs):
+            est.history.append(
+                {k: v[i] for k, v in self.history.items() if len(v) > i}
+            )
+        return self
+
+    def _fit_streaming(
+        self, x, y, *, epochs, batch_size, validation_data, shuffle,
+        verbose, checkpoint_dir, checkpoint_every,
+        checkpoint_min_interval_s, resume, accumulate_steps,
+    ) -> "DistributedTrainer":
+        """Shard-streaming distributed fit over a beyond-RAM dataset.
+
+        Per shard: host-side batching (fresh rng per (epoch, shard) —
+        deterministic across processes, so every host computes the SAME
+        batch composition, the multi-process invariant ``_put_global``
+        relies on), global placement over the data axes, one resident-
+        epoch call.  Shard k+1 loads and batches on an IO thread while
+        the mesh runs shard k; ``_put_global`` stays on the caller
+        thread (multi-controller collectives must issue in one order).
+        Host memory peaks at O(shard), device memory at O(shard/dp) —
+        the BASELINE config-5 shape (ResNet/ImageNet on a v4-32) that a
+        whole-dataset upload can never satisfy.  Reference contract:
+        database_api_image/database.py:86-151.
+        """
+        import concurrent.futures
+
+        from learningorchestra_tpu.store import sharded as sh
+        from learningorchestra_tpu.train.neural import _is_sharded
+
+        if _is_sharded(validation_data):
+            raise ValueError(
+                "validation_data must be in-memory arrays, not sharded "
+                "views"
+            )
+        x, y = sh.resolve_xy_views(x, y)
+
+        est = self.estimator
+        est._set_accumulation(accumulate_steps)
+        ds = x.dataset
+        y_head = np.asarray(y.head(256))
+        loss_kind = est._resolve_loss(y_head)
+        y_cast = np.int32 if loss_kind == "softmax_ce" else np.float32
+        if batch_size % self.data_axes:
+            raise ValueError(
+                f"global batch_size {batch_size} not divisible by "
+                f"dp*fsdp={self.data_axes}"
+            )
+        self._check_seq_divisible(np.asarray(x.head(1)))
+
+        def load(epoch_i: int, pos: int, k: int):
+            # IO thread: disk → host arrays → host-side batching.  The
+            # rng seeds on (epoch, shard position) so every process
+            # computes identical batch composition.
+            xs = x.load_shard(k)
+            ys = y.load_shard(k).astype(y_cast)
+            rng = (
+                np.random.default_rng(
+                    [est.seed, 7 + epoch_i, pos]
+                ) if shuffle else _NoShuffle()
+            )
+            return _batch_data(xs, ys, batch_size, rng)
+
+        start_epoch = 0
+        with self._mesh_bound():
+            if est.params is None:
+                est._init_params(
+                    jnp.asarray(np.asarray(x.head(1), np.float32))
+                )
+            self._ensure_fns(loss_kind, shuffle)
+            params, opt_state = self._place_state()
+            if checkpoint_dir and resume:
+                from learningorchestra_tpu.train import checkpoint as ckpt
+
+                loaded = ckpt.load_latest(
+                    checkpoint_dir,
+                    {"params": params, "opt_state": opt_state},
+                )
+                if loaded is not None:
+                    state, step, past_history = loaded
+                    params = state["params"]
+                    opt_state = state["opt_state"]
+                    self.history = TrainHistory(past_history)
+                    start_epoch = step
+
+            root_key = jax.random.PRNGKey(est.seed)
+            last_save = time.monotonic()
+            with concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="shard-io"
+            ) as io:
+                for epoch_i in range(start_epoch, epochs):
+                    t0 = time.perf_counter()
+                    # Same shard order on every process.
+                    order = (
+                        np.random.default_rng(
+                            [est.seed, 3, epoch_i]
+                        ).permutation(ds.n_shards)
+                        if shuffle else np.arange(ds.n_shards)
+                    )
+                    acc = sh.WeightedMetrics()
+                    nxt = io.submit(load, epoch_i, 0, int(order[0]))
+                    for pos, k in enumerate(order):
+                        xb, yb, mb = nxt.result()
+                        if pos + 1 < len(order):
+                            nxt = io.submit(
+                                load, epoch_i, pos + 1,
+                                int(order[pos + 1]),
+                            )
+                        tokens = np.issubdtype(xb.dtype, np.integer)
+                        params, opt_state, metrics = self._epoch_fn(
+                            params, opt_state,
+                            self._put_global(
+                                xb, self._data_sharding(xb.ndim, tokens)
+                            ),
+                            self._put_global(
+                                yb, self._data_sharding(yb.ndim, False)
+                            ),
+                            self._put_global(
+                                mb, self._data_sharding(mb.ndim, False)
+                            ),
+                            jax.random.fold_in(
+                                root_key, epoch_i * ds.n_shards + pos
+                            ),
+                        )
+                        acc.add(
+                            jax.device_get(metrics),
+                            ds.shard_rows[int(k)],
+                        )
+                    metrics = acc.result()
+                    dt = time.perf_counter() - t0
+                    metrics["epoch_time"] = dt
+                    metrics["samples_per_sec"] = ds.n_rows / dt
+                    if validation_data is not None:
+                        vx, vy = validation_data
+                        metrics.update({
+                            f"val_{k2}": v
+                            for k2, v in self.evaluate(
+                                vx, vy, batch_size=batch_size,
+                                _params=params,
+                            ).items()
+                        })
+                    self.history.append(metrics)
+                    final = epoch_i + 1 == epochs
+                    if checkpoint_dir and checkpoint_every > 0 and (
+                        final
+                        or (
+                            (epoch_i + 1) % checkpoint_every == 0
+                            and time.monotonic() - last_save
+                            >= checkpoint_min_interval_s
+                        )
+                    ):
+                        from learningorchestra_tpu.train import (
+                            checkpoint as ckpt,
+                        )
+
+                        ckpt.save(
+                            checkpoint_dir, epoch_i + 1,
+                            {"params": params, "opt_state": opt_state},
+                            history=dict(self.history),
+                        )
+                        last_save = time.monotonic()
+                    if verbose:
+                        from learningorchestra_tpu.log import get_logger
+
+                        get_logger("train").info(
+                            "epoch %d/%d: %s", epoch_i + 1, epochs,
+                            metrics,
+                        )
+
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            est.params = jax.tree_util.tree_map(
+                np.asarray,
+                multihost_utils.process_allgather(params, tiled=True),
+            )
+            est.opt_state = jax.tree_util.tree_map(
+                np.asarray,
+                multihost_utils.process_allgather(opt_state, tiled=True),
+            )
+        else:
+            est.params = jax.device_get(params)
+            est.opt_state = jax.device_get(opt_state)
+        ran = epochs - start_epoch
         n_epochs = len(self.history.get("loss", ()))
         for i in range(n_epochs - ran, n_epochs):
             est.history.append(

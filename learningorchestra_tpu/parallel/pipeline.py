@@ -185,6 +185,202 @@ def gpipe_loss(
     return fn
 
 
+def one_f_one_b_grads(
+    embed_apply,
+    stage_apply,
+    head_apply,
+    loss_fn,
+    *,
+    n_stages: int,
+    n_micro: int,
+    axis: str = "pp",
+):
+    """Per-device 1F1B (PipeDream-flush) pipeline step for shard_map:
+    returns ``(loss, metrics, grads)`` with the backward INTERLEAVED
+    into the schedule instead of left to ``jax.grad``.
+
+    Why it exists: under ``jax.grad``, GPipe's transpose runs as a
+    second full pass AFTER the forward loop, so every microbatch's
+    residuals stay live through the whole forward — O(n_micro)
+    activation memory per rank.  Here each microbatch's backward starts
+    the moment it leaves the pipe (last rank: same tick), so a rank
+    holds at most ``2·(pp-1-s)`` in-flight inputs — O(pp), independent
+    of n_micro.  That converts directly into bubble: at a fixed
+    activation budget the 1F1B schedule can run n_micro ≫ pp (bubble
+    → (pp-1)/(n_micro+pp-1) → 0) where GPipe's memory wall caps
+    n_micro ≈ budget.
+
+    Mechanics (all static Python loops → ONE jitted program, SPMD):
+
+    - macro tick t ∈ [0, n_micro + 2·pp - 3]; rank s forwards
+      microbatch ``t - s`` and backwards microbatch
+      ``t - 2·pp + 2 + s`` (both masked when out of range);
+    - stage inputs are saved in a (2·pp-1)-slot circular buffer; the
+      backward RE-APPLIES the stage under ``jax.vjp`` on the saved
+      input (rematerialize-in-backward — the standard TPU trade of
+      FLOPs for HBM, and what keeps the buffer a stackable tensor
+      instead of unstackable residual closures);
+    - activations ``ppermute`` right after each forward slot,
+      cotangents ``ppermute`` left after each backward slot;
+    - the last rank seeds each microbatch's cotangent from the
+      head+loss VJP at the forward-completion tick, scaled by
+      ``w_m/gw`` so the stitched gradient equals the gradient of the
+      same global masked-mean loss as :func:`gpipe_loss`.
+
+    Losses/metrics/grads are psum'd exactly as gpipe's AD would:
+    embed/head grads over (dp, fsdp, pp) (replicated out), stage grads
+    over (dp, fsdp) only (each rank owns its stage).
+    """
+    if n_micro < 1:
+        raise ValueError("n_micro must be >= 1")
+    K = max(1, 2 * n_stages - 1)  # circular input-buffer depth
+
+    def fn(eparams, sparams, hparams, xb, yb, mb):
+        sparams = jax.tree_util.tree_map(lambda l: l[0], sparams)
+        idx = lax.axis_index(axis)
+        P_ = n_stages
+        M = n_micro
+        mb_sz = xb.shape[0] // M
+        xm = xb.reshape(M, mb_sz, *xb.shape[1:])
+        ym = yb.reshape(M, mb_sz, *yb.shape[1:])
+        mm = mb.reshape(M, mb_sz)
+        key_masks = xm != 0  # (M, mb, T) pad id 0
+
+        # Global mask mass — the same normalizer gpipe's psum'd masked
+        # mean uses; known upfront so per-microbatch cotangent seeds
+        # can be scaled in-schedule.
+        gw = jnp.maximum(lax.psum(mb.sum(), ("dp", "fsdp")), 1e-9)
+
+        # Embedding forward ONCE (vmapped over microbatches), its VJP
+        # kept for the end: cotangents accumulate per microbatch as
+        # rank 0 finishes backwards.
+        emb, emb_vjp = jax.vjp(
+            lambda ep: jax.vmap(lambda tk: embed_apply(ep, tk))(xm),
+            eparams,
+        )
+
+        right = [(i, i + 1) for i in range(P_ - 1)]
+        left = [(i + 1, i) for i in range(P_ - 1)]
+        is_last = idx == P_ - 1
+        is_first = idx == 0
+
+        in_buf = jnp.zeros((K, *emb.shape[1:]), emb.dtype)
+        demb = jnp.zeros_like(emb)
+        dsparams = jax.tree_util.tree_map(jnp.zeros_like, sparams)
+        dhparams = jax.tree_util.tree_map(jnp.zeros_like, hparams)
+        recv = jnp.zeros_like(emb[0])
+        recv_cot = jnp.zeros_like(emb[0])
+        loss_acc = jnp.zeros((), jnp.float32)
+        w_acc = jnp.zeros((), jnp.float32)
+        metrics_acc = None
+
+        def stage_on(km):
+            return lambda p, xin: stage_apply(p, xin, km)
+
+        for t in range(M + 2 * P_ - 2):
+            # ---- forward slot: rank s, microbatch t - s ----
+            m_f = t - idx
+            f_valid = ((m_f >= 0) & (m_f < M)).astype(jnp.float32)
+            m_fc = jnp.clip(m_f, 0, M - 1)
+            km_f = jnp.take(key_masks, m_fc, axis=0)
+            x_in = jnp.where(is_first, emb[jnp.clip(t, 0, M - 1)], recv)
+            in_buf = in_buf.at[t % K].set(x_in)
+            out = stage_apply(sparams, x_in, km_f)
+            if right:
+                recv = lax.ppermute(out, axis, right)
+
+            # ---- last rank: head + loss + cotangent seed for the
+            # backward slot of this SAME tick (1F1B: bwd of m starts
+            # the tick its fwd completes) ----
+            y_m = jnp.take(ym, m_fc, axis=0)
+            mm_m = jnp.take(mm, m_fc, axis=0)
+
+            def head_loss(hp, h, y_m=y_m, mm_m=mm_m):
+                logits = head_apply(hp, h).astype(jnp.float32)
+                loss, metrics = loss_fn(logits, y_m, mm_m)
+                return loss, metrics
+
+            loss_m, hl_vjp, metrics_m = jax.vjp(
+                head_loss, hparams, out, has_aux=True
+            )
+            w_m = mm_m.sum()
+            contrib = f_valid * is_last.astype(jnp.float32)
+            dhp_m, dh_m = hl_vjp(contrib * w_m / gw)
+            dhparams = jax.tree_util.tree_map(
+                lambda a, g: a + g, dhparams, dhp_m
+            )
+            loss_acc = loss_acc + contrib * w_m * loss_m
+            w_acc = w_acc + contrib * w_m
+            scaled = jax.tree_util.tree_map(
+                lambda v: contrib * w_m * v, metrics_m
+            )
+            metrics_acc = scaled if metrics_acc is None else \
+                jax.tree_util.tree_map(
+                    lambda a, v: a + v, metrics_acc, scaled
+                )
+
+            # ---- backward slot: rank s, microbatch t - 2P + 2 + s ----
+            m_b = t - 2 * P_ + 2 + idx
+            b_valid = ((m_b >= 0) & (m_b < M)).astype(jnp.float32)
+            m_bc = jnp.clip(m_b, 0, M - 1)
+            km_b = jnp.take(key_masks, m_bc, axis=0)
+            # Rank s forwarded m_b at tick m_b + s = t - 2(P-1-s).
+            slot = jnp.mod(t - 2 * (P_ - 1) + 2 * idx, K)
+            x_saved = jnp.take(in_buf, slot, axis=0)
+            # Cotangents arrive f32 (head_loss upcasts; the where-
+            # promote makes stage INPUTS f32 while outputs may be
+            # bf16) — cast to this stage's OUTPUT dtype, exactly the
+            # cast AD's promote/astype transposes apply on the gpipe
+            # path.
+            cot_in = jnp.where(is_last, dh_m, recv_cot).astype(
+                out.dtype
+            )
+            _, s_vjp = jax.vjp(stage_on(km_b), sparams, x_saved)
+            dsp_m, dx = s_vjp(cot_in)
+            dsparams = jax.tree_util.tree_map(
+                lambda a, g: a + b_valid * g, dsparams, dsp_m
+            )
+            dx = dx * b_valid
+            # Cast into the buffer dtype: demb is emb-dtype (bf16 under
+            # mixed precision) while dx is the f32-promoted input
+            # cotangent — a mixed-dtype scatter-add is a future error.
+            demb = demb.at[m_bc].add(
+                (dx * is_first.astype(jnp.float32)).astype(demb.dtype)
+            )
+            if left:
+                recv_cot = lax.ppermute(dx, axis, left)
+
+        # demb varies over pp (only rank 0 contributed, via
+        # axis_index masking) but the embed primal was pp-invariant;
+        # psum over pp broadcasts rank 0's cotangent everywhere, making
+        # the vjp input's replication type match the primal's — and
+        # every rank then computes the identical embed grad.
+        (deparams,) = emb_vjp(lax.psum(demb, axis))
+
+        all_axes = ("dp", "fsdp", axis)
+        gsum = lambda v: lax.psum(v, all_axes)  # noqa: E731
+        gw_all = jnp.maximum(gsum(w_acc), 1e-9)
+        loss = gsum(loss_acc) / gw_all
+        metrics = jax.tree_util.tree_map(
+            lambda v: gsum(v) / gw_all, metrics_acc
+        )
+        # No explicit grad psums: shard_map's replication-typing makes
+        # each jax.vjp transpose psum cotangents onto device-INVARIANT
+        # inputs automatically (an invariant param used by varying data
+        # transposes to a cross-device sum).  deparams/dhparams come
+        # out fully invariant (global sums); dsp_m came out dp-summed
+        # per pp rank.  Adding our own psums here double-counts —
+        # measured 4x/8x on a dp=4,pp=2 mesh before this comment.
+        grads = (
+            deparams,
+            jax.tree_util.tree_map(lambda g: g[None], dsparams),
+            dhparams,
+        )
+        return loss, metrics, grads
+
+    return fn
+
+
 def sequential_loss(embed_apply, stage_apply, head_apply, loss_fn,
                     *, n_stages: int):
     """The pipeline's math without the pipeline — stages applied in
@@ -227,6 +423,7 @@ class PipelinedTransformer:
         mesh: Mesh | None = None,
         pp: int | None = None,
         compute_dtype: str = "bfloat16",
+        schedule: str = "gpipe",  # 'gpipe' | '1f1b'
     ):
         self.vocab_size = vocab_size
         self.hidden_dim = hidden_dim
@@ -239,6 +436,11 @@ class PipelinedTransformer:
         self.learning_rate = learning_rate
         self.seed = seed
         self.compute_dtype = compute_dtype
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"schedule must be 'gpipe' or '1f1b', got {schedule!r}"
+            )
+        self.schedule = schedule
         if mesh is None:
             n = jax.device_count()
             if pp is not None:
@@ -324,17 +526,6 @@ class PipelinedTransformer:
         stage_spec = jax.tree_util.tree_map(
             lambda _: P("pp"), self.params[1]
         )
-        pipe = gpipe_loss(
-            self._embed.apply, self._stage.apply, self._head.apply,
-            self._loss_fn, n_stages=self.pp, n_micro=self.n_micro,
-        )
-        smapped = jax.shard_map(
-            pipe,
-            mesh=mesh,
-            in_specs=(P(), stage_spec, P(), batch_spec, batch_spec,
-                      batch_spec),
-            out_specs=(P(), P()),
-        )
 
         from learningorchestra_tpu.train.neural import _param_cast_for
 
@@ -342,19 +533,64 @@ class PipelinedTransformer:
             jnp.bfloat16 if self.compute_dtype == "bfloat16" else None
         )
 
-        def step(params, opt_state, xb, yb, mb):
-            def objective(ps):
-                # Mixed precision: bf16 compute copy, f32 master
-                # weights in the optimizer (train/neural.py contract).
-                loss, metrics = smapped(*_pcast(ps), xb, yb, mb)
-                return loss, metrics
-
-            grads, metrics = jax.grad(objective, has_aux=True)(params)
-            updates, opt_state = self.optimizer.update(
-                grads, opt_state, params
+        if self.schedule == "1f1b":
+            pipe = one_f_one_b_grads(
+                self._embed.apply, self._stage.apply, self._head.apply,
+                self._loss_fn, n_stages=self.pp, n_micro=self.n_micro,
             )
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, metrics
+            smapped = jax.shard_map(
+                pipe,
+                mesh=mesh,
+                in_specs=(P(), stage_spec, P(), batch_spec, batch_spec,
+                          batch_spec),
+                out_specs=(P(), P(), (P(), stage_spec, P())),
+            )
+
+            def step(params, opt_state, xb, yb, mb):
+                # The schedule computes its own gradients (backward
+                # interleaved per microbatch); grads arrive in compute
+                # dtype and cast back to f32 master precision — the
+                # same cast-transpose jax.grad applies on the gpipe
+                # path.
+                loss, metrics, grads = smapped(*_pcast(params), xb, yb,
+                                               mb)
+                grads = jax.tree_util.tree_map(
+                    lambda g, p: g.astype(p.dtype), grads, params
+                )
+                updates, opt_state = self.optimizer.update(
+                    grads, opt_state, params
+                )
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, metrics
+        else:
+            pipe = gpipe_loss(
+                self._embed.apply, self._stage.apply, self._head.apply,
+                self._loss_fn, n_stages=self.pp, n_micro=self.n_micro,
+            )
+            smapped = jax.shard_map(
+                pipe,
+                mesh=mesh,
+                in_specs=(P(), stage_spec, P(), batch_spec, batch_spec,
+                          batch_spec),
+                out_specs=(P(), P()),
+            )
+
+            def step(params, opt_state, xb, yb, mb):
+                def objective(ps):
+                    # Mixed precision: bf16 compute copy, f32 master
+                    # weights in the optimizer (train/neural.py
+                    # contract).
+                    loss, metrics = smapped(*_pcast(ps), xb, yb, mb)
+                    return loss, metrics
+
+                grads, metrics = jax.grad(objective, has_aux=True)(
+                    params
+                )
+                updates, opt_state = self.optimizer.update(
+                    grads, opt_state, params
+                )
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, metrics
 
         self._step = jax.jit(step, donate_argnums=(0, 1))
         self._oracle = jax.jit(sequential_loss(

@@ -53,6 +53,14 @@ class ServiceContext:
             self.artifacts, max_workers=self.config.jobs.max_workers
         )
         self.loader = StoreLoader(self)
+        from learningorchestra_tpu.services.webhooks import (
+            WebhookNotifier,
+        )
+
+        # Observe PUSH path: job completion fires registered webhooks
+        # (the reference's pub/sub Observe shape, README.md:71).
+        self.webhooks = WebhookNotifier(self.documents)
+        self.engine.notifier = self.webhooks
         from learningorchestra_tpu.jobs.leases import DeviceLeaser
 
         # Per-job accelerator placement (jobs/leases.py): concurrent
@@ -186,6 +194,15 @@ class StoreLoader:
         if meta is None:
             raise KeyError(name)
         kind = str(meta.get("type", ""))
+        if meta.get("sharded"):
+            # Beyond-RAM datasets resolve to a LAZY handle (train paths
+            # stream its shards); materializing a DataFrame here would
+            # be exactly the O(dataset)-host-memory step the sharded
+            # format exists to avoid.  ``$name.col`` indexes to a
+            # single-column view via ShardedDataset.__getitem__.
+            from learningorchestra_tpu.store.sharded import ShardedDataset
+
+            return ShardedDataset(self.ctx.volumes.path_for(kind, name))
         if kind.startswith("dataset/csv") or not self.ctx.volumes.exists(
             kind, name
         ):

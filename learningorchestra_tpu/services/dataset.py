@@ -130,17 +130,31 @@ class DatasetService:
     # -- CSV ------------------------------------------------------------------
 
     def create_csv(
-        self, name: str, url: str, *, infer_types: bool = True
+        self, name: str, url: str, *, infer_types: bool = True,
+        shard_rows: int | None = None,
     ) -> dict:
         """Async ingest: metadata appears immediately (finished=False),
         rows stream in on a job thread — the reference's ASYNC BOUNDARY
-        (database.py:99-105)."""
+        (database.py:99-105).
+
+        ``shard_rows`` switches to SHARDED ingest for beyond-host-RAM
+        datasets: rows stream into columnar ``.npz`` shards on the
+        volume (store/sharded.py) instead of store documents, with the
+        first page of rows kept as store docs for GET preview parity.
+        Training then streams the shards (train/neural.py
+        ``_fit_streaming``) — the reference's any-size ingest+train
+        contract (database.py:86-151) without ever materializing the
+        dataset as one array."""
         self.ctx.require_new_name(name)
         meta = self.ctx.artifacts.metadata.create(
             name, CSV_TYPE, extra={"url": url}
         )
 
         def ingest():
+            if shard_rows:
+                return self._ingest_sharded(
+                    name, url, int(shard_rows), infer_types
+                )
             native = self._ingest_native(name, url, infer_types)
             if native is not None:
                 return native
@@ -238,6 +252,67 @@ class DatasetService:
                 name, (json.loads(ln) for ln in jsonl.splitlines() if ln)
             )
         return {"fields": fields, "rows": n}
+
+    PREVIEW_ROWS = 100  # GET page cap (constants.py:42-44) = preview size
+
+    def _ingest_sharded(
+        self, name: str, url: str, shard_rows: int, infer_types: bool
+    ) -> dict:
+        """Stream CSV rows into columnar volume shards.
+
+        Peak host memory is O(shard_rows · n_cols), whatever the file
+        size.  The first PREVIEW_ROWS rows also land in the document
+        store so ``GET /dataset/csv/<name>`` pages work unchanged (the
+        full row set deliberately does NOT — a beyond-RAM dataset as
+        row documents is the bottleneck this path exists to avoid).
+        Columns must be numeric (empty cells → NaN; integer columns
+        with gaps promote to float): training is the only consumer of
+        shards, and it needs matrices, not strings.
+        """
+        from learningorchestra_tpu.store.sharded import (
+            ShardedDatasetWriter,
+        )
+
+        root = self.ctx.volumes.path_for(CSV_TYPE, name)
+        writer = None
+        preview: list[dict] = []
+        fields: list[str] = []
+        n_rows = 0
+        with _open_url(url) as fh:
+            for row in csv.reader(fh):
+                if not fields:
+                    fields = _clean_header(row)
+                    writer = ShardedDatasetWriter(
+                        root, fields, rows_per_shard=shard_rows
+                    )
+                    continue
+                if not row:
+                    continue
+                vals = [
+                    _infer(v) if infer_types else v
+                    for v in row[: len(fields)]
+                ]
+                vals += [None] * (len(fields) - len(vals))
+                numeric = [
+                    float("nan") if v is None else v for v in vals
+                ]
+                writer.append(numeric)
+                if len(preview) < self.PREVIEW_ROWS:
+                    preview.append(dict(zip(fields, vals)))
+                n_rows += 1
+        if writer is None:
+            raise ValueError(f"CSV at {url} has no header row")
+        manifest = writer.close()
+        if preview:
+            self.ctx.documents.insert_many(name, preview)
+        return {
+            "fields": fields,
+            "rows": n_rows,
+            "sharded": True,
+            "shards": len(manifest["shard_rows"]),
+            "shardRows": shard_rows,
+            "previewRows": len(preview),
+        }
 
     # -- generic binary -------------------------------------------------------
 

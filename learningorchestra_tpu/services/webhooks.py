@@ -1,0 +1,137 @@
+"""Observe push notifications — webhooks on artifact state transitions.
+
+The reference's Observe service is a collection watch/pub-sub: clients
+subscribe and get PUSHED a message when a pipeline step finishes
+(reference: README.md:71 "observe... a wait until a processing step
+finish"; the Python client blocks on a Mongo change stream).  Round 2
+covered the WAIT shape with the ``GET /observe/<name>`` long-poll; this
+module adds the PUSH shape: register a webhook URL against an artifact
+and the job engine's completion path fires an HTTP POST at it on
+``finished``/``failed`` — no polling connection held open.
+
+Registrations are documents in the store (collection
+``observe_webhooks``), so they survive restarts like every other
+artifact.  Delivery is fire-and-forget on a daemon thread with bounded
+retries; the registration doc records the last delivery outcome for
+debugging (``lastStatus``/``lastError``/``deliveries``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+from learningorchestra_tpu.log import get_logger, kv
+
+COLLECTION = "observe_webhooks"
+EVENTS = ("finished", "failed")
+
+
+class WebhookNotifier:
+    def __init__(self, documents, *, attempts: int = 3,
+                 timeout_s: float = 10.0):
+        self.documents = documents
+        self.attempts = attempts
+        self.timeout_s = timeout_s
+        self.log = get_logger("observe")
+
+    # -- registry -------------------------------------------------------------
+
+    def register(self, artifact: str, url: str,
+                 events: list[str] | None = None) -> dict:
+        if not url or not url.startswith(("http://", "https://")):
+            raise ValueError(
+                f"webhook url must be http(s), got {url!r}"
+            )
+        events = list(events or EVENTS)
+        bad = [e for e in events if e not in EVENTS]
+        if bad:
+            raise ValueError(
+                f"unknown webhook events {bad}; valid: {list(EVENTS)}"
+            )
+        doc = {
+            "artifact": artifact,
+            "url": url,
+            "events": events,
+            "deliveries": 0,
+            "lastStatus": None,
+            "lastError": None,
+        }
+        _id = self.documents.insert_one(COLLECTION, doc)
+        return {**doc, "_id": _id}
+
+    def unregister(self, artifact: str, hook_id: int) -> bool:
+        doc = self.documents.find_one(COLLECTION, hook_id)
+        if doc is None or doc.get("artifact") != artifact:
+            return False
+        return self.documents.delete_one(COLLECTION, hook_id)
+
+    def list(self, artifact: str) -> list[dict]:
+        return self.documents.find(
+            COLLECTION, query={"artifact": artifact}
+        )
+
+    # -- firing ---------------------------------------------------------------
+
+    def notify(self, artifact: str, event: str, metadata: dict) -> None:
+        """Fire registered webhooks for (artifact, event) — returns
+        immediately; delivery happens on a daemon thread so a slow or
+        dead endpoint can never stall the job engine's completion
+        path."""
+        try:
+            hooks = [
+                h for h in self.list(artifact)
+                if event in h.get("events", EVENTS)
+            ]
+        except Exception:  # noqa: BLE001 — notify must never raise
+            return
+        if not hooks:
+            return
+        payload = json.dumps({
+            "name": artifact,
+            "event": event,
+            "metadata": metadata,
+        }).encode()
+        threading.Thread(
+            target=self._deliver_all,
+            args=(hooks, payload),
+            name="webhook-notify",
+            daemon=True,
+        ).start()
+
+    def _deliver_all(self, hooks: list[dict], payload: bytes) -> None:
+        for hook in hooks:
+            status, error = self._deliver(hook["url"], payload)
+            try:
+                self.documents.update_one(COLLECTION, hook["_id"], {
+                    "deliveries": hook.get("deliveries", 0) + 1,
+                    "lastStatus": status,
+                    "lastError": error,
+                })
+            except Exception:  # noqa: BLE001 — bookkeeping is best-effort
+                pass
+
+    def _deliver(self, url: str, payload: bytes):
+        last_err = None
+        for attempt in range(self.attempts):
+            try:
+                req = urllib.request.Request(
+                    url, data=payload,
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(
+                    req, timeout=self.timeout_s
+                ) as resp:
+                    return resp.status, None
+            except Exception as exc:  # noqa: BLE001
+                last_err = repr(exc)
+                self.log.warning(kv(
+                    webhook=url, attempt=attempt + 1, error=last_err
+                ))
+                if attempt + 1 < self.attempts:
+                    # No trailing sleep after the FINAL failure — it
+                    # would only delay delivery to the next hook.
+                    time.sleep(min(2 ** attempt, 5))
+        return None, last_err

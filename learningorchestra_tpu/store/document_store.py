@@ -42,6 +42,10 @@ class DuplicateKey(Exception):
     """insert_unique target _id already present."""
 
 
+class CorruptWal(Exception):
+    """WAL damaged beyond the torn-tail case a crash can produce."""
+
+
 class NoSuchCollection(Exception):
     pass
 
@@ -96,25 +100,69 @@ class _Collection:
         # next_id must stay monotonic across deletes, so it tracks the max
         # _id ever inserted, not the max surviving doc.
         max_seen = -1
-        with open(self.path, "r", encoding="utf-8") as fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
+        data = self.path.read_bytes()
+        off = 0
+        good_end = 0  # byte offset after the last complete valid record
+        torn_at = None
+        for raw in data.splitlines(keepends=True):
+            end = off + len(raw)
+            stripped = raw.strip()
+            if not stripped:
+                if raw.endswith(b"\n"):
+                    good_end = end
+                off = end
+                continue
+            op = None
+            if raw.endswith(b"\n"):
+                try:
+                    op = json.loads(stripped)
+                except ValueError:
+                    op = None
+            if not isinstance(op, dict) or "op" not in op:
+                # A crash mid-append leaves exactly one torn record at
+                # the TAIL (partial line, or a line cut before its
+                # newline).  Stop here; corruption is only tolerable if
+                # nothing valid follows (checked below).
+                torn_at = off
+                break
+            kind = op["op"]
+            if kind == "i":
+                doc = op["d"]
+                self.docs[doc["_id"]] = doc
+                max_seen = max(max_seen, doc["_id"])
+            elif kind == "u":
+                _id = op["id"]
+                if _id in self.docs:
+                    self.docs[_id].update(op["d"])
+            elif kind == "d":
+                self.docs.pop(op["id"], None)
+            elif kind == "n":
+                max_seen = max(max_seen, op["v"] - 1)
+            good_end = end
+            off = end
+        if torn_at is not None:
+            for raw in data[torn_at:].splitlines(keepends=True)[1:]:
+                if not raw.endswith(b"\n"):
                     continue
-                op = json.loads(line)
-                kind = op["op"]
-                if kind == "i":
-                    doc = op["d"]
-                    self.docs[doc["_id"]] = doc
-                    max_seen = max(max_seen, doc["_id"])
-                elif kind == "u":
-                    _id = op["id"]
-                    if _id in self.docs:
-                        self.docs[_id].update(op["d"])
-                elif kind == "d":
-                    self.docs.pop(op["id"], None)
-                elif kind == "n":
-                    max_seen = max(max_seen, op["v"] - 1)
+                try:
+                    tail_op = json.loads(raw.strip())
+                except ValueError:
+                    continue
+                if isinstance(tail_op, dict) and "op" in tail_op:
+                    # Valid records BEYOND the bad region: that is not
+                    # a torn tail, it is mid-file damage — refuse to
+                    # silently drop acknowledged writes.
+                    raise CorruptWal(
+                        f"{self.path}: invalid record at byte "
+                        f"{torn_at} followed by valid records — WAL "
+                        "is damaged mid-file, refusing to open"
+                    )
+            # Torn tail only: recover by truncating to the last good
+            # record, so the next append starts a CLEAN line instead
+            # of gluing itself to partial bytes (which would corrupt
+            # the new record too).
+            with open(self.path, "r+b") as fh:
+                fh.truncate(good_end)
         self.next_id = max_seen + 1
 
     def _open_log(self) -> None:

@@ -1,0 +1,169 @@
+"""WAL-shipping read replica for the document store.
+
+The reference deploys a 3-node MongoDB replica set for persistence HA
+(reference: docker-compose.yml:42-90 — mongo + two mongo-secondary
+replicas behind a replSetInitiate).  The store here is a per-collection
+JSONL write-ahead log (document_store.py), which makes replication a
+byte-shipping problem instead of a protocol: a follower tails each
+``<name>.wal``, appends the complete records to its OWN copy (fsync'd —
+the replica must survive its own crash), and applies them to a live
+read view.  Failover is :meth:`WalReplica.promote`: the replica
+directory IS a valid store directory, so promotion is just opening it
+for writes.
+
+Semantics:
+
+- **Record-aligned shipping.**  Only byte ranges ending in a complete
+  ``\\n``-terminated record ship; a torn tail on the primary (crash
+  mid-append) is never copied, mirroring the primary's own recovery.
+- **Compaction/rewrite detection.**  ``compact()`` rewrites a WAL in
+  place; the follower detects the file shrinking below its shipped
+  offset and resyncs that collection from byte 0 (same for a dropped
+  and recreated collection).
+- **Pull model.**  ``sync()`` is explicit — call it on a timer, or
+  from a cron/sidecar.  The primary needs no cooperation beyond its
+  ordinary appends, exactly like shipping WALs off a Postgres primary.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+from learningorchestra_tpu.store.document_store import (
+    DocumentStore,
+    _match,
+)
+
+
+class WalReplica:
+    """Read-only follower of a primary store directory."""
+
+    def __init__(self, primary_root: str | Path,
+                 replica_root: str | Path):
+        self.primary_root = Path(primary_root)
+        self.replica_root = Path(replica_root)
+        self.replica_root.mkdir(parents=True, exist_ok=True)
+        self._offsets: dict[str, int] = {}
+        self._docs: dict[str, dict[int, dict]] = {}
+        # Bootstrap from whatever the replica dir already holds (a
+        # follower restarting must not re-apply from zero into
+        # duplicated state — offsets persist next to the shipped WALs).
+        for wal in sorted(self.replica_root.glob("*.wal")):
+            name = wal.stem
+            self._offsets[name] = wal.stat().st_size
+            self._docs[name] = {}
+            self._apply_bytes(name, wal.read_bytes())
+
+    # -- shipping -------------------------------------------------------------
+
+    def sync(self) -> dict:
+        """Ship new complete records for every primary collection;
+        returns {collection: bytes_shipped}."""
+        shipped: dict[str, int] = {}
+        seen = set()
+        for wal in sorted(self.primary_root.glob("*.wal")):
+            name = wal.stem
+            seen.add(name)
+            shipped[name] = self._sync_one(name, wal)
+        # Collections dropped on the primary disappear here too —
+        # otherwise a promote would resurrect deleted data.
+        for name in list(self._offsets):
+            if name not in seen:
+                self._offsets.pop(name, None)
+                self._docs.pop(name, None)
+                dst = self.replica_root / f"{name}.wal"
+                if dst.exists():
+                    dst.unlink()
+        return shipped
+
+    def _sync_one(self, name: str, src: Path) -> int:
+        offset = self._offsets.get(name, 0)
+        try:
+            size = src.stat().st_size
+        except FileNotFoundError:
+            return 0
+        if size < offset:
+            # Compaction (or drop+recreate) rewrote the file shorter
+            # than what we shipped: restart this collection.
+            offset = 0
+            self._docs[name] = {}
+            dst = self.replica_root / f"{name}.wal"
+            if dst.exists():
+                dst.unlink()
+        with open(src, "rb") as fh:
+            fh.seek(offset)
+            data = fh.read()
+        # Ship complete records only: hold back everything past the
+        # last newline (a mid-append torn tail must not replicate).
+        cut = data.rfind(b"\n")
+        if cut < 0:
+            return 0
+        chunk = data[: cut + 1]
+        dst = self.replica_root / f"{name}.wal"
+        with open(dst, "ab") as fh:
+            fh.write(chunk)
+            fh.flush()
+            os.fsync(fh.fileno())
+        self._offsets[name] = offset + len(chunk)
+        self._apply_bytes(name, chunk)
+        return len(chunk)
+
+    def _apply_bytes(self, name: str, data: bytes) -> None:
+        docs = self._docs.setdefault(name, {})
+        for raw in data.splitlines():
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                op = json.loads(raw)
+            except ValueError:
+                continue  # primary torn tail shipped pre-fix; skip
+            kind = op.get("op")
+            if kind == "i":
+                docs[op["d"]["_id"]] = op["d"]
+            elif kind == "u":
+                if op["id"] in docs:
+                    docs[op["id"]].update(op["d"])
+            elif kind == "d":
+                docs.pop(op["id"], None)
+
+    # -- read surface ---------------------------------------------------------
+
+    def list_collections(self) -> list[str]:
+        return sorted(self._docs)
+
+    def count(self, name: str, query: dict | None = None) -> int:
+        return len(self.find(name, query))
+
+    def find(self, name: str, query: dict | None = None) -> list[dict]:
+        docs = self._docs.get(name, {})
+        return [
+            dict(d) for _id, d in sorted(docs.items())
+            if _match(d, query)
+        ]
+
+    def find_one(self, name: str, _id: int) -> dict | None:
+        doc = self._docs.get(name, {}).get(_id)
+        return dict(doc) if doc is not None else None
+
+    def lag_bytes(self) -> int:
+        """Total unshipped primary bytes — the replication-lag gauge."""
+        lag = 0
+        for wal in self.primary_root.glob("*.wal"):
+            size = wal.stat().st_size
+            off = self._offsets.get(wal.stem, 0)
+            lag += max(0, size - off)
+        return lag
+
+    # -- failover -------------------------------------------------------------
+
+    def promote(self, durable_writes: bool = True) -> DocumentStore:
+        """Open the replica directory as a WRITABLE store — the
+        failover step.  The caller must stop syncing from the old
+        primary first (a promoted replica is a new primary)."""
+        self.sync()
+        return DocumentStore(
+            self.replica_root, durable_writes=durable_writes
+        )

@@ -1,0 +1,318 @@
+"""Sharded (beyond-host-RAM) dataset artifacts.
+
+The reference streams arbitrarily large datasets row-by-row into MongoDB
+and trains by reading rows back per worker (reference:
+microservices/database_api_image/database.py:86-151 — a 3-thread
+download→treat→save queue; training reads the collection back).  A
+row-document store is the wrong layout for a TPU input pipeline: training
+wants large contiguous numeric blocks it can ``device_put`` whole, not
+per-row BSON.  Here ingest writes fixed-size COLUMNAR SHARDS (one ``.npz``
+per shard, one array per column) plus a JSON manifest; the training paths
+stream shard k+1 from disk while the device runs shard k, so peak host
+memory is O(shard), not O(dataset) — BASELINE config 5's
+ResNet-on-ImageNet shape, which can never materialize as one host array.
+
+Layout::
+
+    <root>/manifest.json                 fields, dtypes, shard row counts
+    <root>/shard_00000.npz               {field: ndarray(rows_k,)}
+    ...
+
+Shuffle model (the standard sharded-pipeline trade): shard ORDER is
+reshuffled every epoch on the host, row order WITHIN a shard on the
+device; sample-granular global shuffling would re-read the whole dataset
+per epoch.  Rows land in shards in ingest order, so pre-shuffled sources
+keep their mixing; pathologically ordered sources should raise
+``rows_per_shard`` or pre-shuffle.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+import numpy as np
+
+MANIFEST = "manifest.json"
+_SHARD_FMT = "shard_{:05d}.npz"
+
+# int64 CSV values narrow to int32 (TPU-native int width; jax defaults to
+# 32-bit anyway) and float64 to float32.
+_NARROW = {"int64": "int32", "float64": "float32"}
+
+
+def _narrow(dtype: np.dtype) -> str:
+    name = np.dtype(dtype).name
+    return _NARROW.get(name, name)
+
+
+class ShardedDatasetWriter:
+    """Streaming writer: buffer rows, flush one ``.npz`` per shard.
+
+    Columns may change integer/float character between shards (a column
+    integral for the first million rows then fractional); the manifest
+    records the PROMOTED dtype and readers cast each shard on load, so
+    every shard a consumer sees is uniformly typed.
+    """
+
+    def __init__(self, root: str | Path, fields: list[str], *,
+                 rows_per_shard: int = 65536):
+        if rows_per_shard <= 0:
+            raise ValueError("rows_per_shard must be positive")
+        if not fields:
+            raise ValueError("sharded dataset needs a non-empty header")
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.fields = list(fields)
+        self.rows_per_shard = rows_per_shard
+        self._buf: list[list] = []
+        self._shard_rows: list[int] = []
+        self._dtypes: dict[str, np.dtype] = {}
+        self._closed = False
+
+    def append(self, row: list) -> None:
+        """One row of numeric values in field order (shorter rows are an
+        error — silent column misalignment corrupts training data)."""
+        if len(row) != len(self.fields):
+            raise ValueError(
+                f"row has {len(row)} values, header has "
+                f"{len(self.fields)} fields"
+            )
+        self._buf.append(row)
+        if len(self._buf) >= self.rows_per_shard:
+            self._flush()
+
+    def _flush(self) -> None:
+        if not self._buf:
+            return
+        cols = {}
+        for i, field in enumerate(self.fields):
+            try:
+                arr = np.asarray([r[i] for r in self._buf])
+            except (ValueError, TypeError) as exc:
+                raise ValueError(
+                    f"column {field!r} is not numeric: {exc}"
+                ) from exc
+            if not np.issubdtype(arr.dtype, np.number):
+                raise ValueError(
+                    f"column {field!r} is not numeric "
+                    f"(dtype {arr.dtype}); cast or project it away "
+                    "before sharded ingest"
+                )
+            arr = arr.astype(_narrow(arr.dtype))
+            cols[field] = arr
+            prev = self._dtypes.get(field)
+            if prev is None:
+                self._dtypes[field] = arr.dtype
+            else:
+                # Re-narrow after promotion: int32+float32 promotes to
+                # float64 under numpy's rules, but shards stay 32-bit.
+                self._dtypes[field] = np.dtype(
+                    _narrow(np.promote_types(prev, arr.dtype))
+                )
+        k = len(self._shard_rows)
+        # Atomic publish: a crashed ingest must not leave a torn .npz a
+        # later open() would try to read.
+        tmp = self.root / (_SHARD_FMT.format(k) + ".tmp")
+        with open(tmp, "wb") as fh:
+            np.savez(fh, **cols)
+        os.replace(tmp, self.root / _SHARD_FMT.format(k))
+        self._shard_rows.append(len(self._buf))
+        self._buf = []
+
+    def close(self) -> dict:
+        """Flush the tail shard and publish the manifest (the artifact
+        does not exist as a dataset until the manifest lands)."""
+        if self._closed:
+            raise RuntimeError("writer already closed")
+        self._flush()
+        self._closed = True
+        manifest = {
+            "fields": self.fields,
+            "dtypes": {
+                f: np.dtype(self._dtypes.get(f, np.float32)).name
+                for f in self.fields
+            },
+            "shard_rows": self._shard_rows,
+            "rows": int(sum(self._shard_rows)),
+            "rows_per_shard": self.rows_per_shard,
+        }
+        tmp = self.root / (MANIFEST + ".tmp")
+        tmp.write_text(json.dumps(manifest))
+        os.replace(tmp, self.root / MANIFEST)
+        return manifest
+
+
+class ShardedDataset:
+    """Read handle over a sharded dataset directory — lazy: holds the
+    manifest only; shards load one at a time via :meth:`load_shard`."""
+
+    def __init__(self, root: str | Path):
+        self.root = Path(root)
+        path = self.root / MANIFEST
+        if not path.exists():
+            raise FileNotFoundError(
+                f"no sharded-dataset manifest at {path} (ingest "
+                "unfinished or crashed before publish)"
+            )
+        m = json.loads(path.read_text())
+        self.fields: list[str] = list(m["fields"])
+        self.dtypes = {f: np.dtype(d) for f, d in m["dtypes"].items()}
+        self.shard_rows: list[int] = [int(r) for r in m["shard_rows"]]
+        self.n_rows: int = int(m["rows"])
+        self.rows_per_shard: int = int(m["rows_per_shard"])
+
+    # -- handle surface -------------------------------------------------------
+
+    @property
+    def n_shards(self) -> int:
+        return len(self.shard_rows)
+
+    def __len__(self) -> int:
+        return self.n_rows
+
+    def __getitem__(self, key):
+        """``$dataset.column`` DSL indexing → a single-column view;
+        a list of names → a feature-matrix view."""
+        return self.view(key)
+
+    def view(self, cols) -> "ShardedView":
+        return ShardedView(self, cols)
+
+    def feature_view(self, exclude) -> "ShardedView":
+        """All columns except ``exclude`` — the ``fit(x=$big,
+        y=$big.label)`` convention resolves x to this."""
+        drop = {exclude} if isinstance(exclude, str) else set(exclude)
+        keep = [f for f in self.fields if f not in drop]
+        if not keep:
+            raise ValueError("feature view excludes every column")
+        return ShardedView(self, keep)
+
+    def load_shard(self, k: int, cols: list[str] | None = None) -> dict:
+        """Columns of shard ``k`` as host arrays, cast to the manifest
+        dtypes (shards written before a column promoted may be narrower
+        on disk)."""
+        with np.load(self.root / _SHARD_FMT.format(k)) as z:
+            out = {}
+            for f in (cols or self.fields):
+                arr = z[f]
+                want = self.dtypes[f]
+                out[f] = arr.astype(want) if arr.dtype != want else arr
+            return out
+
+
+class ShardedView:
+    """Lazy column selection over a :class:`ShardedDataset`.
+
+    A string selects ONE column (1-D per shard — the ``y`` shape); a
+    list selects a feature matrix (rows, n_cols) stacked in the given
+    order, promoted to a common dtype (float32 for mixed columns).
+    """
+
+    def __init__(self, dataset: ShardedDataset, cols):
+        self.dataset = dataset
+        self.single = isinstance(cols, str)
+        names = [cols] if self.single else list(cols)
+        missing = [c for c in names if c not in dataset.fields]
+        if missing:
+            raise KeyError(
+                f"no such column(s) {missing} in sharded dataset "
+                f"(fields: {dataset.fields})"
+            )
+        self.cols = names
+
+    def __len__(self) -> int:
+        return self.dataset.n_rows
+
+    @property
+    def dtype(self) -> np.dtype:
+        dts = [self.dataset.dtypes[c] for c in self.cols]
+        out = dts[0]
+        for d in dts[1:]:
+            out = np.promote_types(out, d)
+        return out
+
+    @property
+    def shape(self) -> tuple:
+        n = self.dataset.n_rows
+        return (n,) if self.single else (n, len(self.cols))
+
+    def load_shard(self, k: int) -> np.ndarray:
+        cols = self.dataset.load_shard(k, self.cols)
+        if self.single:
+            return cols[self.cols[0]]
+        dtype = self.dtype
+        return np.stack(
+            [cols[c].astype(dtype) for c in self.cols], axis=1
+        )
+
+    def head(self, n: int = 1) -> np.ndarray:
+        """First ``n`` rows (for parameter init / loss resolution)
+        without loading more than the first shard."""
+        return self.load_shard(0)[:n]
+
+
+def same_dataset(a, b) -> bool:
+    """True when two views stream from the same dataset directory —
+    the x/y alignment precondition for streaming fit."""
+    da = a.dataset if isinstance(a, ShardedView) else a
+    db = b.dataset if isinstance(b, ShardedView) else b
+    return isinstance(da, ShardedDataset) and \
+        isinstance(db, ShardedDataset) and da.root == db.root
+
+
+def resolve_xy_views(x, y):
+    """Normalize/validate the (x, y) pair every streaming surface
+    accepts: y must be one column; a bare-dataset x resolves to all
+    columns except y's (the ``fit(x="$big", y="$big.label")`` request
+    shape); both must stream from ONE dataset (shard alignment).
+    Returns ``(x_view, y_view)``."""
+    if isinstance(y, ShardedDataset) or not (
+        isinstance(y, ShardedView) and y.single
+    ):
+        raise ValueError(
+            "y must select one column of the sharded dataset "
+            "(request shape: \"y\": \"$name.label\")"
+        )
+    if isinstance(x, ShardedDataset):
+        x = x.feature_view(y.cols[0])
+    if not isinstance(x, ShardedView):
+        raise ValueError(
+            "x must be a sharded view when y is one (both sides "
+            "stream shard-aligned from the same dataset)"
+        )
+    if not same_dataset(x, y):
+        raise ValueError(
+            "x and y stream from different sharded datasets; "
+            "shard alignment requires one source"
+        )
+    return x, y
+
+
+class WeightedMetrics:
+    """Row-weighted metric accumulation across shards.
+
+    Perplexity is averaged in LOG domain (a shard's ppl is exp of its
+    mean CE, so mean-of-logs + exp-at-the-end reproduces the global
+    exp-after-mean; averaging exps would Jensen-bias upward) — shared
+    by every streaming loop so the convention can't drift.
+    """
+
+    def __init__(self):
+        self._totals: dict[str, float] = {}
+        self._weight = 0.0
+
+    def add(self, metrics: dict, rows: float) -> None:
+        for key, val in metrics.items():
+            val = float(val)
+            if key == "perplexity":
+                val = float(np.log(val))
+            self._totals[key] = self._totals.get(key, 0.0) + val * rows
+        self._weight += rows
+
+    def result(self) -> dict:
+        out = {k: v / self._weight for k, v in self._totals.items()}
+        if "perplexity" in out:
+            out["perplexity"] = float(np.exp(out["perplexity"]))
+        return out

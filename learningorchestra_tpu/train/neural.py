@@ -83,6 +83,18 @@ def _apply_with_aux(module, p, xb):
     return logits.astype(jnp.float32), aux
 
 
+def _is_sharded(obj) -> bool:
+    """True for sharded-dataset handles/views (and tuples holding one)
+    — the dispatch predicate for the streaming fit/evaluate paths."""
+    from learningorchestra_tpu.store import sharded as sh
+
+    if isinstance(obj, (sh.ShardedDataset, sh.ShardedView)):
+        return True
+    if isinstance(obj, tuple):
+        return any(_is_sharded(o) for o in obj)
+    return False
+
+
 def _finalize_metrics(metrics):
     """Batch-mean the stacked per-step metrics, then apply the
     post-reduction transforms: 'perplexity' arrives as raw per-token CE
@@ -542,6 +554,7 @@ class NeuralEstimator(Estimator):
         checkpoint_min_interval_s: float = 60.0,
         resume: bool = True,
         accumulate_steps: int = 1,
+        quantize_checkpoint: bool = False,
         **_,
     ) -> "NeuralEstimator":
         """keras-fit surface plus managed in-loop checkpointing: with
@@ -562,7 +575,28 @@ class NeuralEstimator(Estimator):
         grads average to the large-batch mean and trajectories match
         large-batch training to compute-dtype rounding; a padded tail
         batch (or per-token LM masks) weights each batch equally
-        rather than by its mask mass."""
+        rather than by its mask mass.
+
+        Beyond-RAM datasets: when x/y are sharded-dataset views
+        (store/sharded.py) the fit STREAMS shards — the whole dataset
+        never materializes on host or device (``_fit_streaming``).
+
+        ``quantize_checkpoint=True`` marks the estimator so its SAVED
+        artifact stores parameters int8 (ops/quant.py) with optimizer
+        state dropped — a ~4-7x smaller serving binary; the live
+        in-memory model keeps full precision."""
+        self._quantize_persist = bool(quantize_checkpoint)
+        if _is_sharded(x) or _is_sharded(y):
+            return self._fit_streaming(
+                x, y, epochs=epochs, batch_size=batch_size,
+                validation_split=validation_split,
+                validation_data=validation_data, shuffle=shuffle,
+                verbose=verbose, callbacks=callbacks,
+                checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every,
+                checkpoint_min_interval_s=checkpoint_min_interval_s,
+                resume=resume, accumulate_steps=accumulate_steps,
+            )
         self._set_accumulation(accumulate_steps)
         x = np.asarray(as_array(x))
         y_arr = np.asarray(y if not hasattr(y, "to_numpy") else y.to_numpy())
@@ -587,6 +621,10 @@ class NeuralEstimator(Estimator):
             raise ValueError("cannot batch an empty dataset")
         if self.params is None:
             self._init_params(jnp.asarray(x[:1]))
+        elif self.opt_state is None:
+            # Quantized (serving) artifacts drop optimizer state;
+            # continuation training re-inits moments from zero.
+            self.opt_state = jax.jit(self.optimizer.init)(self.params)
         if self._eval_fn is None or self._eval_loss_kind != loss_kind:
             _, self._eval_fn = self._build_step(loss_kind)
             self._eval_loss_kind = loss_kind
@@ -682,6 +720,183 @@ class NeuralEstimator(Estimator):
         self.params, self.opt_state = params, opt_state
         return self
 
+    def _fit_streaming(
+        self, x, y, *, epochs, batch_size, validation_split,
+        validation_data, shuffle, verbose, callbacks, checkpoint_dir,
+        checkpoint_every, checkpoint_min_interval_s, resume,
+        accumulate_steps,
+    ) -> "NeuralEstimator":
+        """Shard-streaming fit over a beyond-host-RAM dataset.
+
+        Contract parity with the in-memory path (same managed
+        checkpointing, history, callbacks); mechanics differ where the
+        data layout forces it:
+
+        - x/y are views over ONE sharded dataset (x may be the bare
+          dataset: it resolves to every column except y's — the
+          ``fit(x="$big", y="$big.label")`` request shape);
+        - each epoch walks shards in a fresh host-side order; rows
+          reshuffle on device WITHIN a shard (store/sharded.py module
+          docstring covers the shuffle-granularity trade);
+        - shard k+1 loads from disk on an IO thread and starts its
+          host→device transfer while the device computes on shard k —
+          JAX's async dispatch overlaps them without explicit streams;
+        - ``validation_split`` is unsupported (a fractional split of a
+          stream would pin an arbitrary shard subset); pass
+          ``validation_data`` arrays instead.
+
+        The optimizer step count differs from the in-memory path only
+        in batch boundaries at shard edges (each shard's tail batch
+        pads, exactly like the in-memory tail).  Reference contract:
+        database_api_image/database.py:86-151 (stream-ingest + read-back
+        training, the one reference capability round 2 lacked).
+        """
+        import concurrent.futures
+
+        from learningorchestra_tpu.store import sharded as sh
+
+        if validation_split:
+            raise ValueError(
+                "validation_split is unsupported for sharded datasets; "
+                "pass validation_data=(x, y) arrays"
+            )
+        if _is_sharded(validation_data):
+            raise ValueError(
+                "validation_data must be in-memory arrays, not sharded "
+                "views (validation sets are small by construction)"
+            )
+        x, y = sh.resolve_xy_views(x, y)
+        self._set_accumulation(accumulate_steps)
+
+        ds = x.dataset
+        y_head = np.asarray(y.head(256))
+        loss_kind = self._resolve_loss(y_head)
+        y_cast = np.int32 if loss_kind == "softmax_ce" else np.float32
+        x_head = np.asarray(x.head(1), np.float32)
+        if self.params is None:
+            self._init_params(jnp.asarray(x_head))
+        elif self.opt_state is None:
+            # Quantized (serving) artifacts drop optimizer state.
+            self.opt_state = jax.jit(self.optimizer.init)(self.params)
+        if self._eval_fn is None or self._eval_loss_kind != loss_kind:
+            _, self._eval_fn = self._build_step(loss_kind)
+            self._eval_loss_kind = loss_kind
+
+        dtype = jnp.bfloat16 if self.compute_dtype == "bfloat16" else None
+        loss_fn = self._loss_and_metrics(loss_kind)
+        epoch_fns: dict[int, Any] = {}
+
+        def fn_for(rows: int):
+            # One compilation per distinct shard length — all full
+            # shards share one executable; the tail adds a second.
+            if rows not in epoch_fns:
+                epoch_fns[rows] = build_device_epoch(
+                    self.module, self.optimizer, loss_fn, dtype,
+                    n=rows, batch_size=min(batch_size, rows),
+                    shuffle=bool(shuffle),
+                )
+            return epoch_fns[rows]
+
+        def load(k: int):
+            # IO thread: disk → host arrays → START the async H2D copy.
+            # Dtypes pass through exactly as the in-memory path's
+            # as_array does (int features stay int — token models).
+            xs = x.load_shard(k)
+            ys = y.load_shard(k).astype(y_cast)
+            return jax.device_put(xs), jax.device_put(ys)
+
+        start_epoch = 0
+        if checkpoint_dir and resume:
+            from learningorchestra_tpu.train import checkpoint as ckpt
+
+            loaded = ckpt.resume_or_none(
+                checkpoint_dir,
+                {"params": self.params, "opt_state": self.opt_state},
+            )
+            if loaded is not None:
+                state, step, past_history = loaded
+                self.params = state["params"]
+                self.opt_state = state["opt_state"]
+                self.history = TrainHistory(past_history)
+                start_epoch = step
+
+        from learningorchestra_tpu.train import checkpoint as ckpt_mod
+
+        params, opt_state = self.params, self.opt_state
+        root_key = jax.random.PRNGKey(self.seed)
+        last_save = time.monotonic()
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="shard-io"
+        ) as io:
+            for epoch_i in range(start_epoch, epochs):
+                t0 = time.perf_counter()
+                # Seeded per (seed, epoch), NOT once per fit: a
+                # checkpoint-resumed epoch 6 must walk the same shard
+                # order the uninterrupted run would have (and the
+                # distributed path already does — one convention).
+                order = (
+                    np.random.default_rng(
+                        [self.seed, 3, epoch_i]
+                    ).permutation(ds.n_shards) if shuffle
+                    else np.arange(ds.n_shards)
+                )
+                acc = sh.WeightedMetrics()
+                nxt = io.submit(load, int(order[0]))
+                for pos, k in enumerate(order):
+                    xs, ys = nxt.result()
+                    if pos + 1 < len(order):
+                        nxt = io.submit(load, int(order[pos + 1]))
+                    rows = ds.shard_rows[int(k)]
+                    params, opt_state, metrics = fn_for(rows)(
+                        params, opt_state, xs, ys,
+                        jax.random.fold_in(
+                            root_key, epoch_i * ds.n_shards + pos
+                        ),
+                    )
+                    # Re-anchor every shard: the epoch fn donates its
+                    # state, so an interrupt must not strand
+                    # self.params on deleted buffers.
+                    self.params, self.opt_state = params, opt_state
+                    acc.add(jax.device_get(metrics), rows)
+                metrics = acc.result()
+                metrics["epoch_time"] = time.perf_counter() - t0
+                if validation_data is not None:
+                    vx, vy = validation_data
+                    vy = np.asarray(vy)
+                    if vy.ndim == 2 and vy.shape[1] == 1:
+                        vy = vy.reshape(-1)
+                    vmetrics = self._evaluate_arrays(
+                        params, np.asarray(as_array(vx)), vy,
+                        batch_size, loss_kind,
+                    )
+                    metrics.update(
+                        {f"val_{k2}": v for k2, v in vmetrics.items()}
+                    )
+                self.history.append(metrics)
+                if checkpoint_dir and ckpt_mod.should_save(
+                    epoch_i, epochs, checkpoint_every,
+                    checkpoint_min_interval_s, last_save,
+                ):
+                    from learningorchestra_tpu.train import (
+                        checkpoint as ckpt,
+                    )
+
+                    ckpt.save(
+                        checkpoint_dir, epoch_i + 1,
+                        {"params": params, "opt_state": opt_state},
+                        history=dict(self.history),
+                    )
+                    last_save = time.monotonic()
+                if verbose:
+                    _train_logger().info(
+                        "epoch %d/%d: %s", epoch_i + 1, epochs, metrics
+                    )
+                for cb in callbacks or []:
+                    if callable(cb):
+                        cb(epoch_i, metrics, self)
+        self.params, self.opt_state = params, opt_state
+        return self
+
     def _evaluate_arrays(self, params, x, y, batch_size, loss_kind):
         if loss_kind == "softmax_ce":
             y = y.astype(np.int32)
@@ -694,6 +909,8 @@ class NeuralEstimator(Estimator):
         return {k: float(v) for k, v in metrics.items()}
 
     def evaluate(self, x, y, batch_size: int = 128, **_) -> dict:
+        if _is_sharded(x) or _is_sharded(y):
+            return self._evaluate_streaming(x, y, batch_size)
         x = np.asarray(as_array(x))
         y = np.asarray(y if not hasattr(y, "to_numpy") else y.to_numpy())
         # Only flatten a single-column matrix; multi-output regression
@@ -710,7 +927,47 @@ class NeuralEstimator(Estimator):
             self.params, x, y, batch_size, loss_kind
         )
 
+    def _evaluate_streaming(self, x, y, batch_size: int) -> dict:
+        """Shard-streaming evaluate (same x/y resolution as
+        ``_fit_streaming``); metrics are row-weighted across shards,
+        perplexity averaged in log domain (exp-after-mean)."""
+        from learningorchestra_tpu.store import sharded as sh
+
+        x, y = sh.resolve_xy_views(x, y)
+        if self.params is None:
+            raise RuntimeError("evaluate() before fit()")
+        loss_kind = self._resolve_loss(np.asarray(y.head(256)))
+        if self._eval_fn is None or self._eval_loss_kind != loss_kind:
+            self._step_fn, self._eval_fn = self._build_step(loss_kind)
+            self._eval_loss_kind = loss_kind
+        ds = x.dataset
+        acc = sh.WeightedMetrics()
+        for k in range(ds.n_shards):
+            acc.add(
+                self._evaluate_arrays(
+                    self.params, x.load_shard(k), y.load_shard(k),
+                    batch_size, loss_kind,
+                ),
+                ds.shard_rows[k],
+            )
+        return acc.result()
+
     def predict(self, x, batch_size: int = 512, **_):
+        if _is_sharded(x):
+            # Stream shards; the OUTPUT still materializes (n_rows,
+            # out_dim) on host — logits/classes are orders of magnitude
+            # smaller than beyond-RAM features, but callers with huge
+            # row counts should predict per shard view themselves.
+            from learningorchestra_tpu.store import sharded as sh
+
+            view = x.view(x.fields) if isinstance(x, sh.ShardedDataset) \
+                else x
+            # Dtype passes through untouched — int token columns must
+            # stay int for embedding lookups, same as the fit loader.
+            return np.concatenate([
+                self.predict(view.load_shard(k), batch_size)
+                for k in range(view.dataset.n_shards)
+            ], axis=0)
         x = np.asarray(as_array(x))
         outs = []
         if self._apply_fn is None:
@@ -730,7 +987,20 @@ class NeuralEstimator(Estimator):
 
     # -- persistence (pytree checkpoint; see store/volumes.py) ---------------
 
-    def state_dict(self) -> dict:
+    def state_dict(self, *, quantize: bool = False) -> dict:
+        """``quantize=True`` stores large parameter tensors int8
+        (ops/quant.py row-wise format, ~4x smaller) and DROPS the
+        optimizer state — a quantized artifact is a serving/inference
+        binary; continuation training re-inits moments."""
+        if quantize:
+            from learningorchestra_tpu.ops.quant import quantize_pytree
+
+            return {
+                "params": quantize_pytree(jax.device_get(self.params)),
+                "opt_state": None,
+                "history": dict(self.history),
+                "accumulate_steps": getattr(self, "_accumulate_steps", 1),
+            }
         return {
             "params": jax.device_get(self.params),
             "opt_state": jax.device_get(self.opt_state),
@@ -739,7 +1009,15 @@ class NeuralEstimator(Estimator):
         }
 
     def load_state_dict(self, state: dict) -> None:
-        self.params = state["params"]
+        from learningorchestra_tpu.ops.quant import (
+            dequantize_pytree,
+            has_quantized_leaves,
+        )
+
+        params = state["params"]
+        if params is not None and has_quantized_leaves(params):
+            params = dequantize_pytree(params)
+        self.params = params
         # Restore the accumulation wrapper FIRST so the optimizer and
         # the restored opt_state structure agree (a MultiSteps state
         # under a plain optimizer crashes deep inside the jitted scan).
@@ -748,7 +1026,12 @@ class NeuralEstimator(Estimator):
         self.history = TrainHistory(state.get("history", {}))
 
     def __getstate__(self):
-        """dill support: drop jitted closures, keep module + host arrays."""
+        """dill support: drop jitted closures, keep module + host arrays.
+
+        With ``self._quantize_persist`` set (the train request's
+        ``quantize_checkpoint``), large parameter tensors persist int8
+        and the optimizer state is dropped — the artifact path's
+        quantized binary format."""
         d = dict(self.__dict__)
         d.pop("_decode_fns", None)  # jitted decode scans (GreedyDecodeMixin)
         d["_step_fn"] = None
@@ -760,7 +1043,25 @@ class NeuralEstimator(Estimator):
             else None
         d["opt_state"] = jax.device_get(d["opt_state"]) \
             if d["opt_state"] is not None else None
+        if d.get("_quantize_persist") and d["params"] is not None:
+            from learningorchestra_tpu.ops.quant import quantize_pytree
+
+            d["params"] = quantize_pytree(d["params"])
+            d["opt_state"] = None
         return d
+
+    def __setstate__(self, state):
+        from learningorchestra_tpu.ops.quant import (
+            dequantize_pytree,
+            has_quantized_leaves,
+        )
+
+        if state.get("params") is not None and has_quantized_leaves(
+            state["params"]
+        ):
+            state = dict(state)
+            state["params"] = dequantize_pytree(state["params"])
+        self.__dict__.update(state)
 
 
 class _NoShuffle:

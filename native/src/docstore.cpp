@@ -235,22 +235,49 @@ struct Collection {
     std::string line;
     char buf[1 << 16];
     std::string pending;
+    // Torn-tail recovery (same contract as the Python backend): a
+    // crash mid-append leaves at most one partial record at the END.
+    // Replay applies records up to the first invalid one, then (a) if
+    // any VALID record follows the damage, refuses to open — that is
+    // mid-file corruption, not a crash artifact; (b) otherwise
+    // truncates to the last good record so the next append starts a
+    // clean line instead of gluing onto partial bytes.
+    long good_end = 0;
+    bool torn = false, damaged = false;
     while (fgets(buf, sizeof buf, in)) {
       pending += buf;
       if (pending.empty() || pending.back() != '\n') continue;  // long line
       line.swap(pending);
       pending.clear();
+      long line_end = ftell(in);
       while (!line.empty() && (line.back() == '\n' || line.back() == '\r'))
         line.pop_back();
-      if (line.empty()) continue;
+      if (line.empty()) {
+        // Inside a torn region a blank line must NOT advance good_end
+        // — truncation would then keep the garbage bytes before it,
+        // and the next append would glue onto them.
+        if (!torn) good_end = line_end;
+        continue;
+      }
       std::vector<KV> op;
-      if (!parse_object(line, op)) continue;
+      if (!parse_object(line, op)) {
+        if (torn) {
+          continue;  // still scanning the damaged region
+        }
+        torn = true;
+        continue;
+      }
       std::string kind, d, idv, v;
       for (auto &kv : op) {
         if (kv.key == "op") kind = kv.raw_val;
         else if (kv.key == "d") d = kv.raw_val;
         else if (kv.key == "id") idv = kv.raw_val;
         else if (kv.key == "v") v = kv.raw_val;
+      }
+      if (torn) {
+        // A parseable record AFTER invalid bytes: mid-file damage.
+        if (!kind.empty()) { damaged = true; break; }
+        continue;
       }
       if (kind == "\"i\"") {
         std::string idraw;
@@ -268,8 +295,23 @@ struct Collection {
         long long nv = strtoll(v.c_str(), nullptr, 10);
         if (nv - 1 > max_seen) max_seen = nv - 1;
       }
+      good_end = line_end;
     }
+    if (!pending.empty()) torn = true;  // unterminated tail bytes
     fclose(in);
+    if (damaged) {
+      set_error("corrupt WAL " + path +
+                ": invalid record followed by valid records "
+                "(mid-file damage), refusing to open");
+      return false;
+    }
+    if (torn) {
+      if (truncate(path.c_str(), good_end) != 0) {
+        set_error("cannot truncate torn WAL tail of " + path + ": " +
+                  strerror(errno));
+        return false;
+      }
+    }
     next_id = max_seen + 1;
     return true;
   }
@@ -315,7 +357,7 @@ struct Store {
     auto coll = std::make_shared<Collection>();
     coll->path = root + "/" + name + ".wal";
     coll->durable = durable;
-    coll->replay();
+    if (!coll->replay()) return nullptr;  // mid-file corruption
     if (!coll->open_log()) return nullptr;
     colls.emplace(name, coll);
     return coll;
@@ -549,7 +591,14 @@ int64_t lods_open(const char *root, int durable) {
         names.push_back(fn.substr(0, fn.size() - 4));
     }
     closedir(dir);
-    for (auto &nm : names) store->get(nm, true);
+    for (auto &nm : names) {
+      if (!store->get(nm, true)) {
+        // Mid-file WAL corruption: refuse the whole open, loudly —
+        // silently skipping the collection would read as data loss
+        // (mirrors DocumentStore.__init__ raising CorruptWal).
+        return -1;
+      }
+    }
   }
   std::lock_guard<std::mutex> lock(g_handles_mu);
   g_handles.push_back(std::move(store));

@@ -1,0 +1,68 @@
+"""BERT-base MFU sweep on chip — VERDICT r3 item 2 (27% → 40%+).
+
+Sweeps (batch, seq, remat, flash) over the bf16 BertModel train step
+and prints samples/s + MFU per point.  Run when the tunnel is up:
+
+    PYTHONPATH=/root/.axon_site:/root/repo python scripts/bert_mfu_sweep.py
+
+All timing uses the looped methodology (TPU_EVIDENCE.md): K vs 3K fused
+epochs in single dispatches, differenced, so the tunnel's round-trip
+latency cancels.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+assert jax.devices()[0].platform == "tpu", jax.devices()
+print("device:", jax.devices()[0], flush=True)
+
+from bench import (  # noqa: E402 — repo root on PYTHONPATH
+    _fused_throughput,
+    _model_flops_per_sample,
+    _peak_flops,
+)
+from learningorchestra_tpu.models.text import BertModel  # noqa: E402
+
+PEAK = _peak_flops("tpu")
+rng = np.random.default_rng(0)
+
+# (seq, bs) grid: seq 128 is the BASELINE config-4 shape; 512 is where
+# the flash kernel pays off in-model.  bs rows chosen to bracket the
+# HBM limit of one v5e chip for BERT-base + adam.
+GRID = [
+    (128, 16), (128, 32), (128, 64), (128, 128),
+    (512, 8), (512, 16), (512, 32),
+]
+
+results = []
+for seq, bs in GRID:
+    for remat in (False, True):
+        n = max(4 * bs, 256)
+        tok = rng.integers(0, 30522, (n, seq), dtype=np.int32)
+        lab = rng.integers(0, 2, (n,), dtype=np.int32)
+        est = BertModel(max_len=seq, remat=remat)
+        est._init_params(jnp.asarray(tok[:1]))
+        per_sample = _model_flops_per_sample(est, jnp.asarray(tok[:1]))
+        try:
+            t0 = time.perf_counter()
+            thr = _fused_throughput(est, tok, lab, bs, k=2)
+            wall = time.perf_counter() - t0
+        except Exception as exc:  # noqa: BLE001 — OOM points just report
+            print(f"seq={seq} bs={bs} remat={remat}: FAILED {exc!r}",
+                  flush=True)
+            continue
+        mfu = thr * per_sample / PEAK if per_sample else 0.0
+        row = {
+            "seq": seq, "bs": bs, "remat": remat,
+            "samples_per_sec": round(thr, 1), "mfu": round(mfu, 4),
+            "wall_s": round(wall, 1),
+        }
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+best = max(results, key=lambda r: r["mfu"], default=None)
+print("BEST:", json.dumps(best), flush=True)

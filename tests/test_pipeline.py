@@ -224,3 +224,118 @@ class TestPipelineCheckpointing:
         est2.fit(x, y, epochs=1, batch_size=16, verbose=0,
                  checkpoint_dir=ckdir, resume=False)
         assert len(est2.history["loss"]) == 1
+
+
+class Test1F1BSchedule:
+    """1F1B (VERDICT r2 weak #5): the interleaved-backward schedule is
+    a SCHEDULE — loss and gradients must match the sequential oracle
+    (and hence gpipe) exactly, while in-flight activations drop from
+    O(n_micro) to O(pp)."""
+
+    def test_loss_and_grads_match_oracle(self):
+        from jax.sharding import PartitionSpec as P
+
+        from learningorchestra_tpu.parallel.pipeline import (
+            one_f_one_b_grads,
+        )
+
+        est = _built_estimator(pp=4, dp=2, schedule="1f1b")
+        x, y = _toy()
+        est._init_params(jnp.asarray(x[:1]))
+        xb, yb = jnp.asarray(x), jnp.asarray(y)
+        mb = jnp.ones(len(x), jnp.float32)
+
+        pipe = one_f_one_b_grads(
+            est._embed.apply, est._stage.apply, est._head.apply,
+            est._loss_fn, n_stages=est.pp, n_micro=est.n_micro,
+        )
+        stage_spec = jax.tree_util.tree_map(lambda _: P("pp"),
+                                            est.params[1])
+        smapped = jax.shard_map(
+            pipe, mesh=est.mesh,
+            in_specs=(P(), stage_spec, P(), P(("dp", "fsdp")),
+                      P(("dp", "fsdp")), P(("dp", "fsdp"))),
+            out_specs=(P(), P(), (P(), stage_spec, P())),
+        )
+        loss_1f1b, metrics_1f1b, g_1f1b = jax.jit(smapped)(
+            *est.params, xb, yb, mb
+        )
+
+        seq = sequential_loss(
+            est._embed.apply, est._stage.apply, est._head.apply,
+            est._loss_fn, n_stages=est.pp,
+        )
+        (loss_seq, metrics_seq), g_seq = jax.jit(
+            jax.value_and_grad(
+                lambda ps: seq(*ps, xb, yb, mb), has_aux=True
+            )
+        )(est.params)
+
+        np.testing.assert_allclose(
+            float(loss_1f1b), float(loss_seq), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            float(metrics_1f1b["accuracy"]),
+            float(metrics_seq["accuracy"]), rtol=1e-5,
+        )
+        flat_p, _ = jax.tree_util.tree_flatten(g_1f1b)
+        flat_s, _ = jax.tree_util.tree_flatten(g_seq)
+        assert len(flat_p) == len(flat_s)
+        for a, b in zip(flat_p, flat_s):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
+            )
+
+    def test_large_n_micro(self):
+        """The 1F1B payoff shape: n_micro = 4*pp (a GPipe memory-wall
+        breaker) still matches the oracle."""
+        from jax.sharding import PartitionSpec as P
+
+        from learningorchestra_tpu.parallel.pipeline import (
+            one_f_one_b_grads,
+        )
+
+        est = _built_estimator(pp=2, dp=4, n_microbatches=8,
+                               schedule="1f1b")
+        x, y = _toy(n=64)
+        est._init_params(jnp.asarray(x[:1]))
+        xb, yb = jnp.asarray(x), jnp.asarray(y)
+        mb = jnp.ones(len(x), jnp.float32)
+        pipe = one_f_one_b_grads(
+            est._embed.apply, est._stage.apply, est._head.apply,
+            est._loss_fn, n_stages=est.pp, n_micro=est.n_micro,
+        )
+        stage_spec = jax.tree_util.tree_map(lambda _: P("pp"),
+                                            est.params[1])
+        smapped = jax.shard_map(
+            pipe, mesh=est.mesh,
+            in_specs=(P(), stage_spec, P(), P(("dp", "fsdp")),
+                      P(("dp", "fsdp")), P(("dp", "fsdp"))),
+            out_specs=(P(), P(), (P(), stage_spec, P())),
+        )
+        loss_1f1b, _, g_1f1b = jax.jit(smapped)(*est.params, xb, yb, mb)
+        seq = sequential_loss(
+            est._embed.apply, est._stage.apply, est._head.apply,
+            est._loss_fn, n_stages=est.pp,
+        )
+        (loss_seq, _), g_seq = jax.jit(jax.value_and_grad(
+            lambda ps: seq(*ps, xb, yb, mb), has_aux=True
+        ))(est.params)
+        np.testing.assert_allclose(
+            float(loss_1f1b), float(loss_seq), rtol=1e-5
+        )
+        for a, b in zip(jax.tree_util.tree_leaves(g_1f1b),
+                        jax.tree_util.tree_leaves(g_seq)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
+            )
+
+    def test_fit_reduces_loss_1f1b(self):
+        est = _built_estimator(pp=4, dp=2, schedule="1f1b")
+        x, y = _toy(n=64)
+        est.fit(x, y, epochs=4, batch_size=32, verbose=0)
+        assert est.history["loss"][-1] < est.history["loss"][0]
+
+    def test_bad_schedule_rejected(self):
+        with pytest.raises(ValueError, match="schedule"):
+            _built_estimator(pp=2, dp=1, schedule="zigzag")

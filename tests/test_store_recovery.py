@@ -1,0 +1,218 @@
+"""Crash recovery + WAL-shipping replication (VERDICT r2 missing #2).
+
+Reference HA bar: the 3-node MongoDB replica set
+(docker-compose.yml:42-90).  Here the equivalent is (a) torn-write
+recovery on open — a kill -9 mid-append must never corrupt acknowledged
+writes or poison later appends — in BOTH store backends, and (b) a
+WAL-shipping read replica that catches up and can be promoted.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from learningorchestra_tpu.store import DocumentStore
+from learningorchestra_tpu.store.document_store import CorruptWal
+from learningorchestra_tpu.store.replica import WalReplica
+
+
+def _native_store(root):
+    from learningorchestra_tpu import native
+
+    if not native.native_available():
+        pytest.skip("native store unavailable")
+    return native.NativeDocumentStore(root)
+
+
+class TestTornTail:
+    def _seed(self, root):
+        s = DocumentStore(root)
+        for i in range(5):
+            s.insert_one("c", {"v": i})
+        s.close()
+        return root / "c.wal"
+
+    def test_python_truncates_torn_tail(self, tmp_path):
+        wal = self._seed(tmp_path / "db")
+        good = wal.stat().st_size
+        with open(wal, "ab") as fh:
+            fh.write(b'{"op": "i", "d": {"_id": 99, "v"')  # torn record
+        s = DocumentStore(tmp_path / "db")
+        assert s.count("c") == 5  # acknowledged writes intact
+        assert wal.stat().st_size == good  # tail cut, not glued onto
+        nid = s.insert_one("c", {"v": 5})  # appends still clean
+        s.close()
+        s2 = DocumentStore(tmp_path / "db")
+        assert s2.count("c") == 6
+        assert s2.find_one("c", nid)["v"] == 5
+        s2.close()
+
+    def test_python_torn_tail_with_newline(self, tmp_path):
+        wal = self._seed(tmp_path / "db")
+        with open(wal, "ab") as fh:
+            fh.write(b'{"op": "i", "d"\n')  # cut mid-record, has \n
+        s = DocumentStore(tmp_path / "db")
+        assert s.count("c") == 5
+        s.close()
+
+    def test_python_midfile_damage_refuses(self, tmp_path):
+        wal = self._seed(tmp_path / "db")
+        lines = wal.read_bytes().splitlines(keepends=True)
+        lines[1] = b'{"op": gar\n'  # damage with valid records AFTER
+        wal.write_bytes(b"".join(lines))
+        with pytest.raises(CorruptWal, match="mid-file"):
+            DocumentStore(tmp_path / "db")
+
+    def test_native_truncates_torn_tail(self, tmp_path):
+        wal = self._seed(tmp_path / "db")  # python writes, native reads
+        good = wal.stat().st_size
+        with open(wal, "ab") as fh:
+            fh.write(b'{"op": "i", "d": {"_id": 99, "v"')
+        s = _native_store(tmp_path / "db")
+        assert s.count("c") == 5
+        assert wal.stat().st_size == good
+        s.insert_one("c", {"v": 5})
+        s.close()
+        s2 = DocumentStore(tmp_path / "db")  # interchange still holds
+        assert s2.count("c") == 6
+        s2.close()
+
+    def test_native_midfile_damage_refuses(self, tmp_path):
+        wal = self._seed(tmp_path / "db")
+        lines = wal.read_bytes().splitlines(keepends=True)
+        lines[1] = b"garbage that is not json\n"
+        wal.write_bytes(b"".join(lines))
+        # Same contract as the Python backend: the OPEN fails loudly
+        # instead of silently dropping the damaged collection.
+        with pytest.raises(Exception, match="[Cc]orrupt"):
+            _native_store(tmp_path / "db")
+
+
+class TestKillNineStorm:
+    def test_acknowledged_writes_survive_sigkill(self, tmp_path):
+        """kill -9 mid-insert-storm (durable writes): reopen must see
+        every insert the child acknowledged, with zero corruption."""
+        script = textwrap.dedent("""
+            import os, sys
+            sys.path.insert(0, {repo!r})
+            from learningorchestra_tpu.store import DocumentStore
+            s = DocumentStore({root!r}, durable_writes=True)
+            i = 0
+            while True:
+                _id = s.insert_one("storm", {{"i": i, "pad": "x" * 64}})
+                print(_id, flush=True)  # ack AFTER the fsync'd append
+                i += 1
+        """).format(repo="/root/repo", root=str(tmp_path / "db"))
+        proc = subprocess.Popen(
+            [sys.executable, "-c", script],
+            stdout=subprocess.PIPE,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        acked = []
+        for line in proc.stdout:
+            acked.append(int(line))
+            if len(acked) >= 40:
+                os.kill(proc.pid, signal.SIGKILL)
+                break
+        # Drain whatever was in flight at kill time, then reap.
+        rest = proc.stdout.read().split()
+        acked += [int(v) for v in rest]
+        proc.wait()
+
+        s = DocumentStore(tmp_path / "db", durable_writes=True)
+        present = {d["_id"] for d in s.find("storm")}
+        missing = [a for a in acked if a not in present]
+        assert not missing, f"acknowledged writes lost: {missing}"
+        # Store still fully writable after recovery.
+        s.insert_one("storm", {"i": -1})
+        s.close()
+
+
+class TestWalReplica:
+    def test_ship_catchup_and_reads(self, tmp_path):
+        primary = DocumentStore(tmp_path / "p")
+        ra = WalReplica(tmp_path / "p", tmp_path / "r")
+        ids = [primary.insert_one("c", {"v": i}) for i in range(10)]
+        assert ra.lag_bytes() > 0
+        ra.sync()
+        assert ra.lag_bytes() == 0
+        assert ra.count("c") == 10
+        assert ra.find_one("c", ids[3])["v"] == 3
+
+        # Updates/deletes ship too.
+        primary.update_one("c", ids[0], {"v": 100})
+        primary.delete_one("c", ids[1])
+        ra.sync()
+        assert ra.find_one("c", ids[0])["v"] == 100
+        assert ra.find_one("c", ids[1]) is None
+        assert ra.count("c") == 9
+        primary.close()
+
+    def test_torn_primary_tail_never_ships(self, tmp_path):
+        primary = DocumentStore(tmp_path / "p")
+        primary.insert_one("c", {"v": 0})
+        primary.close()
+        with open(tmp_path / "p" / "c.wal", "ab") as fh:
+            fh.write(b'{"op": "i", "d": {"_id": 9')  # torn, no newline
+        ra = WalReplica(tmp_path / "p", tmp_path / "r")
+        ra.sync()
+        assert ra.count("c") == 1
+        shipped = (tmp_path / "r" / "c.wal").read_bytes()
+        assert shipped.endswith(b"\n")  # record-aligned shipping
+
+    def test_compaction_resync(self, tmp_path):
+        primary = DocumentStore(tmp_path / "p")
+        ids = [primary.insert_one("c", {"v": i}) for i in range(20)]
+        ra = WalReplica(tmp_path / "p", tmp_path / "r")
+        ra.sync()
+        for _id in ids[:15]:
+            primary.delete_one("c", _id)
+        primary.compact("c")  # WAL rewritten shorter than shipped
+        ra.sync()
+        assert ra.count("c") == 5
+        assert {d["v"] for d in ra.find("c")} == {15, 16, 17, 18, 19}
+        primary.close()
+
+    def test_drop_propagates(self, tmp_path):
+        primary = DocumentStore(tmp_path / "p")
+        primary.insert_one("gone", {"v": 1})
+        ra = WalReplica(tmp_path / "p", tmp_path / "r")
+        ra.sync()
+        assert ra.count("gone") == 1
+        primary.drop("gone")
+        ra.sync()
+        assert "gone" not in ra.list_collections()
+        assert not (tmp_path / "r" / "gone.wal").exists()
+        primary.close()
+
+    def test_replica_restart_resumes(self, tmp_path):
+        primary = DocumentStore(tmp_path / "p")
+        for i in range(5):
+            primary.insert_one("c", {"v": i})
+        WalReplica(tmp_path / "p", tmp_path / "r").sync()
+        for i in range(5, 8):
+            primary.insert_one("c", {"v": i})
+        # Fresh follower over the same replica dir: bootstraps from the
+        # shipped WAL, then ships only the delta (no duplication).
+        rb = WalReplica(tmp_path / "p", tmp_path / "r")
+        assert rb.count("c") == 5
+        rb.sync()
+        assert rb.count("c") == 8
+        primary.close()
+
+    def test_promote_failover(self, tmp_path):
+        primary = DocumentStore(tmp_path / "p")
+        ids = [primary.insert_one("c", {"v": i}) for i in range(4)]
+        ra = WalReplica(tmp_path / "p", tmp_path / "r")
+        promoted = ra.promote()
+        assert promoted.count("c") == 4
+        # New primary takes writes; ids continue past the old ones.
+        nid = promoted.insert_one("c", {"v": 99})
+        assert nid > max(ids)
+        promoted.close()
+        primary.close()
