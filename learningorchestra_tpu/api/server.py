@@ -290,6 +290,32 @@ class APIServer:
                 meta = self.dataset.create_csv(
                     name, url, shard_rows=shard_rows
                 )
+            elif kind == "tensor":
+                labels_url = body.get("labelsUrl")
+                if not labels_url:
+                    raise ValidationError(
+                        "tensor ingest needs 'labelsUrl' (.npy labels)"
+                    )
+                shard_rows = body.get("shardRows", 4096)
+                try:
+                    shard_rows = int(shard_rows)
+                except (TypeError, ValueError):
+                    raise ValidationError(
+                        "'shardRows' must be a positive integer"
+                    ) from None
+                if shard_rows <= 0:
+                    # Same contract as the CSV path: an explicit bad
+                    # value errors, never silently takes the default.
+                    raise ValidationError(
+                        "'shardRows' must be a positive integer"
+                    )
+                try:
+                    meta = self.dataset.create_tensor(
+                        name, url, labels_url=labels_url,
+                        shard_rows=shard_rows,
+                    )
+                except ValueError as exc:
+                    raise ValidationError(str(exc)) from None
             else:
                 meta = self.dataset.create_generic(name, url)
             return self._created(f"dataset/{kind}", meta)
@@ -764,7 +790,7 @@ class APIServer:
         # ---- Observe push (webhooks on state transitions) ----
         def webhook_register(m, body, query):
             name = m.group("name")
-            meta = self.ctx.require_existing(name)
+            self.ctx.require_existing(name)
             try:
                 hook = self.ctx.webhooks.register(
                     name, body.get("url"), body.get("events")
@@ -774,7 +800,14 @@ class APIServer:
             # Registration raced the job: if the artifact is ALREADY
             # terminal, the engine's completion path has fired and
             # will never fire again — deliver now instead of leaving
-            # the client waiting forever.
+            # the client waiting forever.  The metadata re-read comes
+            # AFTER the hook insert: a job finishing in between sees
+            # the hook (engine fires) OR we see the terminal state
+            # (immediate fire) — both orders deliver; reading before
+            # the insert would let the completion slip through the gap
+            # unseen by either side.  (Worst case both fire — webhook
+            # delivery is at-least-once, the standard contract.)
+            meta = self.ctx.artifacts.metadata.read(name) or {}
             event = None
             if meta.get("jobState") == "failed":
                 event = "failed"

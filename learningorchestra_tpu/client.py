@@ -57,6 +57,7 @@ class Context:
 
         self.dataset_csv = _Dataset(self, "csv")
         self.dataset_generic = _Dataset(self, "generic")
+        self.dataset_tensor = _TensorDataset(self)
         self.projection = _Projection(self)
         self.data_type = _DataType(self)
         self.transform = _Transform(self, "tensorflow")
@@ -175,6 +176,24 @@ class _Dataset(_Service):
         if shard_rows is not None:
             body["shardRows"] = int(shard_rows)
         return self.ctx.request("POST", f"/{self.service_path}", body)
+
+    def list(self) -> list[dict]:
+        return self.ctx.request("GET", f"/{self.service_path}")
+
+
+class _TensorDataset(_Service):
+    """N-D (image-shaped) sharded ingest: features + labels as .npy
+    files, memory-mapped and copied shard by shard — the beyond-RAM
+    path for BASELINE config 5-style image datasets."""
+
+    service_path = "dataset/tensor"
+
+    def insert(self, dataset_name: str, url: str, labels_url: str,
+               shard_rows: int = 4096) -> dict:
+        return self.ctx.request("POST", f"/{self.service_path}", {
+            "datasetName": dataset_name, "url": url,
+            "labelsUrl": labels_url, "shardRows": int(shard_rows),
+        })
 
     def list(self) -> list[dict]:
         return self.ctx.request("GET", f"/{self.service_path}")

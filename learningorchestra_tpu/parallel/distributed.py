@@ -533,19 +533,14 @@ class DistributedTrainer:
                             ).items()
                         })
                     self.history.append(metrics)
-                    final = epoch_i + 1 == epochs
-                    if checkpoint_dir and checkpoint_every > 0 and (
-                        final
-                        or (
-                            (epoch_i + 1) % checkpoint_every == 0
-                            and time.monotonic() - last_save
-                            >= checkpoint_min_interval_s
-                        )
-                    ):
-                        from learningorchestra_tpu.train import (
-                            checkpoint as ckpt,
-                        )
+                    from learningorchestra_tpu.train import (
+                        checkpoint as ckpt,
+                    )
 
+                    if checkpoint_dir and ckpt.should_save(
+                        epoch_i, epochs, checkpoint_every,
+                        checkpoint_min_interval_s, last_save,
+                    ):
                         ckpt.save(
                             checkpoint_dir, epoch_i + 1,
                             {"params": params, "opt_state": opt_state},

@@ -30,6 +30,7 @@ _HEADER_CLEAN_RE = re.compile(r"[^0-9a-zA-Z_]+")
 
 CSV_TYPE = "dataset/csv"
 GENERIC_TYPE = "dataset/generic"
+TENSOR_TYPE = "dataset/tensor"
 
 
 def _clean_header(header: list[str]) -> list[str]:
@@ -313,6 +314,102 @@ class DatasetService:
             "shardRows": shard_rows,
             "previewRows": len(preview),
         }
+
+    # -- tensor (N-D, image-shaped) -------------------------------------------
+
+    TENSOR_CHUNK_ROWS = 1024  # rows moved per mmap slice during ingest
+
+    def create_tensor(
+        self, name: str, url: str, *, labels_url: str,
+        shard_rows: int = 4096,
+    ) -> dict:
+        """Sharded ingest of N-D features (the image-dataset shape —
+        BASELINE config 5's ResNet/ImageNet, where a row is a (H, W, C)
+        block a CSV cannot sanely carry).  ``url``/``labels_url`` point
+        at ``.npy`` arrays; the source is memory-mapped and copied
+        shard by shard, so host memory stays O(chunk) whatever the
+        file size — the beyond-RAM contract of the CSV path
+        (database_api_image/database.py:86-151), for tensors.
+
+        The artifact trains exactly like a sharded CSV:
+        ``x="$name"`` (or ``"$name.x"``), ``y="$name.label"``.
+        """
+        self.ctx.require_new_name(name)
+        if int(shard_rows) <= 0:
+            raise ValueError("shardRows must be a positive integer")
+        meta = self.ctx.artifacts.metadata.create(
+            name, TENSOR_TYPE,
+            extra={"url": url, "labelsUrl": labels_url},
+        )
+
+        def ingest():
+            import numpy as np
+
+            from learningorchestra_tpu.store.sharded import (
+                ShardedTensorWriter,
+            )
+
+            feats = np.load(self._local_npy(url), mmap_mode="r")
+            labels = np.load(self._local_npy(labels_url), mmap_mode="r")
+            if feats.ndim < 2:
+                raise ValueError(
+                    f"features must be (rows, ...), got {feats.shape}"
+                )
+            if labels.shape[0] != feats.shape[0] or labels.ndim != 1:
+                raise ValueError(
+                    f"labels must be ({feats.shape[0]},), got "
+                    f"{labels.shape}"
+                )
+            root = self.ctx.volumes.path_for(TENSOR_TYPE, name)
+            writer = ShardedTensorWriter(
+                root,
+                {"x": feats.shape[1:], "label": ()},
+                rows_per_shard=int(shard_rows),
+            )
+            n = feats.shape[0]
+            step = self.TENSOR_CHUNK_ROWS
+            for i in range(0, n, step):
+                writer.append_rows({
+                    "x": np.asarray(feats[i:i + step]),
+                    "label": np.asarray(labels[i:i + step]),
+                })
+            manifest = writer.close()
+            return {
+                "fields": ["x", "label"],
+                "rows": n,
+                "sharded": True,
+                "shards": len(manifest["shard_rows"]),
+                "shardRows": int(shard_rows),
+                "featureShape": list(feats.shape[1:]),
+            }
+
+        self.ctx.engine.submit(
+            name,
+            ingest,
+            description=f"tensor ingest from {url}",
+            on_success=lambda r: r,
+        )
+        return meta
+
+    def _local_npy(self, url: str) -> str:
+        """A local filesystem path for an .npy source — downloads HTTP
+        sources to the datasets volume first (streamed to disk) so
+        ``np.load(mmap_mode='r')`` can map them."""
+        if url.startswith(("http://", "https://")):
+            import hashlib
+
+            import requests
+
+            cache_name = "npycache_" + hashlib.sha1(
+                url.encode()
+            ).hexdigest()[:16]
+            resp = requests.get(url, stream=True, timeout=60)
+            resp.raise_for_status()
+            path = self.ctx.volumes.save_stream(
+                GENERIC_TYPE, cache_name, resp.raw
+            )
+            return str(path)
+        return url[len("file://"):] if url.startswith("file://") else url
 
     # -- generic binary -------------------------------------------------------
 

@@ -78,15 +78,34 @@ class WalReplica:
                     dst.unlink()
         return shipped
 
+    # Shipped-tail window compared against the primary on every sync:
+    # detects a COMPACTED-then-REGROWN WAL whose size passed our offset
+    # again (size alone can't) — mid-record shipping would silently
+    # diverge the replica.
+    TAIL_CHECK = 64
+
     def _sync_one(self, name: str, src: Path) -> int:
         offset = self._offsets.get(name, 0)
         try:
             size = src.stat().st_size
         except FileNotFoundError:
             return 0
-        if size < offset:
-            # Compaction (or drop+recreate) rewrote the file shorter
-            # than what we shipped: restart this collection.
+        rewritten = size < offset
+        if not rewritten and offset > 0:
+            # Same-or-larger size: confirm the primary still holds the
+            # bytes we shipped by comparing the tail window.
+            dst = self.replica_root / f"{name}.wal"
+            check = min(self.TAIL_CHECK, offset)
+            with open(src, "rb") as fh:
+                fh.seek(offset - check)
+                primary_tail = fh.read(check)
+            with open(dst, "rb") as fh:
+                fh.seek(offset - check)
+                replica_tail = fh.read(check)
+            rewritten = primary_tail != replica_tail
+        if rewritten:
+            # Compaction (or drop+recreate) rewrote the file: restart
+            # this collection from byte 0.
             offset = 0
             self._docs[name] = {}
             dst = self.replica_root / f"{name}.wal"

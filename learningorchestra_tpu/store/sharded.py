@@ -144,6 +144,116 @@ class ShardedDatasetWriter:
         return manifest
 
 
+class ShardedTensorWriter:
+    """Streaming writer for N-D (tensor) columns — the image-dataset
+    shape (BASELINE config 5: ResNet/ImageNet), where a row's features
+    are a (H, W, C) block, not scalars.  Chunks of rows arrive as
+    arrays ({column: (k, *feature_shape)}) and flush into the same
+    shard/manifest layout the scalar writer produces, so every reader
+    (views, streaming fit, replica of the volume) works unchanged.
+    """
+
+    def __init__(self, root: str | Path, column_shapes: dict, *,
+                 rows_per_shard: int = 4096):
+        if rows_per_shard <= 0:
+            raise ValueError("rows_per_shard must be positive")
+        if not column_shapes:
+            raise ValueError("tensor dataset needs columns")
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.fields = list(column_shapes)
+        self.column_shapes = {
+            f: tuple(s) for f, s in column_shapes.items()
+        }
+        self.rows_per_shard = rows_per_shard
+        self._buf: dict[str, list] = {f: [] for f in self.fields}
+        self._buffered = 0
+        self._shard_rows: list[int] = []
+        self._dtypes: dict[str, np.dtype] = {}
+        self._closed = False
+
+    def append_rows(self, chunk: dict) -> None:
+        """A chunk of rows per column: {field: (k, *field_shape)}.
+        All columns must bring the same k."""
+        sizes = set()
+        for field in self.fields:
+            arr = np.asarray(chunk[field])
+            want = self.column_shapes[field]
+            if tuple(arr.shape[1:]) != want:
+                raise ValueError(
+                    f"column {field!r} rows have shape "
+                    f"{arr.shape[1:]}, dataset declares {want}"
+                )
+            if not np.issubdtype(arr.dtype, np.number):
+                raise ValueError(f"column {field!r} is not numeric")
+            sizes.add(arr.shape[0])
+        if len(sizes) != 1:
+            raise ValueError(f"columns brought differing row counts: "
+                             f"{sorted(sizes)}")
+        k = sizes.pop()
+        # Convert ONCE per chunk (astype only copies on a real dtype
+        # change), not per shard-boundary crossing.
+        converted = {}
+        for field in self.fields:
+            arr = np.asarray(chunk[field])
+            want = np.dtype(_narrow(arr.dtype))
+            converted[field] = arr.astype(want, copy=False)
+        off = 0
+        while off < k:
+            room = self.rows_per_shard - self._buffered
+            take = min(room, k - off)
+            for field in self.fields:
+                self._buf[field].append(
+                    converted[field][off:off + take]
+                )
+            self._buffered += take
+            off += take
+            if self._buffered >= self.rows_per_shard:
+                self._flush()
+
+    def _flush(self) -> None:
+        if not self._buffered:
+            return
+        cols = {}
+        for field in self.fields:
+            arr = np.concatenate(self._buf[field], axis=0)
+            cols[field] = arr
+            prev = self._dtypes.get(field)
+            self._dtypes[field] = arr.dtype if prev is None else \
+                np.dtype(_narrow(np.promote_types(prev, arr.dtype)))
+            self._buf[field] = []
+        k = len(self._shard_rows)
+        tmp = self.root / (_SHARD_FMT.format(k) + ".tmp")
+        with open(tmp, "wb") as fh:
+            np.savez(fh, **cols)
+        os.replace(tmp, self.root / _SHARD_FMT.format(k))
+        self._shard_rows.append(self._buffered)
+        self._buffered = 0
+
+    def close(self) -> dict:
+        if self._closed:
+            raise RuntimeError("writer already closed")
+        self._flush()
+        self._closed = True
+        manifest = {
+            "fields": self.fields,
+            "dtypes": {
+                f: np.dtype(self._dtypes.get(f, np.float32)).name
+                for f in self.fields
+            },
+            "column_shapes": {
+                f: list(s) for f, s in self.column_shapes.items()
+            },
+            "shard_rows": self._shard_rows,
+            "rows": int(sum(self._shard_rows)),
+            "rows_per_shard": self.rows_per_shard,
+        }
+        tmp = self.root / (MANIFEST + ".tmp")
+        tmp.write_text(json.dumps(manifest))
+        os.replace(tmp, self.root / MANIFEST)
+        return manifest
+
+
 class ShardedDataset:
     """Read handle over a sharded dataset directory — lazy: holds the
     manifest only; shards load one at a time via :meth:`load_shard`."""
@@ -162,6 +272,12 @@ class ShardedDataset:
         self.shard_rows: list[int] = [int(r) for r in m["shard_rows"]]
         self.n_rows: int = int(m["rows"])
         self.rows_per_shard: int = int(m["rows_per_shard"])
+        # Tensor datasets (ShardedTensorWriter) record per-column row
+        # shapes; scalar datasets predate the key and default to ().
+        self.column_shapes: dict[str, tuple] = {
+            f: tuple(s)
+            for f, s in (m.get("column_shapes") or {}).items()
+        }
 
     # -- handle surface -------------------------------------------------------
 
@@ -205,21 +321,40 @@ class ShardedDataset:
 class ShardedView:
     """Lazy column selection over a :class:`ShardedDataset`.
 
-    A string selects ONE column (1-D per shard — the ``y`` shape); a
+    A string selects ONE column — scalar columns yield (rows,), tensor
+    columns (ShardedTensorWriter) yield (rows, *feature_shape).  A
     list selects a feature matrix (rows, n_cols) stacked in the given
-    order, promoted to a common dtype (float32 for mixed columns).
+    order, promoted to a common dtype; a one-element list over a
+    tensor column collapses to that column (``feature_view`` on a
+    tensor dataset resolves to its x block).  Mixing tensor columns
+    into a multi-column matrix is an error — there is no meaningful
+    stacking axis.
     """
 
     def __init__(self, dataset: ShardedDataset, cols):
         self.dataset = dataset
-        self.single = isinstance(cols, str)
-        names = [cols] if self.single else list(cols)
+        single = isinstance(cols, str)
+        names = [cols] if single else list(cols)
         missing = [c for c in names if c not in dataset.fields]
         if missing:
             raise KeyError(
                 f"no such column(s) {missing} in sharded dataset "
                 f"(fields: {dataset.fields})"
             )
+        nd = [c for c in names if dataset.column_shapes.get(c)]
+        if not single and len(names) == 1 and nd:
+            # A one-element list over a TENSOR column collapses to the
+            # column itself (feature_view on a tensor dataset).  A
+            # one-element list over a scalar column stays a (rows, 1)
+            # matrix — the shape the in-memory DataFrame path feeds
+            # single-feature models.
+            single = True
+        elif nd and not single:
+            raise ValueError(
+                f"tensor column(s) {nd} cannot stack into a feature "
+                "matrix; select one column"
+            )
+        self.single = single
         self.cols = names
 
     def __len__(self) -> int:
@@ -236,7 +371,10 @@ class ShardedView:
     @property
     def shape(self) -> tuple:
         n = self.dataset.n_rows
-        return (n,) if self.single else (n, len(self.cols))
+        if self.single:
+            row = self.dataset.column_shapes.get(self.cols[0], ())
+            return (n, *row)
+        return (n, len(self.cols))
 
     def load_shard(self, k: int) -> np.ndarray:
         cols = self.dataset.load_shard(k, self.cols)

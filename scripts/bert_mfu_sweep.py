@@ -30,6 +30,18 @@ from learningorchestra_tpu.models.text import BertModel  # noqa: E402
 PEAK = _peak_flops("tpu")
 rng = np.random.default_rng(0)
 
+# One-time: prove the TRAIN path really lowers to the Pallas flash
+# kernel on chip (VERDICT r3 item 2's "not mha_reference" check) —
+# Mosaic kernels appear as tpu_custom_call in the HLO.
+_est = BertModel(max_len=128, num_layers=1)
+_tok = jnp.asarray(rng.integers(0, 30522, (1, 128), dtype=np.int32))
+_est._init_params(_tok)
+_hlo = jax.jit(_est.module.apply).lower(_est.params, _tok).as_text()
+print(json.dumps({
+    "check": "flash_in_train_path",
+    "tpu_custom_call": "tpu_custom_call" in _hlo or "CustomCall" in _hlo,
+}), flush=True)
+
 # (seq, bs) grid: seq 128 is the BASELINE config-4 shape; 512 is where
 # the flash kernel pays off in-model.  bs rows chosen to bracket the
 # HBM limit of one v5e chip for BERT-base + adam.

@@ -332,3 +332,137 @@ class TestShardedREST:
             assert hist and hist[-1]["loss"] < hist[0]["loss"]
         finally:
             server.shutdown()
+
+
+class TestTensorSharded:
+    def test_tensor_writer_round_trip(self, tmp_path):
+        from learningorchestra_tpu.store.sharded import (
+            ShardedTensorWriter,
+        )
+
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((100, 8, 8, 3)).astype(np.float32)
+        y = rng.integers(0, 10, (100,))
+        w = ShardedTensorWriter(
+            tmp_path / "t", {"x": (8, 8, 3), "label": ()},
+            rows_per_shard=32,
+        )
+        # Ragged chunk sizes must still cut exact 32-row shards.
+        for lo, hi in [(0, 10), (10, 50), (50, 100)]:
+            w.append_rows({"x": x[lo:hi], "label": y[lo:hi]})
+        w.close()
+        ds = ShardedDataset(tmp_path / "t")
+        assert ds.shard_rows == [32, 32, 32, 4]
+        assert ds.column_shapes["x"] == (8, 8, 3)
+        xv = ds.feature_view("label")
+        assert xv.single and xv.shape == (100, 8, 8, 3)
+        got = np.concatenate(
+            [xv.load_shard(k) for k in range(ds.n_shards)]
+        )
+        np.testing.assert_allclose(got, x, rtol=1e-6)
+        got_y = np.concatenate(
+            [ds["label"].load_shard(k) for k in range(ds.n_shards)]
+        )
+        np.testing.assert_array_equal(got_y, y)
+        with pytest.raises(ValueError, match="tensor column"):
+            ds.view(["x", "label"])
+
+    def test_tensor_writer_validates(self, tmp_path):
+        from learningorchestra_tpu.store.sharded import (
+            ShardedTensorWriter,
+        )
+
+        w = ShardedTensorWriter(
+            tmp_path / "v", {"x": (4,), "label": ()}, rows_per_shard=8
+        )
+        with pytest.raises(ValueError, match="declares"):
+            w.append_rows({"x": np.zeros((2, 5)),
+                           "label": np.zeros(2)})
+        with pytest.raises(ValueError, match="differing row counts"):
+            w.append_rows({"x": np.zeros((2, 4)),
+                           "label": np.zeros(3)})
+
+    def test_tensor_ingest_and_cnn_train_via_rest(self, tmp_path):
+        """BASELINE config 5's shape end-to-end: image-shaped .npy
+        sources ingest sharded (mmap'd, O(chunk) host memory) and a
+        CNN streams them through the SAME train request JSON."""
+        import time as _time
+
+        import requests
+
+        from learningorchestra_tpu.api.server import APIServer
+        from learningorchestra_tpu.config import Config
+
+        rng = np.random.default_rng(0)
+        # Labels derivable from the images so the CNN can learn.
+        x = rng.standard_normal((240, 28, 28, 1)).astype(np.float32)
+        y = (x.mean(axis=(1, 2, 3)) > 0).astype(np.int64)
+        np.save(tmp_path / "imgs.npy", x)
+        np.save(tmp_path / "labels.npy", y)
+
+        cfg = Config()
+        cfg.store.root = str(tmp_path / "store")
+        cfg.store.volume_root = str(tmp_path / "volumes")
+        server = APIServer(cfg)
+        port = server.start_background()
+        base = f"http://127.0.0.1:{port}/api/learningOrchestra/v1"
+
+        def poll(path, timeout=120):
+            deadline = _time.time() + timeout
+            while _time.time() < deadline:
+                docs = requests.get(base + path, timeout=10).json()
+                meta = docs[0] if isinstance(docs, list) and docs else {}
+                if meta.get("finished"):
+                    return meta
+                if meta.get("jobState") == "failed":
+                    raise AssertionError(meta.get("exception"))
+                _time.sleep(0.05)
+            raise AssertionError(f"timeout {path}")
+
+        try:
+            r = requests.post(f"{base}/dataset/tensor", json={
+                "datasetName": "imgs",
+                "url": str(tmp_path / "imgs.npy"),
+                "labelsUrl": str(tmp_path / "labels.npy"),
+                "shardRows": 64,
+            })
+            assert r.status_code == 201, r.text
+            meta = poll("/dataset/tensor/imgs")
+            assert meta["sharded"] is True
+            assert meta["rows"] == 240
+            assert meta["featureShape"] == [28, 28, 1]
+            assert meta["shards"] == 4  # 3x64 + 48
+
+            # Missing labelsUrl rejected.
+            bad = requests.post(f"{base}/dataset/tensor", json={
+                "datasetName": "imgs2",
+                "url": str(tmp_path / "imgs.npy"),
+            })
+            assert bad.status_code == 406
+
+            r = requests.post(f"{base}/model/tensorflow", json={
+                "name": "cnn",
+                "modulePath": "learningorchestra_tpu.models.vision",
+                "class": "MnistCNN",
+                "classParameters": {"num_classes": 2},
+            })
+            assert r.status_code == 201, r.text
+            poll("/model/tensorflow/cnn")
+            r = requests.post(f"{base}/train/tensorflow", json={
+                "name": "cnnfit", "modelName": "cnn",
+                "parentName": "cnn", "method": "fit",
+                "methodParameters": {
+                    "x": "$imgs", "y": "$imgs.label",
+                    "epochs": 6, "batch_size": 32,
+                },
+            })
+            assert r.status_code == 201, r.text
+            poll("/train/tensorflow/cnnfit")
+            docs = requests.get(
+                f"{base}/train/tensorflow/cnnfit",
+                params={"limit": 100},
+            ).json()
+            hist = [d for d in docs if d.get("docType") == "history"]
+            assert hist and hist[-1]["loss"] < hist[0]["loss"]
+        finally:
+            server.shutdown()

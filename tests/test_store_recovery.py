@@ -216,3 +216,35 @@ class TestWalReplica:
         assert nid > max(ids)
         promoted.close()
         primary.close()
+
+
+def test_replica_detects_compaction_after_regrowth(tmp_path):
+    """Size-only rewrite detection misses a WAL that compacted and then
+    REGREW past the shipped offset (code-review r3): the tail-window
+    comparison must trigger a clean resync instead of shipping from a
+    mid-record offset of the new file."""
+    primary = DocumentStore(tmp_path / "p")
+    ids = [
+        primary.insert_one("c", {"v": i, "pad": "x" * 40})
+        for i in range(50)
+    ]
+    ra = WalReplica(tmp_path / "p", tmp_path / "r")
+    ra.sync()
+    shipped = ra._offsets["c"]
+
+    for _id in ids[:45]:
+        primary.delete_one("c", _id)
+    primary.compact("c")  # shrinks below shipped offset
+    # ...then regrow PAST the shipped offset before the next sync.
+    new_ids = [
+        primary.insert_one("c", {"v": 100 + i, "pad": "y" * 40})
+        for i in range(60)
+    ]
+    assert (tmp_path / "p" / "c.wal").stat().st_size > shipped
+
+    ra.sync()
+    assert ra.count("c") == 5 + 60
+    got = {d["v"] for d in ra.find("c")}
+    assert got == {45, 46, 47, 48, 49} | {100 + i for i in range(60)}
+    assert ra.find_one("c", new_ids[0])["v"] == 100
+    primary.close()
