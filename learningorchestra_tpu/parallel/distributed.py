@@ -429,6 +429,10 @@ class DistributedTrainer:
         x, y = sh.resolve_xy_views(x, y)
 
         est = self.estimator
+        # Same column memory the single-device streaming fit records:
+        # a later est.predict(bare_dataset) must select these features,
+        # not the label column too.
+        est._sharded_fit_cols = list(x.cols)
         est._set_accumulation(accumulate_steps)
         ds = x.dataset
         y_head = np.asarray(y.head(256))

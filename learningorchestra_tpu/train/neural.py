@@ -766,6 +766,10 @@ class NeuralEstimator(Estimator):
                 "views (validation sets are small by construction)"
             )
         x, y = sh.resolve_xy_views(x, y)
+        # Remember the feature columns so a later predict on the BARE
+        # dataset ("x": "$big") selects the same features instead of
+        # accidentally feeding the label column too.
+        self._sharded_fit_cols = list(x.cols)
         self._set_accumulation(accumulate_steps)
 
         ds = x.dataset
@@ -960,8 +964,19 @@ class NeuralEstimator(Estimator):
             # row counts should predict per shard view themselves.
             from learningorchestra_tpu.store import sharded as sh
 
-            view = x.view(x.fields) if isinstance(x, sh.ShardedDataset) \
-                else x
+            if isinstance(x, sh.ShardedDataset):
+                # Bare dataset: prefer the columns the streaming fit
+                # trained on (they exclude the label); otherwise all.
+                cols = getattr(self, "_sharded_fit_cols", None)
+                if cols and all(c in x.fields for c in cols):
+                    # Always the LIST form: a one-element list keeps
+                    # the (rows, 1) matrix shape fit trained on
+                    # (ShardedView collapses only tensor columns).
+                    view = x.view(cols)
+                else:
+                    view = x.view(x.fields)
+            else:
+                view = x
             # Dtype passes through untouched — int token columns must
             # stay int for embedding lookups, same as the fit loader.
             return np.concatenate([
@@ -992,20 +1007,28 @@ class NeuralEstimator(Estimator):
         (ops/quant.py row-wise format, ~4x smaller) and DROPS the
         optimizer state — a quantized artifact is a serving/inference
         binary; continuation training re-inits moments."""
+        extras = {
+            "history": dict(self.history),
+            "accumulate_steps": getattr(self, "_accumulate_steps", 1),
+            # Feature-column memory for bare-sharded-dataset predict;
+            # must survive persistence or the restored model reverts
+            # to feeding the label column.
+            "sharded_fit_cols": getattr(
+                self, "_sharded_fit_cols", None
+            ),
+        }
         if quantize:
             from learningorchestra_tpu.ops.quant import quantize_pytree
 
             return {
                 "params": quantize_pytree(jax.device_get(self.params)),
                 "opt_state": None,
-                "history": dict(self.history),
-                "accumulate_steps": getattr(self, "_accumulate_steps", 1),
+                **extras,
             }
         return {
             "params": jax.device_get(self.params),
             "opt_state": jax.device_get(self.opt_state),
-            "history": dict(self.history),
-            "accumulate_steps": getattr(self, "_accumulate_steps", 1),
+            **extras,
         }
 
     def load_state_dict(self, state: dict) -> None:
@@ -1024,6 +1047,9 @@ class NeuralEstimator(Estimator):
         self._set_accumulation(state.get("accumulate_steps", 1))
         self.opt_state = state["opt_state"]
         self.history = TrainHistory(state.get("history", {}))
+        cols = state.get("sharded_fit_cols")
+        if cols:
+            self._sharded_fit_cols = list(cols)
 
     def __getstate__(self):
         """dill support: drop jitted closures, keep module + host arrays.

@@ -466,3 +466,66 @@ class TestTensorSharded:
             assert hist and hist[-1]["loss"] < hist[0]["loss"]
         finally:
             server.shutdown()
+
+
+def test_bare_dataset_predict_uses_fit_columns(tmp_path):
+    """predict("$big") after a streaming fit must select the SAME
+    feature columns the fit used — not feed the label column too
+    (found by the round-3 example: predict crashed with a shape error
+    on exactly the dataset fit() accepted)."""
+    from learningorchestra_tpu.models.mlp import MLPClassifier
+
+    ds, x, _ = _write(tmp_path, n=96, rows_per_shard=32)
+    est = MLPClassifier(hidden_layer_sizes=[8], num_classes=3)
+    est.fit(ds, ds["label"], epochs=2, batch_size=32)
+    preds = est.predict(ds)  # bare dataset, like "x": "$big"
+    assert preds.shape == (96, 3)
+    np.testing.assert_allclose(
+        preds, est.predict(x), rtol=1e-5, atol=1e-5
+    )
+    # The column memory survives the state_dict persistence contract.
+    fresh = MLPClassifier(hidden_layer_sizes=[8], num_classes=3)
+    fresh.load_state_dict(est.state_dict())
+    np.testing.assert_allclose(
+        fresh.predict(ds), preds, rtol=1e-5, atol=1e-5
+    )
+
+
+def test_bare_dataset_predict_single_feature(tmp_path):
+    """One-feature datasets train on (rows, 1) matrices; the bare
+    predict must reproduce that shape, not a 1-D vector."""
+    from learningorchestra_tpu.models.mlp import MLPClassifier
+    from learningorchestra_tpu.store.sharded import (
+        ShardedDataset,
+        ShardedDatasetWriter,
+    )
+
+    rng = np.random.default_rng(5)
+    w = ShardedDatasetWriter(tmp_path / "one", ["f", "label"],
+                             rows_per_shard=32)
+    for _ in range(64):
+        f = float(rng.standard_normal())
+        w.append([f, int(f > 0)])
+    w.close()
+    ds = ShardedDataset(tmp_path / "one")
+    est = MLPClassifier(hidden_layer_sizes=[4], num_classes=2)
+    est.fit(ds, ds["label"], epochs=2, batch_size=32)
+    preds = est.predict(ds)
+    assert preds.shape == (64, 2)
+
+
+def test_distributed_streaming_records_fit_columns(tmp_path):
+    """The distributed streaming fit records the same column memory —
+    est.predict(bare_dataset) works after a mesh fit too."""
+    from learningorchestra_tpu.models.mlp import MLPClassifier
+    from learningorchestra_tpu.parallel.distributed import (
+        DistributedTrainer,
+    )
+    from learningorchestra_tpu.parallel.mesh import MeshSpec
+
+    ds, x, _ = _write(tmp_path, n=128, rows_per_shard=64)
+    est = MLPClassifier(hidden_layer_sizes=[8], num_classes=3)
+    trainer = DistributedTrainer(est, spec=MeshSpec(dp=4))
+    trainer.fit(ds, ds["label"], epochs=2, batch_size=32)
+    preds = est.predict(ds)
+    assert preds.shape == (128, 3)
