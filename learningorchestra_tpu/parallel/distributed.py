@@ -232,6 +232,7 @@ class DistributedTrainer:
         checkpoint_min_interval_s: float = 60.0,
         resume: bool = True,
         accumulate_steps: int = 1,
+        checkpoint_async: bool = True,
         **_,
     ) -> "DistributedTrainer":
         """Same managed in-loop checkpointing contract as the
@@ -253,6 +254,7 @@ class DistributedTrainer:
                 checkpoint_every=checkpoint_every,
                 checkpoint_min_interval_s=checkpoint_min_interval_s,
                 resume=resume, accumulate_steps=accumulate_steps,
+                checkpoint_async=checkpoint_async,
             )
         est = self.estimator
         # Explicit (re)configuration each fit: no silent inheritance of
@@ -278,95 +280,106 @@ class DistributedTrainer:
             self._check_seq_divisible(np.asarray(validation_data[0]))
 
         start_epoch = 0
-        with self._mesh_bound():
-            if est.params is None:
-                est._init_params(jnp.asarray(x[:1]))
-            self._ensure_fns(loss_kind, shuffle)
+        try:
+            with self._mesh_bound():
+                if est.params is None:
+                    est._init_params(jnp.asarray(x[:1]))
+                self._ensure_fns(loss_kind, shuffle)
 
-            params, opt_state = self._place_state()
-            if checkpoint_dir and resume:
-                from learningorchestra_tpu.train import checkpoint as ckpt
+                params, opt_state = self._place_state()
+                if checkpoint_dir and resume:
+                    from learningorchestra_tpu.train import checkpoint as ckpt
 
-                # Sharded restore: the placed (mesh-sharded) state is the
-                # template, so orbax loads each shard straight onto its
-                # device — no host-side full-state materialization, and
-                # the saving mesh shape need not match this one.
-                loaded = ckpt.load_latest(
-                    checkpoint_dir,
-                    {"params": params, "opt_state": opt_state},
-                )
-                if loaded is not None:
-                    state, step, past_history = loaded
-                    params = state["params"]
-                    opt_state = state["opt_state"]
-                    self.history = TrainHistory(past_history)
-                    start_epoch = step
-
-            # Upload the epoch-batched dataset ONCE, sharded over the
-            # data axes; epochs below reshuffle batch order on device.
-            rng = np.random.default_rng(est.seed)
-            xb, yb, mb = _batch_data(
-                x, y_arr, batch_size, rng if shuffle else _NoShuffle()
-            )
-            n_samples = xb.shape[0] * xb.shape[1]
-            xs = self._put_global(xb, self._data_sharding(xb.ndim, tokens))
-            ys = self._put_global(yb, self._data_sharding(yb.ndim, False))
-            ms = self._put_global(mb, self._data_sharding(mb.ndim, False))
-            root_key = jax.random.PRNGKey(est.seed)
-            last_save = time.monotonic()
-            for epoch_i in range(start_epoch, epochs):
-                t0 = time.perf_counter()
-                params, opt_state, metrics = self._epoch_fn(
-                    params, opt_state, xs, ys, ms,
-                    jax.random.fold_in(root_key, epoch_i),
-                )
-                # One host transfer for all metric scalars (replicated
-                # outputs, so this is process-local even multi-host).
-                metrics = {
-                    k: float(v)
-                    for k, v in jax.device_get(metrics).items()
-                }
-                dt = time.perf_counter() - t0
-                metrics["epoch_time"] = dt
-                metrics["samples_per_sec"] = n_samples / dt
-                if validation_data is not None:
-                    vx, vy = validation_data
-                    metrics.update(
-                        {
-                            f"val_{k}": v
-                            for k, v in self.evaluate(
-                                vx, vy, batch_size=batch_size,
-                                _params=params,
-                            ).items()
-                        }
-                    )
-                self.history.append(metrics)
-                final = epoch_i + 1 == epochs
-                if checkpoint_dir and checkpoint_every > 0 and (
-                    final
-                    or (
-                        (epoch_i + 1) % checkpoint_every == 0
-                        and time.monotonic() - last_save
-                        >= checkpoint_min_interval_s
-                    )
-                ):
-                    from learningorchestra_tpu.train import (
-                        checkpoint as ckpt,
-                    )
-
-                    ckpt.save(
-                        checkpoint_dir, epoch_i + 1,
+                    # Sharded restore: the placed (mesh-sharded) state is the
+                    # template, so orbax loads each shard straight onto its
+                    # device — no host-side full-state materialization, and
+                    # the saving mesh shape need not match this one.
+                    loaded = ckpt.load_latest(
+                        checkpoint_dir,
                         {"params": params, "opt_state": opt_state},
-                        history=dict(self.history),
                     )
-                    last_save = time.monotonic()
-                if verbose:
-                    from learningorchestra_tpu.log import get_logger
+                    if loaded is not None:
+                        state, step, past_history = loaded
+                        params = state["params"]
+                        opt_state = state["opt_state"]
+                        self.history = TrainHistory(past_history)
+                        start_epoch = step
 
-                    get_logger("train").info(
-                        "epoch %d/%d: %s", epoch_i + 1, epochs, metrics
+                # Upload the epoch-batched dataset ONCE, sharded over the
+                # data axes; epochs below reshuffle batch order on device.
+                rng = np.random.default_rng(est.seed)
+                xb, yb, mb = _batch_data(
+                    x, y_arr, batch_size, rng if shuffle else _NoShuffle()
+                )
+                n_samples = xb.shape[0] * xb.shape[1]
+                xs = self._put_global(xb, self._data_sharding(xb.ndim, tokens))
+                ys = self._put_global(yb, self._data_sharding(yb.ndim, False))
+                ms = self._put_global(mb, self._data_sharding(mb.ndim, False))
+                root_key = jax.random.PRNGKey(est.seed)
+                last_save = time.monotonic()
+                for epoch_i in range(start_epoch, epochs):
+                    t0 = time.perf_counter()
+                    params, opt_state, metrics = self._epoch_fn(
+                        params, opt_state, xs, ys, ms,
+                        jax.random.fold_in(root_key, epoch_i),
                     )
+                    # One host transfer for all metric scalars (replicated
+                    # outputs, so this is process-local even multi-host).
+                    metrics = {
+                        k: float(v)
+                        for k, v in jax.device_get(metrics).items()
+                    }
+                    dt = time.perf_counter() - t0
+                    metrics["epoch_time"] = dt
+                    metrics["samples_per_sec"] = n_samples / dt
+                    if validation_data is not None:
+                        vx, vy = validation_data
+                        metrics.update(
+                            {
+                                f"val_{k}": v
+                                for k, v in self.evaluate(
+                                    vx, vy, batch_size=batch_size,
+                                    _params=params,
+                                ).items()
+                            }
+                        )
+                    self.history.append(metrics)
+                    final = epoch_i + 1 == epochs
+                    if checkpoint_dir and checkpoint_every > 0 and (
+                        final
+                        or (
+                            (epoch_i + 1) % checkpoint_every == 0
+                            and time.monotonic() - last_save
+                            >= checkpoint_min_interval_s
+                        )
+                    ):
+                        from learningorchestra_tpu.train import (
+                            checkpoint as ckpt,
+                        )
 
+                        ckpt.save(
+                            checkpoint_dir, epoch_i + 1,
+                            {"params": params, "opt_state": opt_state},
+                            history=dict(self.history),
+                            async_save=checkpoint_async,
+                        )
+                        last_save = time.monotonic()
+                    if verbose:
+                        from learningorchestra_tpu.log import get_logger
+
+                        get_logger("train").info(
+                            "epoch %d/%d: %s", epoch_i + 1, epochs, metrics
+                        )
+
+        finally:
+            if checkpoint_dir:
+                from learningorchestra_tpu.train import (
+                    checkpoint as ckpt,
+                )
+
+                # The last async save must be durable when fit
+                # returns — exception paths included.
+                ckpt.finalize_async(checkpoint_dir)
         # Hand the trained state back to the estimator (host pytree) so the
         # artifact contract — any step re-executable from the stored binary
         # (SURVEY §5.4) — holds regardless of which path trained it.
@@ -401,6 +414,7 @@ class DistributedTrainer:
         self, x, y, *, epochs, batch_size, validation_data, shuffle,
         verbose, checkpoint_dir, checkpoint_every,
         checkpoint_min_interval_s, resume, accumulate_steps,
+        checkpoint_async: bool = True,
     ) -> "DistributedTrainer":
         """Shard-streaming distributed fit over a beyond-RAM dataset.
 
@@ -459,106 +473,116 @@ class DistributedTrainer:
             return _batch_data(xs, ys, batch_size, rng)
 
         start_epoch = 0
-        with self._mesh_bound():
-            if est.params is None:
-                est._init_params(
-                    jnp.asarray(np.asarray(x.head(1), np.float32))
-                )
-            self._ensure_fns(loss_kind, shuffle)
-            params, opt_state = self._place_state()
-            if checkpoint_dir and resume:
-                from learningorchestra_tpu.train import checkpoint as ckpt
-
-                loaded = ckpt.load_latest(
-                    checkpoint_dir,
-                    {"params": params, "opt_state": opt_state},
-                )
-                if loaded is not None:
-                    state, step, past_history = loaded
-                    params = state["params"]
-                    opt_state = state["opt_state"]
-                    self.history = TrainHistory(past_history)
-                    start_epoch = step
-
-            root_key = jax.random.PRNGKey(est.seed)
-            last_save = time.monotonic()
-            with concurrent.futures.ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="shard-io"
-            ) as io:
-                for epoch_i in range(start_epoch, epochs):
-                    t0 = time.perf_counter()
-                    # Same shard order on every process.
-                    order = (
-                        np.random.default_rng(
-                            [est.seed, 3, epoch_i]
-                        ).permutation(ds.n_shards)
-                        if shuffle else np.arange(ds.n_shards)
+        try:
+            with self._mesh_bound():
+                if est.params is None:
+                    est._init_params(
+                        jnp.asarray(np.asarray(x.head(1), np.float32))
                     )
-                    acc = sh.WeightedMetrics()
-                    nxt = io.submit(load, epoch_i, 0, int(order[0]))
-                    for pos, k in enumerate(order):
-                        xb, yb, mb = nxt.result()
-                        if pos + 1 < len(order):
-                            nxt = io.submit(
-                                load, epoch_i, pos + 1,
-                                int(order[pos + 1]),
+                self._ensure_fns(loss_kind, shuffle)
+                params, opt_state = self._place_state()
+                if checkpoint_dir and resume:
+                    from learningorchestra_tpu.train import checkpoint as ckpt
+
+                    loaded = ckpt.load_latest(
+                        checkpoint_dir,
+                        {"params": params, "opt_state": opt_state},
+                    )
+                    if loaded is not None:
+                        state, step, past_history = loaded
+                        params = state["params"]
+                        opt_state = state["opt_state"]
+                        self.history = TrainHistory(past_history)
+                        start_epoch = step
+
+                root_key = jax.random.PRNGKey(est.seed)
+                last_save = time.monotonic()
+                with concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="shard-io"
+                ) as io:
+                    for epoch_i in range(start_epoch, epochs):
+                        t0 = time.perf_counter()
+                        # Same shard order on every process.
+                        order = (
+                            np.random.default_rng(
+                                [est.seed, 3, epoch_i]
+                            ).permutation(ds.n_shards)
+                            if shuffle else np.arange(ds.n_shards)
+                        )
+                        acc = sh.WeightedMetrics()
+                        nxt = io.submit(load, epoch_i, 0, int(order[0]))
+                        for pos, k in enumerate(order):
+                            xb, yb, mb = nxt.result()
+                            if pos + 1 < len(order):
+                                nxt = io.submit(
+                                    load, epoch_i, pos + 1,
+                                    int(order[pos + 1]),
+                                )
+                            tokens = np.issubdtype(xb.dtype, np.integer)
+                            params, opt_state, metrics = self._epoch_fn(
+                                params, opt_state,
+                                self._put_global(
+                                    xb, self._data_sharding(xb.ndim, tokens)
+                                ),
+                                self._put_global(
+                                    yb, self._data_sharding(yb.ndim, False)
+                                ),
+                                self._put_global(
+                                    mb, self._data_sharding(mb.ndim, False)
+                                ),
+                                jax.random.fold_in(
+                                    root_key, epoch_i * ds.n_shards + pos
+                                ),
                             )
-                        tokens = np.issubdtype(xb.dtype, np.integer)
-                        params, opt_state, metrics = self._epoch_fn(
-                            params, opt_state,
-                            self._put_global(
-                                xb, self._data_sharding(xb.ndim, tokens)
-                            ),
-                            self._put_global(
-                                yb, self._data_sharding(yb.ndim, False)
-                            ),
-                            self._put_global(
-                                mb, self._data_sharding(mb.ndim, False)
-                            ),
-                            jax.random.fold_in(
-                                root_key, epoch_i * ds.n_shards + pos
-                            ),
-                        )
-                        acc.add(
-                            jax.device_get(metrics),
-                            ds.shard_rows[int(k)],
-                        )
-                    metrics = acc.result()
-                    dt = time.perf_counter() - t0
-                    metrics["epoch_time"] = dt
-                    metrics["samples_per_sec"] = ds.n_rows / dt
-                    if validation_data is not None:
-                        vx, vy = validation_data
-                        metrics.update({
-                            f"val_{k2}": v
-                            for k2, v in self.evaluate(
-                                vx, vy, batch_size=batch_size,
-                                _params=params,
-                            ).items()
-                        })
-                    self.history.append(metrics)
-                    from learningorchestra_tpu.train import (
-                        checkpoint as ckpt,
-                    )
-
-                    if checkpoint_dir and ckpt.should_save(
-                        epoch_i, epochs, checkpoint_every,
-                        checkpoint_min_interval_s, last_save,
-                    ):
-                        ckpt.save(
-                            checkpoint_dir, epoch_i + 1,
-                            {"params": params, "opt_state": opt_state},
-                            history=dict(self.history),
-                        )
-                        last_save = time.monotonic()
-                    if verbose:
-                        from learningorchestra_tpu.log import get_logger
-
-                        get_logger("train").info(
-                            "epoch %d/%d: %s", epoch_i + 1, epochs,
-                            metrics,
+                            acc.add(
+                                jax.device_get(metrics),
+                                ds.shard_rows[int(k)],
+                            )
+                        metrics = acc.result()
+                        dt = time.perf_counter() - t0
+                        metrics["epoch_time"] = dt
+                        metrics["samples_per_sec"] = ds.n_rows / dt
+                        if validation_data is not None:
+                            vx, vy = validation_data
+                            metrics.update({
+                                f"val_{k2}": v
+                                for k2, v in self.evaluate(
+                                    vx, vy, batch_size=batch_size,
+                                    _params=params,
+                                ).items()
+                            })
+                        self.history.append(metrics)
+                        from learningorchestra_tpu.train import (
+                            checkpoint as ckpt,
                         )
 
+                        if checkpoint_dir and ckpt.should_save(
+                            epoch_i, epochs, checkpoint_every,
+                            checkpoint_min_interval_s, last_save,
+                        ):
+                            ckpt.save(
+                                checkpoint_dir, epoch_i + 1,
+                                {"params": params, "opt_state": opt_state},
+                                history=dict(self.history),
+                                async_save=checkpoint_async,
+                            )
+                            last_save = time.monotonic()
+                        if verbose:
+                            from learningorchestra_tpu.log import get_logger
+
+                            get_logger("train").info(
+                                "epoch %d/%d: %s", epoch_i + 1, epochs,
+                                metrics,
+                            )
+
+        finally:
+            if checkpoint_dir:
+                from learningorchestra_tpu.train import (
+                    checkpoint as ckpt,
+                )
+
+                # Durable-on-return, exception paths included.
+                ckpt.finalize_async(checkpoint_dir)
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 

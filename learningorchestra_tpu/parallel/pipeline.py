@@ -605,7 +605,7 @@ class PipelinedTransformer:
             checkpoint_dir: str | None = None,
             checkpoint_every: int = 1,
             checkpoint_min_interval_s: float = 60.0,
-            resume: bool = True, **_):
+            resume: bool = True, checkpoint_async: bool = True, **_):
         """Same managed in-loop checkpointing contract as
         ``NeuralEstimator.fit``: with ``checkpoint_dir`` set the
         (stage-stacked) state persists every ``checkpoint_every``
@@ -670,50 +670,57 @@ class PipelinedTransformer:
             # shuffles exactly as the original would at this epoch.
             for _ in range(start_epoch):
                 rng.permutation(n)
-        for epoch_i in range(start_epoch, epochs):
-            order = rng.permutation(n) if shuffle else np.arange(n)
-            epoch_metrics = []
-            for lo in range(0, n, batch_size):
-                idx = order[lo: lo + batch_size]
-                if len(idx) < batch_size:  # pad + mask the tail batch
-                    pad = batch_size - len(idx)
-                    idx = np.concatenate([idx, idx[:1].repeat(pad)])
-                    mask = np.concatenate(
-                        [np.ones(batch_size - pad, np.float32),
-                         np.zeros(pad, np.float32)]
+        try:
+            for epoch_i in range(start_epoch, epochs):
+                order = rng.permutation(n) if shuffle else np.arange(n)
+                epoch_metrics = []
+                for lo in range(0, n, batch_size):
+                    idx = order[lo: lo + batch_size]
+                    if len(idx) < batch_size:  # pad + mask the tail batch
+                        pad = batch_size - len(idx)
+                        idx = np.concatenate([idx, idx[:1].repeat(pad)])
+                        mask = np.concatenate(
+                            [np.ones(batch_size - pad, np.float32),
+                             np.zeros(pad, np.float32)]
+                        )
+                    else:
+                        mask = np.ones(batch_size, np.float32)
+                    self.params, self.opt_state, metrics = self._step(
+                        self.params, self.opt_state,
+                        jnp.asarray(x[idx]), jnp.asarray(y[idx]),
+                        jnp.asarray(mask),
                     )
-                else:
-                    mask = np.ones(batch_size, np.float32)
-                self.params, self.opt_state, metrics = self._step(
-                    self.params, self.opt_state,
-                    jnp.asarray(x[idx]), jnp.asarray(y[idx]),
-                    jnp.asarray(mask),
-                )
-                epoch_metrics.append(metrics)
-            stacked = jax.device_get(epoch_metrics)
-            epoch_row = {
-                k: float(np.mean([m[k] for m in stacked]))
-                for k in stacked[0]
-            }
-            if "perplexity" in epoch_row:  # raw CE until post-mean exp
-                epoch_row["perplexity"] = float(
-                    np.exp(epoch_row["perplexity"])
-                )
-            self.history.append(epoch_row)
-            if verbose:
-                print(f"pipeline epoch: {self.history['loss'][-1]:.4f}",
-                      flush=True)
-            if checkpoint_dir and ckpt_mod.should_save(
-                epoch_i, epochs, checkpoint_every,
-                checkpoint_min_interval_s, last_save,
-            ):
-                ckpt_mod.save(
-                    checkpoint_dir, epoch_i + 1,
-                    {"params": self.params,
-                     "opt_state": self.opt_state},
-                    history=dict(self.history),
-                )
-                last_save = time.monotonic()
+                    epoch_metrics.append(metrics)
+                stacked = jax.device_get(epoch_metrics)
+                epoch_row = {
+                    k: float(np.mean([m[k] for m in stacked]))
+                    for k in stacked[0]
+                }
+                if "perplexity" in epoch_row:  # raw CE until post-mean exp
+                    epoch_row["perplexity"] = float(
+                        np.exp(epoch_row["perplexity"])
+                    )
+                self.history.append(epoch_row)
+                if verbose:
+                    print(f"pipeline epoch: {self.history['loss'][-1]:.4f}",
+                          flush=True)
+                if checkpoint_dir and ckpt_mod.should_save(
+                    epoch_i, epochs, checkpoint_every,
+                    checkpoint_min_interval_s, last_save,
+                ):
+                    ckpt_mod.save(
+                        checkpoint_dir, epoch_i + 1,
+                        {"params": self.params,
+                         "opt_state": self.opt_state},
+                        history=dict(self.history),
+                        async_save=checkpoint_async,
+                    )
+                    last_save = time.monotonic()
+        finally:
+            if checkpoint_dir:
+                # The last async save must be durable when fit
+                # returns — exception paths included.
+                ckpt_mod.finalize_async(checkpoint_dir)
         return self
 
     _CHUNK = 512  # inference batch: fixed shape -> one compile

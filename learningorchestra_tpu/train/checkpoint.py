@@ -61,14 +61,135 @@ def _barrier(tag: str) -> None:
         multihost_utils.sync_global_devices(tag)
 
 
+def _publish(directory: Path, step: int, history: dict | None) -> None:
+    """Commit point: the ``latest.json`` marker names the newest FULLY
+    WRITTEN checkpoint; readers never see a step the data hasn't
+    landed for.  Also prunes old steps."""
+    marker = {"step": step, "history": history or {}}
+    tmp = directory / "latest.json.tmp"
+    tmp.write_text(json.dumps(marker))
+    os.replace(tmp, directory / "latest.json")
+    for old in sorted(directory.glob("step_*")):
+        try:
+            n = int(old.name.split("_", 1)[1])
+        except ValueError:
+            continue
+        if n <= step - KEEP:
+            shutil.rmtree(old, ignore_errors=True)
+
+
+# Async bookkeeping is PER CHECKPOINT DIRECTORY: the job engine runs
+# fits concurrently on worker threads (jobs/engine.py, max_workers=8),
+# so a single global slot would let one job's finalize swallow (or
+# republish over) another's marker.  Each directory gets its own
+# AsyncCheckpointer + one-pending-save slot, guarded by its own lock.
+import threading as _threading
+
+
+class _AsyncSlot:
+    def __init__(self):
+        self.lock = _threading.Lock()
+        self.ckpt = None
+        self.pending = None  # (step, history) awaiting publish
+
+
+_SLOTS: dict[str, _AsyncSlot] = {}
+_SLOTS_LOCK = _threading.Lock()
+_ATEXIT = {"registered": False}
+
+
+def _slot(directory: Path) -> _AsyncSlot:
+    key = str(directory)
+    with _SLOTS_LOCK:
+        if key not in _SLOTS:
+            _SLOTS[key] = _AsyncSlot()
+            if not _ATEXIT["registered"]:
+                import atexit
+
+                # A process must never exit with a written-but-
+                # unpublished checkpoint (the marker is the commit
+                # point).
+                atexit.register(finalize_async)
+                _ATEXIT["registered"] = True
+        return _SLOTS[key]
+
+
+def _finalize_slot(key: str, slot: _AsyncSlot) -> None:
+    with slot.lock:
+        if slot.pending is None:
+            return
+        step, history = slot.pending
+        slot.pending = None
+        slot.ckpt.wait_until_finished()
+        _publish(Path(key), step, history)
+
+
+def finalize_async(directory: str | Path | None = None) -> None:
+    """Block until in-flight async saves commit and publish their
+    markers — for one checkpoint directory, or (``None``) all of them.
+    Fit loops call this at loop exit so the last checkpoint is durable
+    when fit() returns — the same guarantee the sync path gives per
+    save."""
+    if directory is not None:
+        key = str(Path(directory))
+        with _SLOTS_LOCK:
+            slot = _SLOTS.get(key)
+        if slot is not None:
+            _finalize_slot(key, slot)
+        return
+    with _SLOTS_LOCK:
+        items = list(_SLOTS.items())
+    for key, slot in items:
+        _finalize_slot(key, slot)
+
+
 def save(directory: str | Path, step: int, state: dict,
-         history: dict | None = None) -> Path:
+         history: dict | None = None, *,
+         async_save: bool = False) -> Path:
     """Persist {params, opt_state} at ``step``; returns the step path.
 
     Collective under multi-process JAX; sharded leaves are written
     without gathering to host.
+
+    ``async_save=True`` (single-process only) returns as soon as the
+    device arrays are snapshotted: serialization runs on a background
+    thread while training continues — on a remote-TPU link the
+    device→host transfer dominates save time, so overlapping it buys
+    a whole checkpoint's wall-clock per save.  The marker publishes at
+    the NEXT save or at :func:`finalize_async`, so a crash mid-write
+    resumes from the previous durable step (the same fallback a crash
+    mid-sync-save has).
     """
+    import jax
+
     directory = Path(directory)
+    if async_save and jax.process_count() == 1:
+        import orbax.checkpoint as ocp
+
+        slot = _slot(directory)
+        with slot.lock:
+            # Previous save to THIS directory commits + publishes
+            # first (one in flight per directory).
+            if slot.pending is not None:
+                p_step, p_history = slot.pending
+                slot.pending = None
+                slot.ckpt.wait_until_finished()
+                _publish(directory, p_step, p_history)
+            if slot.ckpt is None:
+                slot.ckpt = ocp.AsyncCheckpointer(
+                    ocp.StandardCheckpointHandler()
+                )
+            directory.mkdir(parents=True, exist_ok=True)
+            path = directory / f"step_{step}"
+            if path.exists():
+                shutil.rmtree(path)
+            slot.ckpt.save(path, args=ocp.args.StandardSave(state))
+            slot.pending = (step, history)
+        return path
+    # Sync path: flush any pending ASYNC save to this directory first —
+    # otherwise a stale pending marker could later publish OVER this
+    # save's marker and rewind latest.json to an older step.
+    finalize_async(directory)
     if _is_primary():
         directory.mkdir(parents=True, exist_ok=True)
         path = directory / f"step_{step}"
@@ -81,17 +202,7 @@ def save(directory: str | Path, step: int, state: dict,
     # StandardCheckpointer.save commits (atomic rename) before returning,
     # on every process, so the marker write below cannot race the data.
     if _is_primary():
-        marker = {"step": step, "history": history or {}}
-        tmp = directory / "latest.json.tmp"
-        tmp.write_text(json.dumps(marker))
-        os.replace(tmp, directory / "latest.json")
-        for old in sorted(directory.glob("step_*")):
-            try:
-                n = int(old.name.split("_", 1)[1])
-            except ValueError:
-                continue
-            if n <= step - KEEP:
-                shutil.rmtree(old, ignore_errors=True)
+        _publish(directory, step, history)
     _barrier(f"ckpt-post-{step}")
     return path
 
@@ -108,6 +219,9 @@ def load_latest(directory: str | Path, template: dict):
     slice than the one that saved).
     """
     directory = Path(directory)
+    # Flush any in-flight async save first: a reader in this process
+    # must see the newest step, not the marker from one save ago.
+    finalize_async(directory)
     marker_path = directory / "latest.json"
     if not marker_path.exists():
         return None

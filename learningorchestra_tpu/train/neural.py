@@ -555,6 +555,7 @@ class NeuralEstimator(Estimator):
         resume: bool = True,
         accumulate_steps: int = 1,
         quantize_checkpoint: bool = False,
+        checkpoint_async: bool = True,
         **_,
     ) -> "NeuralEstimator":
         """keras-fit surface plus managed in-loop checkpointing: with
@@ -596,6 +597,7 @@ class NeuralEstimator(Estimator):
                 checkpoint_every=checkpoint_every,
                 checkpoint_min_interval_s=checkpoint_min_interval_s,
                 resume=resume, accumulate_steps=accumulate_steps,
+                checkpoint_async=checkpoint_async,
             )
         self._set_accumulation(accumulate_steps)
         x = np.asarray(as_array(x))
@@ -667,56 +669,64 @@ class NeuralEstimator(Estimator):
 
         params, opt_state = self.params, self.opt_state
         last_save = time.monotonic()
-        for epoch_i in range(start_epoch, epochs):
-            t0 = time.perf_counter()
-            params, opt_state, metrics = self._device_epoch(
-                params, opt_state, xs, ys,
-                jax.random.fold_in(root_key, epoch_i),
-            )
-            # Re-anchor the estimator each epoch: the epoch call donates
-            # its (params, opt_state) arguments, so a raise from a
-            # callback/validation below must not strand self.params on
-            # deleted buffers.
-            self.params, self.opt_state = params, opt_state
-            # ONE host transfer for all metric scalars — per-metric
-            # float() pays a device round-trip each (remote-TPU
-            # dispatch is ~7 ms per call).
-            metrics = {
-                k: float(v) for k, v in jax.device_get(metrics).items()
-            }
-            metrics["epoch_time"] = time.perf_counter() - t0
-            if validation_data is not None:
-                vx, vy = validation_data
-                vy = np.asarray(vy)
-                # Only flatten single-column matrices — sequence targets
-                # (B, T) keep their shape (the LM loss path).
-                if vy.ndim == 2 and vy.shape[1] == 1:
-                    vy = vy.reshape(-1)
-                vmetrics = self._evaluate_arrays(
-                    params, np.asarray(as_array(vx)), vy,
-                    batch_size, loss_kind,
+        try:
+            for epoch_i in range(start_epoch, epochs):
+                t0 = time.perf_counter()
+                params, opt_state, metrics = self._device_epoch(
+                    params, opt_state, xs, ys,
+                    jax.random.fold_in(root_key, epoch_i),
                 )
-                metrics.update({f"val_{k}": v for k, v in vmetrics.items()})
-            self.history.append(metrics)
-            if checkpoint_dir and ckpt_mod.should_save(
-                epoch_i, epochs, checkpoint_every,
-                checkpoint_min_interval_s, last_save,
-            ):
-                from learningorchestra_tpu.train import checkpoint as ckpt
+                # Re-anchor the estimator each epoch: the epoch call donates
+                # its (params, opt_state) arguments, so a raise from a
+                # callback/validation below must not strand self.params on
+                # deleted buffers.
+                self.params, self.opt_state = params, opt_state
+                # ONE host transfer for all metric scalars — per-metric
+                # float() pays a device round-trip each (remote-TPU
+                # dispatch is ~7 ms per call).
+                metrics = {
+                    k: float(v) for k, v in jax.device_get(metrics).items()
+                }
+                metrics["epoch_time"] = time.perf_counter() - t0
+                if validation_data is not None:
+                    vx, vy = validation_data
+                    vy = np.asarray(vy)
+                    # Only flatten single-column matrices — sequence targets
+                    # (B, T) keep their shape (the LM loss path).
+                    if vy.ndim == 2 and vy.shape[1] == 1:
+                        vy = vy.reshape(-1)
+                    vmetrics = self._evaluate_arrays(
+                        params, np.asarray(as_array(vx)), vy,
+                        batch_size, loss_kind,
+                    )
+                    metrics.update({f"val_{k}": v for k, v in vmetrics.items()})
+                self.history.append(metrics)
+                if checkpoint_dir and ckpt_mod.should_save(
+                    epoch_i, epochs, checkpoint_every,
+                    checkpoint_min_interval_s, last_save,
+                ):
+                    from learningorchestra_tpu.train import checkpoint as ckpt
 
-                ckpt.save(
-                    checkpoint_dir, epoch_i + 1,
-                    {"params": params, "opt_state": opt_state},
-                    history=dict(self.history),
-                )
-                last_save = time.monotonic()
-            if verbose:
-                _train_logger().info(
-                    "epoch %d/%d: %s", epoch_i + 1, epochs, metrics
-                )
-            for cb in callbacks or []:
-                if callable(cb):
-                    cb(epoch_i, metrics, self)
+                    ckpt.save(
+                        checkpoint_dir, epoch_i + 1,
+                        {"params": params, "opt_state": opt_state},
+                        history=dict(self.history),
+                        async_save=checkpoint_async,
+                    )
+                    last_save = time.monotonic()
+                if verbose:
+                    _train_logger().info(
+                        "epoch %d/%d: %s", epoch_i + 1, epochs, metrics
+                    )
+                for cb in callbacks or []:
+                    if callable(cb):
+                        cb(epoch_i, metrics, self)
+        finally:
+            if checkpoint_dir:
+                # The last async save must be durable when fit returns
+                # (and an exception mid-loop must not strand a pending
+                # write unpublished for a later fit in this process).
+                ckpt_mod.finalize_async(checkpoint_dir)
         self.params, self.opt_state = params, opt_state
         return self
 
@@ -724,7 +734,7 @@ class NeuralEstimator(Estimator):
         self, x, y, *, epochs, batch_size, validation_split,
         validation_data, shuffle, verbose, callbacks, checkpoint_dir,
         checkpoint_every, checkpoint_min_interval_s, resume,
-        accumulate_steps,
+        accumulate_steps, checkpoint_async: bool = True,
     ) -> "NeuralEstimator":
         """Shard-streaming fit over a beyond-host-RAM dataset.
 
@@ -829,75 +839,82 @@ class NeuralEstimator(Estimator):
         params, opt_state = self.params, self.opt_state
         root_key = jax.random.PRNGKey(self.seed)
         last_save = time.monotonic()
-        with concurrent.futures.ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="shard-io"
-        ) as io:
-            for epoch_i in range(start_epoch, epochs):
-                t0 = time.perf_counter()
-                # Seeded per (seed, epoch), NOT once per fit: a
-                # checkpoint-resumed epoch 6 must walk the same shard
-                # order the uninterrupted run would have (and the
-                # distributed path already does — one convention).
-                order = (
-                    np.random.default_rng(
-                        [self.seed, 3, epoch_i]
-                    ).permutation(ds.n_shards) if shuffle
-                    else np.arange(ds.n_shards)
-                )
-                acc = sh.WeightedMetrics()
-                nxt = io.submit(load, int(order[0]))
-                for pos, k in enumerate(order):
-                    xs, ys = nxt.result()
-                    if pos + 1 < len(order):
-                        nxt = io.submit(load, int(order[pos + 1]))
-                    rows = ds.shard_rows[int(k)]
-                    params, opt_state, metrics = fn_for(rows)(
-                        params, opt_state, xs, ys,
-                        jax.random.fold_in(
-                            root_key, epoch_i * ds.n_shards + pos
-                        ),
+        try:
+            with concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="shard-io"
+            ) as io:
+                for epoch_i in range(start_epoch, epochs):
+                    t0 = time.perf_counter()
+                    # Seeded per (seed, epoch), NOT once per fit: a
+                    # checkpoint-resumed epoch 6 must walk the same shard
+                    # order the uninterrupted run would have (and the
+                    # distributed path already does — one convention).
+                    order = (
+                        np.random.default_rng(
+                            [self.seed, 3, epoch_i]
+                        ).permutation(ds.n_shards) if shuffle
+                        else np.arange(ds.n_shards)
                     )
-                    # Re-anchor every shard: the epoch fn donates its
-                    # state, so an interrupt must not strand
-                    # self.params on deleted buffers.
-                    self.params, self.opt_state = params, opt_state
-                    acc.add(jax.device_get(metrics), rows)
-                metrics = acc.result()
-                metrics["epoch_time"] = time.perf_counter() - t0
-                if validation_data is not None:
-                    vx, vy = validation_data
-                    vy = np.asarray(vy)
-                    if vy.ndim == 2 and vy.shape[1] == 1:
-                        vy = vy.reshape(-1)
-                    vmetrics = self._evaluate_arrays(
-                        params, np.asarray(as_array(vx)), vy,
-                        batch_size, loss_kind,
-                    )
-                    metrics.update(
-                        {f"val_{k2}": v for k2, v in vmetrics.items()}
-                    )
-                self.history.append(metrics)
-                if checkpoint_dir and ckpt_mod.should_save(
-                    epoch_i, epochs, checkpoint_every,
-                    checkpoint_min_interval_s, last_save,
-                ):
-                    from learningorchestra_tpu.train import (
-                        checkpoint as ckpt,
-                    )
+                    acc = sh.WeightedMetrics()
+                    nxt = io.submit(load, int(order[0]))
+                    for pos, k in enumerate(order):
+                        xs, ys = nxt.result()
+                        if pos + 1 < len(order):
+                            nxt = io.submit(load, int(order[pos + 1]))
+                        rows = ds.shard_rows[int(k)]
+                        params, opt_state, metrics = fn_for(rows)(
+                            params, opt_state, xs, ys,
+                            jax.random.fold_in(
+                                root_key, epoch_i * ds.n_shards + pos
+                            ),
+                        )
+                        # Re-anchor every shard: the epoch fn donates its
+                        # state, so an interrupt must not strand
+                        # self.params on deleted buffers.
+                        self.params, self.opt_state = params, opt_state
+                        acc.add(jax.device_get(metrics), rows)
+                    metrics = acc.result()
+                    metrics["epoch_time"] = time.perf_counter() - t0
+                    if validation_data is not None:
+                        vx, vy = validation_data
+                        vy = np.asarray(vy)
+                        if vy.ndim == 2 and vy.shape[1] == 1:
+                            vy = vy.reshape(-1)
+                        vmetrics = self._evaluate_arrays(
+                            params, np.asarray(as_array(vx)), vy,
+                            batch_size, loss_kind,
+                        )
+                        metrics.update(
+                            {f"val_{k2}": v for k2, v in vmetrics.items()}
+                        )
+                    self.history.append(metrics)
+                    if checkpoint_dir and ckpt_mod.should_save(
+                        epoch_i, epochs, checkpoint_every,
+                        checkpoint_min_interval_s, last_save,
+                    ):
+                        from learningorchestra_tpu.train import (
+                            checkpoint as ckpt,
+                        )
 
-                    ckpt.save(
-                        checkpoint_dir, epoch_i + 1,
-                        {"params": params, "opt_state": opt_state},
-                        history=dict(self.history),
-                    )
-                    last_save = time.monotonic()
-                if verbose:
-                    _train_logger().info(
-                        "epoch %d/%d: %s", epoch_i + 1, epochs, metrics
-                    )
-                for cb in callbacks or []:
-                    if callable(cb):
-                        cb(epoch_i, metrics, self)
+                        ckpt.save(
+                            checkpoint_dir, epoch_i + 1,
+                            {"params": params, "opt_state": opt_state},
+                            history=dict(self.history),
+                            async_save=checkpoint_async,
+                        )
+                        last_save = time.monotonic()
+                    if verbose:
+                        _train_logger().info(
+                            "epoch %d/%d: %s", epoch_i + 1, epochs, metrics
+                        )
+                    for cb in callbacks or []:
+                        if callable(cb):
+                            cb(epoch_i, metrics, self)
+        finally:
+            if checkpoint_dir:
+                # Same durability contract as the in-memory
+                # loop, incl. the exception path.
+                ckpt_mod.finalize_async(checkpoint_dir)
         self.params, self.opt_state = params, opt_state
         return self
 

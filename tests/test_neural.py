@@ -649,3 +649,48 @@ def test_generate_rejects_overlong_prompt():
     x = np.ones((1, 12), np.int32)
     with pytest.raises(ValueError, match="exceeds max_len"):
         est.generate(x, max_new_tokens=4)
+
+
+def test_async_checkpointing_contract(tmp_path):
+    """Async saves (default-on): the marker only ever names a fully
+    committed step; fit() returning means the last checkpoint is
+    durable; resume from an async-checkpointed fit works; and a reader
+    in the same process sees the newest step (load flushes pending)."""
+    import json
+
+    from learningorchestra_tpu.models.mlp import MLPClassifier
+    from learningorchestra_tpu.train import checkpoint as ckpt
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 4)).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int32)
+    ck = str(tmp_path / "ck")
+
+    a = MLPClassifier(hidden_layer_sizes=[8], num_classes=2, seed=0)
+    a.fit(x, y, epochs=3, batch_size=16, checkpoint_dir=ck,
+          checkpoint_min_interval_s=0.0)
+    # fit() returned -> the final save is durable and published.
+    marker = json.loads((tmp_path / "ck" / "latest.json").read_text())
+    assert marker["step"] == 3
+    assert (tmp_path / "ck" / "step_3").exists()
+
+    # Resume continues from the async-written step.
+    b = MLPClassifier(hidden_layer_sizes=[8], num_classes=2, seed=0)
+    b.fit(x, y, epochs=5, batch_size=16, checkpoint_dir=ck,
+          checkpoint_min_interval_s=0.0)
+    assert len(b.history["loss"]) == 5  # stitched 3 + 2
+
+    # Pending-save flush: a save left in flight is visible to the next
+    # reader in this process (load_latest finalizes first).
+    state = {"params": a.params, "opt_state": a.opt_state}
+    ckpt.save(ck, 9, state, history={"loss": [0.1]}, async_save=True)
+    loaded = ckpt.load_latest(ck, state)
+    assert loaded is not None and loaded[1] == 9
+    marker = json.loads((tmp_path / "ck" / "latest.json").read_text())
+    assert marker["step"] == 9
+
+    # Sync fallback still works (the multi-process path).
+    ckpt.save(ck, 10, state, history=None, async_save=False)
+    assert json.loads(
+        (tmp_path / "ck" / "latest.json").read_text()
+    )["step"] == 10
