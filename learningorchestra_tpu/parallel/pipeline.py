@@ -598,6 +598,72 @@ class PipelinedTransformer:
             self._loss_fn, n_stages=self.pp,
         ))
 
+    def _restore_placed(self, state: dict) -> None:
+        """Shared resume re-placement: orbax restores each leaf to the
+        TEMPLATE leaf's placement, and scalar optimizer counts can come
+        back single-device, which jit rejects against mesh-placed
+        params — re-pin both onto the pipeline shardings."""
+        self.params = self._place_params(state["params"])
+        fresh = jax.jit(self.optimizer.init)(self.params)
+        mesh_devices = set(self.mesh.devices.flat)
+
+        def _sh(f):
+            sh = getattr(f, "sharding", None)
+            if sh is not None and set(sh.device_set) == mesh_devices:
+                return sh
+            # Scalar leaves (adam's count) come off the init jit on
+            # one device; replicate them on the mesh.
+            return NamedSharding(self.mesh, P())
+
+        self.opt_state = jax.tree_util.tree_map(
+            lambda r, f: jax.device_put(r, _sh(f)),
+            state["opt_state"], fresh,
+        )
+
+    def _batch_pass(self, xs, ys, order, batch_size):
+        """Run the pipelined train step over ``order`` in batch_size
+        slices (tail batch padded + masked); returns the DEVICE metric
+        dicts and each batch's real-row weight — callers device_get at
+        their own granularity (per epoch in-memory, per shard when
+        streaming) so tunnel round-trips stay amortized."""
+        metrics_list, weights = [], []
+        for lo in range(0, len(order), batch_size):
+            idx = order[lo: lo + batch_size]
+            if len(idx) < batch_size:
+                pad = batch_size - len(idx)
+                idx = np.concatenate([idx, idx[:1].repeat(pad)])
+                mask = np.concatenate([
+                    np.ones(batch_size - pad, np.float32),
+                    np.zeros(pad, np.float32),
+                ])
+            else:
+                mask = np.ones(batch_size, np.float32)
+            self.params, self.opt_state, m = self._step(
+                self.params, self.opt_state,
+                jnp.asarray(xs[idx]), jnp.asarray(ys[idx]),
+                jnp.asarray(mask),
+            )
+            metrics_list.append(m)
+            weights.append(float(mask.sum()))
+        return metrics_list, weights
+
+    @staticmethod
+    def _weighted_update(totals, metrics_list, weights):
+        """device_get + mask-weighted accumulation (a padded tail
+        batch must not count like a full one); returns weight added."""
+        stacked = jax.device_get(metrics_list)
+        for m, w in zip(stacked, weights):
+            for k, v in m.items():
+                totals[k] = totals.get(k, 0.0) + float(v) * w
+        return sum(weights)
+
+    @staticmethod
+    def _finish_row(totals, wsum):
+        row = {k: v / max(wsum, 1e-9) for k, v in totals.items()}
+        if "perplexity" in row:  # raw CE until post-mean exp
+            row["perplexity"] = float(np.exp(row["perplexity"]))
+        return row
+
     # -- keras-fit surface ----------------------------------------------------
 
     def fit(self, x, y, epochs: int = 1, batch_size: int = 32,
@@ -612,7 +678,22 @@ class PipelinedTransformer:
         epochs via the shard-aware orbax helper — sharded stage params
         save without a host gather — and an interrupted fit resumes
         from the newest checkpoint (the preemption story, SURVEY §5.4).
+
+        Sharded-dataset views stream shard by shard (the beyond-RAM
+        contract every fit surface carries, train/neural.py
+        ``_fit_streaming``).
         """
+        from learningorchestra_tpu.train.neural import _is_sharded
+
+        if _is_sharded(x) or _is_sharded(y):
+            return self._fit_streaming(
+                x, y, epochs=epochs, batch_size=batch_size,
+                shuffle=shuffle, verbose=verbose,
+                checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every,
+                checkpoint_min_interval_s=checkpoint_min_interval_s,
+                resume=resume, checkpoint_async=checkpoint_async,
+            )
         x = np.asarray(x)
         y = np.asarray(y).astype(np.int32)
         # Global batch must split into n_micro microbatches that split
@@ -636,27 +717,7 @@ class PipelinedTransformer:
             )
             if loaded is not None:
                 state, step, past_history = loaded
-                # Re-place onto the pipeline shardings: orbax restores
-                # each leaf to the TEMPLATE leaf's placement, and
-                # scalar optimizer counts can come back single-device,
-                # which jit rejects against mesh-placed params.
-                self.params = self._place_params(state["params"])
-                fresh = jax.jit(self.optimizer.init)(self.params)
-                mesh_devices = set(self.mesh.devices.flat)
-
-                def _sh(f):
-                    sh = getattr(f, "sharding", None)
-                    if sh is not None and \
-                            set(sh.device_set) == mesh_devices:
-                        return sh
-                    # Scalar leaves (adam's count) come off the init
-                    # jit on one device; replicate them on the mesh.
-                    return NamedSharding(self.mesh, P())
-
-                self.opt_state = jax.tree_util.tree_map(
-                    lambda r, f: jax.device_put(r, _sh(f)),
-                    state["opt_state"], fresh,
-                )
+                self._restore_placed(state)
                 self.history = TrainHistory(past_history)
                 start_epoch = step
 
@@ -673,33 +734,11 @@ class PipelinedTransformer:
         try:
             for epoch_i in range(start_epoch, epochs):
                 order = rng.permutation(n) if shuffle else np.arange(n)
-                epoch_metrics = []
-                for lo in range(0, n, batch_size):
-                    idx = order[lo: lo + batch_size]
-                    if len(idx) < batch_size:  # pad + mask the tail batch
-                        pad = batch_size - len(idx)
-                        idx = np.concatenate([idx, idx[:1].repeat(pad)])
-                        mask = np.concatenate(
-                            [np.ones(batch_size - pad, np.float32),
-                             np.zeros(pad, np.float32)]
-                        )
-                    else:
-                        mask = np.ones(batch_size, np.float32)
-                    self.params, self.opt_state, metrics = self._step(
-                        self.params, self.opt_state,
-                        jnp.asarray(x[idx]), jnp.asarray(y[idx]),
-                        jnp.asarray(mask),
-                    )
-                    epoch_metrics.append(metrics)
-                stacked = jax.device_get(epoch_metrics)
-                epoch_row = {
-                    k: float(np.mean([m[k] for m in stacked]))
-                    for k in stacked[0]
-                }
-                if "perplexity" in epoch_row:  # raw CE until post-mean exp
-                    epoch_row["perplexity"] = float(
-                        np.exp(epoch_row["perplexity"])
-                    )
+                totals: dict = {}
+                wsum = self._weighted_update(
+                    totals, *self._batch_pass(x, y, order, batch_size)
+                )
+                epoch_row = self._finish_row(totals, wsum)
                 self.history.append(epoch_row)
                 if verbose:
                     print(f"pipeline epoch: {self.history['loss'][-1]:.4f}",
@@ -721,6 +760,112 @@ class PipelinedTransformer:
                 # The last async save must be durable when fit
                 # returns — exception paths included.
                 ckpt_mod.finalize_async(checkpoint_dir)
+        return self
+
+    def _fit_streaming(
+        self, x, y, *, epochs, batch_size, shuffle, verbose,
+        checkpoint_dir, checkpoint_every, checkpoint_min_interval_s,
+        resume, checkpoint_async,
+    ) -> "PipelinedTransformer":
+        """Shard-streaming pipelined fit: the same microbatched step,
+        fed shard by shard with IO-thread prefetch — token datasets
+        bigger than host RAM train through the pp mesh unchanged."""
+        import concurrent.futures
+
+        from learningorchestra_tpu.store import sharded as sh
+
+        x, y = sh.resolve_xy_views(x, y)
+        # Column memory for a later predict/evaluate on the bare
+        # dataset (same contract as NeuralEstimator).
+        self._sharded_fit_cols = list(x.cols)
+        ds = x.dataset
+        quantum = self.n_micro * (
+            self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
+        )
+        batch_size = max(quantum, (batch_size // quantum) * quantum)
+        if self.params is None:
+            self._init_params(jnp.asarray(np.asarray(x.head(1))))
+        if self._step is None:
+            self._build()
+
+        start_epoch = 0
+        if checkpoint_dir and resume:
+            from learningorchestra_tpu.train import checkpoint as ckpt
+
+            loaded = ckpt.resume_or_none(
+                checkpoint_dir,
+                {"params": self.params, "opt_state": self.opt_state},
+            )
+            if loaded is not None:
+                state, step, past_history = loaded
+                self._restore_placed(state)
+                self.history = TrainHistory(past_history)
+                start_epoch = step
+
+        from learningorchestra_tpu.train import checkpoint as ckpt_mod
+
+        def load(k: int):
+            xs = np.asarray(x.load_shard(k))
+            ys = np.asarray(y.load_shard(k)).astype(np.int32)
+            return xs, ys
+
+        last_save = time.monotonic()
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="shard-io"
+        ) as io:
+            try:
+                for epoch_i in range(start_epoch, epochs):
+                    order = (
+                        np.random.default_rng(
+                            [self.seed, 3, epoch_i]
+                        ).permutation(ds.n_shards)
+                        if shuffle else np.arange(ds.n_shards)
+                    )
+                    totals: dict = {}
+                    wsum = 0.0
+                    nxt = io.submit(load, int(order[0]))
+                    for pos, k in enumerate(order):
+                        xs, ys = nxt.result()
+                        if pos + 1 < len(order):
+                            nxt = io.submit(load, int(order[pos + 1]))
+                        inner = (
+                            np.random.default_rng(
+                                [self.seed, 7 + epoch_i, pos]
+                            ).permutation(len(xs))
+                            if shuffle else np.arange(len(xs))
+                        )
+                        # device_get per SHARD: bounded retained
+                        # buffers for beyond-RAM datasets, without
+                        # per-batch tunnel round-trips.
+                        wsum += self._weighted_update(
+                            totals,
+                            *self._batch_pass(
+                                xs, ys, inner, batch_size
+                            ),
+                        )
+                    epoch_row = self._finish_row(totals, wsum)
+                    self.history.append(epoch_row)
+                    if verbose:
+                        print(
+                            "pipeline epoch: "
+                            f"{self.history['loss'][-1]:.4f}",
+                            flush=True,
+                        )
+                    if checkpoint_dir and ckpt_mod.should_save(
+                        epoch_i, epochs, checkpoint_every,
+                        checkpoint_min_interval_s, last_save,
+                    ):
+                        ckpt_mod.save(
+                            checkpoint_dir, epoch_i + 1,
+                            {"params": self.params,
+                             "opt_state": self.opt_state},
+                            history=dict(self.history),
+                            async_save=checkpoint_async,
+                        )
+                        last_save = time.monotonic()
+            finally:
+                if checkpoint_dir:
+                    ckpt_mod.finalize_async(checkpoint_dir)
         return self
 
     _CHUNK = 512  # inference batch: fixed shape -> one compile
@@ -751,6 +896,20 @@ class PipelinedTransformer:
             )[:n]
 
     def evaluate(self, x, y, **_) -> dict:
+        from learningorchestra_tpu.train.neural import _is_sharded
+
+        if _is_sharded(x) or _is_sharded(y):
+            from learningorchestra_tpu.store import sharded as sh
+
+            x, y = sh.resolve_xy_views(x, y)
+            dsx = x.dataset
+            acc = sh.WeightedMetrics()
+            for k in range(dsx.n_shards):
+                acc.add(
+                    self.evaluate(x.load_shard(k), y.load_shard(k)),
+                    dsx.shard_rows[k],
+                )
+            return acc.result()
         x = np.asarray(x)
         y = np.asarray(y).astype(np.int32)
         if self.params is None:
@@ -773,6 +932,22 @@ class PipelinedTransformer:
         return out
 
     def predict(self, x, **_):
+        from learningorchestra_tpu.train.neural import _is_sharded
+
+        if _is_sharded(x):
+            from learningorchestra_tpu.store import sharded as sh
+
+            if isinstance(x, sh.ShardedDataset):
+                cols = getattr(self, "_sharded_fit_cols", None)
+                view = x.view(cols) if cols and all(
+                    c in x.fields for c in cols
+                ) else x.view(x.fields)
+            else:
+                view = x
+            return np.concatenate([
+                self.predict(view.load_shard(k))
+                for k in range(view.dataset.n_shards)
+            ], axis=0)
         x = np.asarray(x)
         if self.params is None:
             raise RuntimeError("predict before fit")
