@@ -33,6 +33,36 @@ def _write(tmp_path, n=100, rows_per_shard=32, seed=0):
     ), np.asarray([r[2] for r in rows], np.int32)
 
 
+def _start_server(tmp_path):
+    """Local APIServer over a tmp store/volume; returns (server, base)."""
+    from learningorchestra_tpu.api.server import APIServer
+    from learningorchestra_tpu.config import Config
+
+    cfg = Config()
+    cfg.store.root = str(tmp_path / "store")
+    cfg.store.volume_root = str(tmp_path / "volumes")
+    server = APIServer(cfg)
+    port = server.start_background()
+    return server, f"http://127.0.0.1:{port}/api/learningOrchestra/v1"
+
+
+def _poll(base, path, timeout=120):
+    import time as _time
+
+    import requests
+
+    deadline = _time.time() + timeout
+    while _time.time() < deadline:
+        docs = requests.get(base + path, timeout=10).json()
+        meta = docs[0] if isinstance(docs, list) and docs else {}
+        if meta.get("finished"):
+            return meta
+        if meta.get("jobState") == "failed":
+            raise AssertionError(meta.get("exception"))
+        _time.sleep(0.05)
+    raise AssertionError(f"timeout polling {path}")
+
+
 class TestFormat:
     def test_round_trip_and_shard_layout(self, tmp_path):
         ds, x, y = _write(tmp_path, n=100, rows_per_shard=32)
@@ -256,26 +286,8 @@ class TestShardedREST:
                 a, b = rng.standard_normal(2)
                 fh.write(f"{a:.5f},{b:.5f},{int(a + b > 0) + int(a - b > 0)}\n")
 
-        cfg = Config()
-        cfg.store.root = str(tmp_path / "store")
-        cfg.store.volume_root = str(tmp_path / "volumes")
-        server = APIServer(cfg)
-        port = server.start_background()
-        base = f"http://127.0.0.1:{port}/api/learningOrchestra/v1"
-
-        def poll(path, timeout=90):
-            deadline = _time.time() + timeout
-            while _time.time() < deadline:
-                docs = requests.get(base + path, timeout=10).json()
-                meta = docs[0] if isinstance(docs, list) and docs else {}
-                if meta.get("finished"):
-                    return meta
-                if meta.get("jobState") == "failed":
-                    raise AssertionError(
-                        f"job failed: {meta.get('exception')}"
-                    )
-                _time.sleep(0.05)
-            raise AssertionError(f"timeout polling {path}")
+        server, base = _start_server(tmp_path)
+        poll = lambda p, timeout=120: _poll(base, p, timeout)  # noqa: E731
 
         try:
             r = requests.post(f"{base}/dataset/csv", json={
@@ -400,24 +412,8 @@ class TestTensorSharded:
         np.save(tmp_path / "imgs.npy", x)
         np.save(tmp_path / "labels.npy", y)
 
-        cfg = Config()
-        cfg.store.root = str(tmp_path / "store")
-        cfg.store.volume_root = str(tmp_path / "volumes")
-        server = APIServer(cfg)
-        port = server.start_background()
-        base = f"http://127.0.0.1:{port}/api/learningOrchestra/v1"
-
-        def poll(path, timeout=120):
-            deadline = _time.time() + timeout
-            while _time.time() < deadline:
-                docs = requests.get(base + path, timeout=10).json()
-                meta = docs[0] if isinstance(docs, list) and docs else {}
-                if meta.get("finished"):
-                    return meta
-                if meta.get("jobState") == "failed":
-                    raise AssertionError(meta.get("exception"))
-                _time.sleep(0.05)
-            raise AssertionError(f"timeout {path}")
+        server, base = _start_server(tmp_path)
+        poll = lambda p, timeout=120: _poll(base, p, timeout)  # noqa: E731
 
         try:
             r = requests.post(f"{base}/dataset/tensor", json={
@@ -529,3 +525,62 @@ def test_distributed_streaming_records_fit_columns(tmp_path):
     trainer.fit(ds, ds["label"], epochs=2, batch_size=32)
     preds = est.predict(ds)
     assert preds.shape == (128, 3)
+
+
+def test_sharded_train_patch_rerun(tmp_path):
+    """PATCH re-runs re-resolve the sharded DSL refs and stream again —
+    the stateful re-executable-step contract holds for beyond-RAM
+    trains too."""
+    import time as _time
+
+    import requests
+
+    from learningorchestra_tpu.api.server import APIServer
+    from learningorchestra_tpu.config import Config
+
+    rng = np.random.default_rng(0)
+    csv = tmp_path / "p.csv"
+    with open(csv, "w") as fh:
+        fh.write("a,b,label\n")
+        for _ in range(200):
+            a, b = rng.standard_normal(2)
+            fh.write(f"{a:.5f},{b:.5f},{int(a + b > 0)}\n")
+    server, base = _start_server(tmp_path)
+    poll = lambda p, timeout=120: _poll(base, p, timeout)  # noqa: E731
+
+    try:
+        requests.post(f"{base}/dataset/csv", json={
+            "datasetName": "pds", "url": str(csv), "shardRows": 64,
+        })
+        poll("/dataset/csv/pds")
+        requests.post(f"{base}/model/tensorflow", json={
+            "name": "pm",
+            "modulePath": "learningorchestra_tpu.models.mlp",
+            "class": "MLPClassifier",
+            "classParameters": {"hidden_layer_sizes": [16],
+                                "num_classes": 2},
+        })
+        poll("/model/tensorflow/pm")
+        r = requests.post(f"{base}/train/tensorflow", json={
+            "name": "pfit", "modelName": "pm", "parentName": "pm",
+            "method": "fit",
+            "methodParameters": {"x": "$pds", "y": "$pds.label",
+                                 "epochs": 3, "batch_size": 32},
+        })
+        assert r.status_code == 201, r.text
+        poll("/train/tensorflow/pfit")
+        # PATCH with more epochs: re-resolves "$pds" (a fresh lazy
+        # handle) and streams again from epoch 0.
+        r = requests.patch(f"{base}/train/tensorflow/pfit", json={
+            "methodParameters": {"x": "$pds", "y": "$pds.label",
+                                 "epochs": 5, "batch_size": 32},
+        })
+        assert r.status_code == 200, r.text
+        meta = poll("/train/tensorflow/pfit")
+        assert meta["fitTime"] > 0
+        docs = requests.get(f"{base}/train/tensorflow/pfit",
+                            params={"limit": 100}).json()
+        hist = [d for d in docs if d.get("docType") == "history"]
+        assert len(hist) == 5  # re-run replaced the old rows
+    finally:
+        server.shutdown()

@@ -248,3 +248,24 @@ def test_replica_detects_compaction_after_regrowth(tmp_path):
     assert got == {45, 46, 47, 48, 49} | {100 + i for i in range(60)}
     assert ra.find_one("c", new_ids[0])["v"] == 100
     primary.close()
+
+
+def test_replica_follows_native_primary(tmp_path):
+    """WAL shipping is format-level, so a replica follows a primary
+    written by the C++ backend identically (the byte-compatible-WAL
+    contract doing real work)."""
+    s = _native_store(tmp_path / "p")
+    ids = [s.insert_one("c", {"v": i}) for i in range(12)]
+    ra = WalReplica(tmp_path / "p", tmp_path / "r")
+    ra.sync()
+    assert ra.count("c") == 12
+    s.update_one("c", ids[0], {"v": 99})
+    s.delete_one("c", ids[1])
+    ra.sync()
+    assert ra.find_one("c", ids[0])["v"] == 99
+    assert ra.find_one("c", ids[1]) is None
+    # Promotion yields a store the PYTHON backend can serve.
+    promoted = ra.promote()
+    assert promoted.count("c") == 11
+    promoted.close()
+    s.close()
