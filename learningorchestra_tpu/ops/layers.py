@@ -117,6 +117,18 @@ class MultiHeadSelfAttention(nn.Module):
     # the uninitialized pass behaves as a normal forward and sizes the
     # cache); then feed one position at a time.
     decode: bool = False
+    # One (H + 2·H_kv, hd) projection instead of three — the MXU wants
+    # fewer, LARGER matmuls: at short sequence lengths the three small
+    # per-layer projections are dispatch/tiling-bound, and XLA does not
+    # merge separate dots on its own.  Same math (the fused weight is
+    # the block-stack of the three), same init variance (fan_in is the
+    # model dim either way).  Trade: under tp>1 the fused head axis
+    # cannot cleanly head-shard (parallel/sharding.py replicates it) —
+    # Megatron-style tensor-parallel attention should set
+    # fused_qkv=False.  Legacy separate-projection artifacts load via
+    # ops.layers.migrate_separate_qkv (applied automatically on the
+    # estimator load paths).
+    fused_qkv: bool = True
 
     @nn.compact
     def __call__(self, x, key_mask=None):
@@ -134,15 +146,24 @@ class MultiHeadSelfAttention(nn.Module):
                 f"num_kv_heads={kv_heads}"
             )
 
-        def proj(name, heads):
-            y = nn.DenseGeneral(
-                (heads, head_dim), dtype=self.dtype, name=name
-            )(x)
-            return y.transpose(0, 2, 1, 3)  # (B, heads, T, hd)
+        if self.fused_qkv:
+            qkv = nn.DenseGeneral(
+                (self.num_heads + 2 * kv_heads, head_dim),
+                dtype=self.dtype, name="qkv",
+            )(x).transpose(0, 2, 1, 3)  # (B, H+2H_kv, T, hd)
+            q = qkv[:, : self.num_heads]
+            k = qkv[:, self.num_heads: self.num_heads + kv_heads]
+            v = qkv[:, self.num_heads + kv_heads:]
+        else:
+            def proj(name, heads):
+                y = nn.DenseGeneral(
+                    (heads, head_dim), dtype=self.dtype, name=name
+                )(x)
+                return y.transpose(0, 2, 1, 3)  # (B, heads, T, hd)
 
-        q = proj("query", self.num_heads)
-        k = proj("key", kv_heads)
-        v = proj("value", kv_heads)
+            q = proj("query", self.num_heads)
+            k = proj("key", kv_heads)
+            v = proj("value", kv_heads)
         is_initialized = self.decode and self.has_variable(
             "cache", "cached_key"
         )
@@ -235,3 +256,58 @@ class MultiHeadSelfAttention(nn.Module):
         return nn.DenseGeneral(
             self.qkv_features, dtype=self.dtype, name="out"
         )(out)
+
+
+def migrate_separate_qkv(tree):
+    """Convert a legacy separate-projection parameter tree
+    (query/key/value DenseGeneral triplets) to the fused ``qkv``
+    layout — the exact block-stack the fused layer computes, so
+    outputs are bit-identical.  Non-matching subtrees pass through;
+    the estimator load paths apply this automatically when they see
+    the legacy pattern."""
+    import numpy as np
+
+    def _is_proj(node):
+        return isinstance(node, dict) and "kernel" in node
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        if (
+            {"query", "key", "value"} <= set(node)
+            and all(_is_proj(node[k]) for k in ("query", "key", "value"))
+        ):
+            node = dict(node)
+            q = node.pop("query")
+            k = node.pop("key")
+            v = node.pop("value")
+            node["qkv"] = {
+                "kernel": np.concatenate(
+                    [np.asarray(q["kernel"]), np.asarray(k["kernel"]),
+                     np.asarray(v["kernel"])], axis=1,
+                ),
+                "bias": np.concatenate(
+                    [np.asarray(q["bias"]), np.asarray(k["bias"]),
+                     np.asarray(v["bias"])], axis=0,
+                ),
+            }
+        return {kk: walk(vv) for kk, vv in node.items()}
+
+    return walk(tree)
+
+
+def has_separate_qkv(tree) -> bool:
+    """True when the tree holds legacy query/key/value triplets."""
+    found = {"hit": False}
+
+    def walk(node):
+        if not isinstance(node, dict) or found["hit"]:
+            return
+        if {"query", "key", "value"} <= set(node):
+            found["hit"] = True
+            return
+        for v in node.values():
+            walk(v)
+
+    walk(tree)
+    return found["hit"]

@@ -73,7 +73,17 @@ def leaf_spec(path: tuple, shape: tuple, mesh: Mesh) -> P:
         # Gate on the layer NAME, not just divisibility, so a future
         # 3-D kernel with a different axis layout never silently gets
         # heads-style placement.
-        is_qkv = any(t in name for t in ("query", "key", "value", "qkv"))
+        if "qkv" in name:
+            # FUSED projection: the head axis is [Q..., K..., V...] —
+            # a contiguous tp chunking never respects the section
+            # boundaries (slots of Q and K land on one shard), so
+            # head-sharding it would force per-layer reshards after
+            # the q/k/v slices.  Replicate the head axis; fsdp still
+            # shards the hidden axis.  tp>1 attention wanting Megatron
+            # head-sharding should build layers with fused_qkv=False.
+            inn = "fsdp" if _divisible(shape[0], fsdp) else None
+            return P(inn, None, None)
+        is_qkv = any(t in name for t in ("query", "key", "value"))
         if is_qkv:
             inn = "fsdp" if _divisible(shape[0], fsdp) else None
             if _divisible(shape[1], tp):

@@ -1049,6 +1049,10 @@ class NeuralEstimator(Estimator):
         }
 
     def load_state_dict(self, state: dict) -> None:
+        from learningorchestra_tpu.ops.layers import (
+            has_separate_qkv,
+            migrate_separate_qkv,
+        )
         from learningorchestra_tpu.ops.quant import (
             dequantize_pytree,
             has_quantized_leaves,
@@ -1057,6 +1061,13 @@ class NeuralEstimator(Estimator):
         params = state["params"]
         if params is not None and has_quantized_leaves(params):
             params = dequantize_pytree(params)
+        if params is not None and has_separate_qkv(params):
+            # Legacy separate-projection artifact meeting the fused
+            # default: block-stack into the qkv layout (bit-identical
+            # outputs).  fused_qkv=False models keep their layout by
+            # initializing params before loading.
+            if self.params is None or not has_separate_qkv(self.params):
+                params = migrate_separate_qkv(params)
         self.params = params
         # Restore the accumulation wrapper FIRST so the optimizer and
         # the restored opt_state structure agree (a MultiSteps state
@@ -1104,6 +1115,9 @@ class NeuralEstimator(Estimator):
         ):
             state = dict(state)
             state["params"] = dequantize_pytree(state["params"])
+        # No qkv migration here: a dill'd instance carries its OWN
+        # module (with its fused_qkv setting), so its params always
+        # match — only load_state_dict crosses layout versions.
         self.__dict__.update(state)
 
 

@@ -413,10 +413,11 @@ def test_gqa_decoder_cache_generate():
             max_len=16, mlp_dim=16, num_kv_heads=kv_heads,
         )
         est.fit(x, tgt, epochs=1, batch_size=8, verbose=0)
-        # KV projection kernels carry kv_heads, not num_heads.
+        # The fused QKV kernel carries H + 2*kv_heads head slots —
+        # fewer KV heads shrink the projection (and the decode cache).
         kshape = est.params["params"]["TransformerBlock_0"][
-            "MultiHeadSelfAttention_0"]["key"]["kernel"].shape
-        assert kshape[1] == kv_heads, kshape
+            "MultiHeadSelfAttention_0"]["qkv"]["kernel"].shape
+        assert kshape[1] == 4 + 2 * kv_heads, kshape
         out = est.generate(x[:2, :4], max_new_tokens=4)
         np.testing.assert_array_equal(
             out, naive_greedy_decode(est, x[:2, :4], 8)

@@ -658,35 +658,58 @@ class TestDistributedCheckpointing:
 
 class TestAttentionHeadSharding:
     def test_qkv_kernels_shard_by_heads_over_tp(self):
-        """Megatron attention-parallel: 3-D QKV DenseGeneral kernels
-        (hidden, heads, head_dim) place HEADS on tp, so each shard owns
-        whole heads and attention runs collective-free."""
+        """Megatron attention-parallel applies to the SEPARATE
+        projection layout (fused_qkv=False): 3-D query/key/value
+        kernels place HEADS on tp so each shard owns whole heads and
+        attention runs collective-free.  The FUSED kernel's mixed
+        [Q|K|V] head axis cannot split cleanly, so it must replicate
+        heads instead of forcing per-layer reshards."""
         import jax
+        import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
 
-        from learningorchestra_tpu.models.text import TransformerClassifier
+        from learningorchestra_tpu.ops.layers import MultiHeadSelfAttention
         from learningorchestra_tpu.parallel.mesh import MeshSpec, build_mesh
         from learningorchestra_tpu.parallel.sharding import param_shardings
 
-        est = TransformerClassifier(
-            vocab_size=64, hidden_dim=16, num_layers=1, num_heads=4,
-            max_len=16, num_classes=2,
-        )
-        est._init_params(np.zeros((1, 8), np.int32))
         mesh = build_mesh(MeshSpec(tp=2, fsdp=2),
                           devices=jax.devices()[:4])
-        shardings = param_shardings(est.params, mesh)
-        param_flat = dict(
-            jax.tree_util.tree_flatten_with_path(est.params)[0]
+        x0 = jnp.zeros((1, 8, 16), jnp.float32)
+
+        # Unfused: heads on tp (the Megatron invariant).
+        sep = MultiHeadSelfAttention(
+            num_heads=4, qkv_features=16, fused_qkv=False,
+            use_flash=False,
         )
-        flat = jax.tree_util.tree_flatten_with_path(shardings)[0]
-        # Select the 3-D QKV KERNELS themselves (not their 2-D biases),
-        # so a regression to replicated kernels fails loudly.
-        qkv = [
+        ps = sep.init(jax.random.PRNGKey(0), x0)
+        flat = jax.tree_util.tree_flatten_with_path(
+            param_shardings(ps, mesh)
+        )[0]
+        heads_sharded = [
             (path, s) for path, s in flat
-            if "query" in "/".join(str(p) for p in path).lower()
-            and param_flat[path].ndim == 3
+            if any(n in "/".join(str(p) for p in path).lower()
+                   for n in ("query", "key", "value"))
+            and len(s.spec) == 3
         ]
-        assert qkv, "no 3-D query kernels found"
-        for path, sharding in qkv:
+        assert heads_sharded, "no 3-D separate projection kernels"
+        for path, sharding in heads_sharded:
             assert sharding.spec[1] == "tp", (path, sharding.spec)
+
+        # Fused: head axis REPLICATED (never mixed-section sharded),
+        # hidden still on fsdp.
+        fused = MultiHeadSelfAttention(
+            num_heads=4, qkv_features=16, use_flash=False,
+        )
+        pf = fused.init(jax.random.PRNGKey(0), x0)
+        flat = jax.tree_util.tree_flatten_with_path(
+            param_shardings(pf, mesh)
+        )[0]
+        fused_kernels = [
+            (path, s) for path, s in flat
+            if "qkv" in "/".join(str(p) for p in path).lower()
+            and len(s.spec) == 3
+        ]
+        assert fused_kernels, "no 3-D fused qkv kernels"
+        for path, sharding in fused_kernels:
+            assert sharding.spec[1] is None, (path, sharding.spec)
+            assert sharding.spec[0] == "fsdp", (path, sharding.spec)
