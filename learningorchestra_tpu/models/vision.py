@@ -116,6 +116,10 @@ class _ResNet(nn.Module):
     block: type
     num_classes: int = 1000
     width: int = 64
+    # jax.checkpoint each residual block: activations rematerialize in
+    # the backward pass — the batch-size headroom knob for conv nets,
+    # where activation HBM (B x H x W x C per block) dominates params.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -125,12 +129,20 @@ class _ResNet(nn.Module):
         x = nn.GroupNorm(num_groups=min(32, self.width))(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        block_cls = nn.remat(self.block) if self.remat else self.block
+        idx = 0
         for stage, n_blocks in enumerate(self.stage_sizes):
             for block_i in range(n_blocks):
                 strides = (2, 2) if stage > 0 and block_i == 0 else (1, 1)
-                x = self.block(
-                    self.width * (2**stage), strides=strides
+                # Explicit names pinned to the historical auto-names
+                # (sequential across stages) so stored artifacts survive
+                # toggling the memory knob — same convention as
+                # BertEncoder's remat (models/text.py).
+                x = block_cls(
+                    self.width * (2**stage), strides=strides,
+                    name=f"{self.block.__name__}_{idx}",
                 )(x)
+                idx += 1
         x = x.mean(axis=(1, 2))  # global average pool
         return nn.Dense(self.num_classes)(x)
 
@@ -142,13 +154,16 @@ class ResNet18(NeuralEstimator):
         num_classes: int = 1000,
         learning_rate: float = 1e-3,
         seed: int = 0,
+        remat: bool = False,
     ):
         self.num_classes = num_classes
+        self.remat = remat
         super().__init__(
             _ResNet(
                 stage_sizes=(2, 2, 2, 2),
                 block=_ResNetBlock,
                 num_classes=num_classes,
+                remat=remat,
             ),
             loss="softmax_ce",
             learning_rate=learning_rate,
@@ -163,13 +178,16 @@ class ResNet50(NeuralEstimator):
         num_classes: int = 1000,
         learning_rate: float = 1e-3,
         seed: int = 0,
+        remat: bool = False,
     ):
         self.num_classes = num_classes
+        self.remat = remat
         super().__init__(
             _ResNet(
                 stage_sizes=(3, 4, 6, 3),
                 block=_BottleneckBlock,
                 num_classes=num_classes,
+                remat=remat,
             ),
             loss="softmax_ce",
             learning_rate=learning_rate,
