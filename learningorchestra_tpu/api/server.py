@@ -383,6 +383,40 @@ class APIServer:
             )[1],
         )
 
+        # ---- Transform: text (BPE tokenization) ----
+        # Beyond the reference's surface (its text configs assume
+        # user-shipped preprocessing in compile_code); follows the
+        # projection family's verb/URI/polling contract.
+        def text_create(m, body, query):
+            meta = self.transform.create_text(
+                body.get("name"),
+                body.get("datasetName") or body.get("parentName"),
+                text_field=body.get("textField"),
+                label_field=body.get("labelField"),
+                vocab_size=body.get("vocabSize", 8000),
+                max_len=body.get("maxLen", 128),
+                lowercase=body.get("lowercase", True),
+                tokenizer_from=body.get("tokenizerFrom"),
+                shard_rows=body.get("shardRows", 4096),
+            )
+            return self._created("transform/text", meta)
+
+        add("POST", r"/transform/text", text_create)
+        add("PATCH", r"/transform/text", lambda m, b, q: (
+            200, {"metadata": self.transform.update_text(b.get("name"))},
+        ))
+        add("PATCH", r"/transform/text/" + NAME, lambda m, b, q: (
+            200, {"metadata": self.transform.update_text(m.group("name"))},
+        ))
+        add("GET", r"/transform/text/" + NAME, lambda m, b, q: (
+            200,
+            self.dataset.read_page(m.group("name"), **self._page_args(q)),
+        ))
+        add("DELETE", r"/transform/text/" + NAME, lambda m, b, q: (
+            self.dataset.delete(m.group("name")),
+            (200, {"result": "deleted"}),
+        )[1])
+
         # ---- Transform: dataType ----
         def datatype_patch(m, body, query):
             meta = self.transform.update_field_types(

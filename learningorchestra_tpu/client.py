@@ -59,6 +59,7 @@ class Context:
         self.dataset_generic = _Dataset(self, "generic")
         self.dataset_tensor = _TensorDataset(self)
         self.projection = _Projection(self)
+        self.text = _TextTransform(self)
         self.data_type = _DataType(self)
         self.transform = _Transform(self, "tensorflow")
         self.transform_sklearn = _Transform(self, "scikitlearn")
@@ -218,6 +219,32 @@ class _Projection(_Service):
             "PATCH", "/transform/projection",
             {"projectionName": projection_name, "fields": fields},
         )
+
+
+class _TextTransform(_Service):
+    """BPE tokenization of a text column into a tensor-sharded dataset
+    of fixed-length int32 rows (beyond the reference's surface — its
+    text configs assume user preprocessing in compile_code)."""
+
+    service_path = "transform/text"
+
+    def create(self, name: str, dataset_name: str, *, text_field: str,
+               label_field: str | None = None, vocab_size: int = 8000,
+               max_len: int = 128, lowercase: bool = True,
+               tokenizer_from: str | None = None,
+               shard_rows: int = 4096) -> dict:
+        return self.ctx.request(
+            "POST", "/transform/text",
+            {"name": name, "datasetName": dataset_name,
+             "textField": text_field, "labelField": label_field,
+             "vocabSize": vocab_size, "maxLen": max_len,
+             "lowercase": lowercase, "tokenizerFrom": tokenizer_from,
+             "shardRows": shard_rows},
+        )
+
+    def update(self, name: str) -> dict:
+        """PATCH re-run — re-tokenizes from the parent's current rows."""
+        return self.ctx.request("PATCH", f"/transform/text/{name}", {})
 
 
 class _Transform(_Service):

@@ -109,6 +109,15 @@ class ServiceContext:
         # never let '..'/absolute names reach a shutil.rmtree.
         if not _ARTIFACT_NAME_RE.fullmatch(name) or ".." in name:
             raise ValidationError(f"invalid artifact name: {name!r}")
+        # Reserved: text transforms store their trained tokenizer
+        # binary at "<artifact>.tokenizer" in the shared transform
+        # volume (services/transform.py::_tokenizer_volume_name); an
+        # artifact claiming such a name would collide with it.
+        if name.endswith(".tokenizer"):
+            raise ValidationError(
+                f"artifact name {name!r} uses the reserved "
+                "'.tokenizer' suffix"
+            )
         if self.artifacts.metadata.exists(name):
             raise ConflictError(f"duplicate artifact name: {name!r}")
 
@@ -159,6 +168,14 @@ class ServiceContext:
         meta = self.require_existing(name)
         self.artifacts.delete(name)
         self.volumes.delete(meta.get("type", ""), name)
+        # A text transform also owns a trained-tokenizer binary next to
+        # its shard directory; deleting the artifact must not leave it
+        # behind (a later tokenizerFrom would silently load the stale
+        # vocab of a name that no longer exists).
+        if meta.get("type") == "transform/text":
+            self.volumes.delete(
+                meta.get("type", ""), name + ".tokenizer"
+            )
         import shutil
 
         ckdir = self.checkpoint_dir(name)

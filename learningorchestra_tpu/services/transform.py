@@ -25,6 +25,16 @@ from learningorchestra_tpu.services.context import (
 from learningorchestra_tpu.toolkit import registry
 
 PROJECTION_TYPE = "transform/projection"
+TEXT_TYPE = "transform/text"
+
+
+def _tokenizer_volume_name(artifact_name: str) -> str:
+    """The trained tokenizer binary sits NEXT to the artifact's shard
+    directory in the transform volume (every transform/* type maps to
+    one volume key — store/volumes.py::volume_key_for_type), so it
+    needs a distinct name; '.' cannot appear in a path traversal and
+    is valid for volume names."""
+    return artifact_name + ".tokenizer"
 
 
 def _compact_best_effort(documents, name: str) -> None:
@@ -187,6 +197,269 @@ class TransformService:
             on_success=lambda r: r,
         )
         return self.ctx.artifacts.metadata.read(parent_name)
+
+    # -- text tokenization (BPE → tensor-sharded int rows) --------------------
+
+    def create_text(
+        self,
+        name: str,
+        parent_name: str,
+        *,
+        text_field: str,
+        label_field: str | None = None,
+        vocab_size: int = 8000,
+        max_len: int = 128,
+        lowercase: bool = True,
+        tokenizer_from: str | None = None,
+        shard_rows: int = 4096,
+    ) -> dict:
+        """Tokenize a text column into a tensor-sharded dataset of
+        fixed-length int32 rows (+ integer labels) that the streaming
+        fit surfaces consume directly (``x="$name"``,
+        ``y="$name.label"``).
+
+        The reference has no tokenizer service — its text configs
+        assume user-shipped preprocessing in ``compile_code``
+        (binary_executor_image/binary_execution.py:246-268).  Making it
+        a transform keeps the whole text pipeline inside the framework:
+        raw CSV → BPE → static-shape tensors (the XLA-friendly text
+        representation) → train.  ``tokenizerFrom`` re-uses another
+        text transform's trained tokenizer, the held-out-split
+        contract (encode test data with the TRAIN split's vocab).
+        """
+        parent = self.ctx.require_finished_parent(parent_name)
+        self.ctx.require_new_name(name)
+        if not text_field:
+            raise ValidationError("textField is required")
+
+        def _int(value, key):
+            # Malformed request input must be a 406, not an int() 500.
+            try:
+                return int(value)
+            except (TypeError, ValueError):
+                raise ValidationError(
+                    f"{key} must be an integer, got {value!r}"
+                ) from None
+
+        vocab_size = _int(vocab_size, "vocabSize")
+        max_len = _int(max_len, "maxLen")
+        shard_rows = _int(shard_rows, "shardRows")
+        if vocab_size < 8:
+            raise ValidationError(f"vocabSize too small: {vocab_size}")
+        if max_len < 4:
+            raise ValidationError(f"maxLen too small: {max_len}")
+        if shard_rows <= 0:
+            raise ValidationError("shardRows must be positive")
+        self._check_text_parent(parent, text_field, label_field)
+        self._check_tokenizer_from(tokenizer_from)
+        meta = self.ctx.artifacts.metadata.create(
+            name, TEXT_TYPE, parent_name=parent_name,
+            extra={
+                "textField": text_field, "labelField": label_field,
+                "vocabSize": int(vocab_size), "maxLen": int(max_len),
+                "lowercase": bool(lowercase),
+                "tokenizerFrom": tokenizer_from,
+                "shardRows": int(shard_rows),
+            },
+        )
+        self._submit_text(name, meta, replace=False)
+        return meta
+
+    def _check_tokenizer_from(self, tokenizer_from) -> None:
+        """Malformed or dangling tokenizerFrom must be a 406 — never a
+        volume-layer ValueError (500) or a job-time FileNotFoundError."""
+        if tokenizer_from is None:
+            return
+        if not isinstance(tokenizer_from, str) or not tokenizer_from:
+            raise ValidationError(
+                f"tokenizerFrom must be an artifact name, "
+                f"got {tokenizer_from!r}"
+            )
+        try:
+            ok = self.ctx.volumes.exists(
+                TEXT_TYPE, _tokenizer_volume_name(tokenizer_from)
+            )
+        except ValueError:
+            raise ValidationError(
+                f"invalid tokenizerFrom name: {tokenizer_from!r}"
+            ) from None
+        if not ok:
+            raise ValidationError(
+                f"no trained tokenizer named {tokenizer_from!r}"
+            )
+
+    @staticmethod
+    def _check_text_parent(parent: dict, text_field: str,
+                           label_field: str | None) -> None:
+        """Shared by create AND PATCH re-run — the parent's schema may
+        have changed between them (re-ingest with renamed columns), and
+        a stale field name must be a 406, not an all-empty dataset."""
+        if parent.get("sharded"):
+            raise ValidationError(
+                "text tokenization reads a document dataset (sharded "
+                "datasets hold numeric columns only)"
+            )
+        known = parent.get("fields") or []
+        for f in filter(None, (text_field, label_field)):
+            if known and f not in known:
+                raise ValidationError(f"no such field: {f!r}")
+
+    def update_text(self, name: str) -> dict:
+        """PATCH re-run: re-tokenizes from the parent's CURRENT rows
+        with the original request's parameters (same contract as the
+        projection PATCH)."""
+        meta = self.ctx.require_not_running(name)
+        if meta.get("type") != TEXT_TYPE:
+            raise ValidationError(f"{name!r} is not a text transform")
+        parent = self.ctx.require_finished_parent(meta.get("parentName"))
+        self._check_text_parent(
+            parent, meta.get("textField"), meta.get("labelField")
+        )
+        self._check_tokenizer_from(meta.get("tokenizerFrom"))
+        self.ctx.artifacts.metadata.restart(name)
+        self._submit_text(name, meta, replace=True)
+        return self.ctx.artifacts.metadata.read(name)
+
+    def _submit_text(self, name: str, meta: dict, *, replace: bool) -> None:
+        parent_name = meta["parentName"]
+        text_field = meta["textField"]
+        label_field = meta.get("labelField")
+        tokenizer_from = meta.get("tokenizerFrom")
+        max_len = int(meta["maxLen"])
+
+        def tokenize():
+            import numpy as np
+
+            from learningorchestra_tpu.store.sharded import (
+                ShardedTensorWriter,
+            )
+            from learningorchestra_tpu.text import BpeTokenizer
+            from learningorchestra_tpu.text.bpe import count_words
+
+            docs = self.ctx.documents.find(
+                parent_name,
+                query={"_id": {"$gte": 1}, "docType": {"$ne": "execution"}},
+            )
+            if not docs:
+                raise ValueError(f"dataset {parent_name!r} has no rows")
+            if tokenizer_from:
+                tok = self.ctx.volumes.read_object(
+                    TEXT_TYPE, _tokenizer_volume_name(tokenizer_from)
+                )
+            else:
+                wc = count_words(
+                    (d.get(text_field) or "" for d in docs),
+                    lowercase=bool(meta.get("lowercase", True)),
+                )
+                tok = BpeTokenizer.train(
+                    wc, vocab_size=int(meta["vocabSize"]),
+                    lowercase=bool(meta.get("lowercase", True)),
+                )
+                self.ctx.volumes.save_object(
+                    TEXT_TYPE, _tokenizer_volume_name(name), tok
+                )
+
+            classes: list | None = None
+            labels = None
+            if label_field is not None:
+                import math
+
+                raw = [d.get(label_field) for d in docs]
+                n_missing = sum(
+                    1 for v in raw
+                    if v is None
+                    or (isinstance(v, float) and not math.isfinite(v))
+                )
+                if n_missing:
+                    # A missing/NaN label must be an error, not a
+                    # phantom "None" class silently shifting every
+                    # class id (or an int(NaN) crash).
+                    raise ValueError(
+                        f"{n_missing} row(s) have no "
+                        f"{label_field!r} value; clean or project "
+                        "the dataset first"
+                    )
+                if all(
+                    isinstance(v, (int, float))
+                    and float(v) == int(v) for v in raw
+                ):
+                    labels = np.asarray([int(v) for v in raw], np.int64)
+                else:
+                    # String / non-integral labels: deterministic
+                    # class ids (sorted order), recorded for decode.
+                    classes = sorted({str(v) for v in raw})
+                    lut = {c: i for i, c in enumerate(classes)}
+                    labels = np.asarray(
+                        [lut[str(v)] for v in raw], np.int64
+                    )
+
+            root = self.ctx.volumes.path_for(TEXT_TYPE, name)
+            if replace:
+                if root.exists():
+                    import shutil
+
+                    shutil.rmtree(root)
+                # Stale preview docs from the previous run too.
+                for doc in self.ctx.documents.find(
+                    name,
+                    query={
+                        "_id": {"$gte": 1},
+                        "docType": {"$ne": "execution"},
+                    },
+                ):
+                    self.ctx.documents.delete_one(name, doc["_id"])
+            columns = {"tokens": (max_len,)}
+            if labels is not None:
+                columns["label"] = ()
+            writer = ShardedTensorWriter(
+                root, columns, rows_per_shard=int(meta["shardRows"]),
+            )
+            preview: list[dict] = []
+            step = 1024
+            for i in range(0, len(docs), step):
+                enc = tok.encode_batch(
+                    [d.get(text_field) or "" for d in docs[i:i + step]],
+                    max_len,
+                )
+                chunk = {"tokens": enc}
+                if labels is not None:
+                    chunk["label"] = labels[i:i + step]
+                writer.append_rows(chunk)
+                # First rows also land in the document store so the
+                # artifact's GET pages show data (sharded-CSV preview
+                # parity — dataset.py PREVIEW_ROWS); token rows are
+                # small, unlike image tensors, so previews are cheap.
+                for j in range(len(enc)):
+                    if len(preview) >= 20:
+                        break
+                    row = {
+                        "text": str(docs[i + j].get(text_field) or ""),
+                        "tokens": enc[j][enc[j] != 0].tolist(),
+                    }
+                    if labels is not None:
+                        row["label"] = int(labels[i + j])
+                    preview.append(row)
+            manifest = writer.close()
+            if preview:
+                self.ctx.documents.insert_many(name, preview)
+            out = {
+                "fields": list(columns),
+                "rows": len(docs),
+                "sharded": True,
+                "shards": len(manifest["shard_rows"]),
+                "featureShape": [max_len],
+                "vocabSize": tok.vocab_size,
+                "tokenizer": tokenizer_from or name,
+            }
+            if classes is not None:
+                out["labelClasses"] = classes
+            return out
+
+        self.ctx.engine.submit(
+            name, tokenize,
+            description=f"BPE tokenization of {parent_name}.{text_field}",
+            on_success=lambda r: r,
+        )
 
     # -- generic transform (registry class + method) --------------------------
 

@@ -18,6 +18,7 @@ tree keyed by service type, with three formats:
 from __future__ import annotations
 
 import io
+import os
 import re
 import shutil
 from pathlib import Path
@@ -78,10 +79,20 @@ class VolumeStorage:
     # -- dill (parity fallback) ----------------------------------------------
 
     def save_object(self, artifact_type: str, name: str, obj: Any) -> Path:
-        path = self.path_for(artifact_type, name)
+        return self._dump_atomic(self.path_for(artifact_type, name), obj)
+
+    @staticmethod
+    def _dump_atomic(path: Path, obj: Any) -> Path:
+        """tmp + rename publish: a PATCH re-run rewriting a binary
+        while a concurrent job dill-loads it must never expose a torn
+        file (same discipline as the shard writer's os.replace)."""
         path.parent.mkdir(parents=True, exist_ok=True)
-        with open(path, "wb") as fh:
+        # Leading '.' can never collide with an artifact binary:
+        # _NAME_RE requires names to start with an alphanumeric.
+        tmp = path.with_name("." + path.name + ".tmp")
+        with open(tmp, "wb") as fh:
             dill.dump(obj, fh)
+        os.replace(tmp, path)
         return path
 
     def read_object(self, artifact_type: str, name: str) -> Any:
@@ -104,11 +115,8 @@ class VolumeStorage:
             else x,
             tree,
         )
-        path = self.path_for(artifact_type, name)
-        path.parent.mkdir(parents=True, exist_ok=True)
-        with open(path, "wb") as fh:
-            dill.dump(host_tree, fh)
-        return path
+        return self._dump_atomic(self.path_for(artifact_type, name),
+                                 host_tree)
 
     def read_pytree(self, artifact_type: str, name: str) -> Any:
         return self.read_object(artifact_type, name)

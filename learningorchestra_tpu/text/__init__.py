@@ -1,0 +1,17 @@
+"""First-party text preprocessing: BPE tokenizer + the text→tensor
+transform that makes the reference's text configs (SURVEY §6 configs
+3/4 — IMDb LSTM, BERT fine-tune) runnable from RAW text instead of
+pre-tokenized integers.
+
+The reference has no tokenizer of its own — its text pipelines assume
+the user ships preprocessing inside ``compile_code`` (reference:
+microservices/binary_executor_image/binary_execution.py:246-268).
+Here tokenization is a first-class transform: deterministic, stored
+with the artifact, and emitting FIXED-LENGTH int32 rows — the static
+shapes XLA needs (a ragged text batch cannot tile onto the MXU; a
+(B, max_len) int32 block can).
+"""
+
+from learningorchestra_tpu.text.bpe import BpeTokenizer
+
+__all__ = ["BpeTokenizer"]

@@ -1,0 +1,323 @@
+"""BPE tokenizer + the /transform/text service.
+
+The reference ships no tokenizer (its text configs assume user
+preprocessing inside compile_code — binary_executor_image/
+binary_execution.py:246-268); this is the framework-native text front
+end: raw text column → deterministic BPE → fixed-length int32 tensor
+shards that the jitted/streaming fit surfaces consume unchanged.
+"""
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.text import BpeTokenizer
+from learningorchestra_tpu.text.bpe import (
+    BOS_ID,
+    EOS_ID,
+    PAD_ID,
+    UNK_ID,
+    count_words,
+)
+
+CORPUS = [
+    "the cat sat on the mat",
+    "the dog sat on the log",
+    "cats chase dogs and dogs chase cats",
+    "a mat and a log",
+] * 25
+
+
+class TestBpeCore:
+    def _tok(self, vocab_size=96):
+        return BpeTokenizer.train(count_words(CORPUS),
+                                  vocab_size=vocab_size)
+
+    def test_round_trip_known_text(self):
+        tok = self._tok()
+        enc = tok.encode("the cat sat on the mat", max_len=32)
+        assert enc.dtype == np.int32 and enc.shape == (32,)
+        assert enc[0] == BOS_ID
+        assert EOS_ID in enc
+        assert tok.decode(enc) == "the cat sat on the mat"
+
+    def test_padding_and_truncation(self):
+        tok = self._tok()
+        enc = tok.encode("the cat", max_len=32)
+        # tail is PAD after EOS
+        eos = int(np.argmax(enc == EOS_ID))
+        assert (enc[eos + 1:] == PAD_ID).all()
+        # truncation always terminates with EOS at the boundary
+        trunc = tok.encode(" ".join(["cat"] * 100), max_len=8)
+        assert trunc.shape == (8,) and trunc[-1] == EOS_ID
+        assert (trunc != PAD_ID).all()
+
+    def test_unknown_chars_hit_unk_not_crash(self):
+        tok = self._tok()
+        enc = tok.encode("zebra quokka", max_len=16)  # chars unseen
+        assert UNK_ID in enc
+
+    def test_determinism_and_json_round_trip(self):
+        a = self._tok()
+        b = self._tok()
+        assert a.vocab == b.vocab and a.merges == b.merges
+        c = BpeTokenizer.from_json(a.to_json())
+        s = "dogs chase cats on a log"
+        assert (a.encode(s, 24) == c.encode(s, 24)).all()
+
+    def test_merges_actually_compress(self):
+        """BPE must beat the character baseline on its own corpus."""
+        tok = self._tok()
+        chars_only = BpeTokenizer(
+            {**{s: i for i, s in
+                enumerate(("<pad>", "<unk>", "<s>", "</s>"))},
+             **{ch: i + 4 for i, ch in
+                enumerate(sorted(set("".join(CORPUS) + "</w>")))}},
+            merges=[],
+        )
+        s = "the cat sat on the mat"
+        n_bpe = int((tok.encode(s, 64) != PAD_ID).sum())
+        n_chr = int((chars_only.encode(s, 64) != PAD_ID).sum())
+        assert n_bpe < n_chr
+
+    def test_vocab_ids_are_dense_and_special_prefixed(self):
+        tok = self._tok()
+        ids = sorted(tok.vocab.values())
+        assert ids == list(range(len(ids)))
+        assert tok.vocab["<pad>"] == PAD_ID == 0
+
+
+class TestTextTransformREST:
+    @pytest.fixture()
+    def served(self, tmp_path):
+        from tests.test_sharded import _start_server
+
+        server, base = _start_server(tmp_path)
+        yield server, base, tmp_path
+        server.shutdown()
+
+    def _ingest_text_csv(self, base, tmp_path, name, rows):
+        import requests
+
+        path = tmp_path / f"{name}.csv"
+        with open(path, "w") as fh:
+            fh.write("review,sentiment\n")
+            for text, lab in rows:
+                fh.write(f'"{text}",{lab}\n')
+        r = requests.post(f"{base}/dataset/csv", json={
+            "datasetName": name, "url": f"file://{path}",
+        })
+        assert r.status_code == 201, r.text
+        from tests.test_sharded import _poll
+
+        _poll(base, f"/dataset/csv/{name}")
+
+    def test_tokenize_train_and_heldout_reuse(self, served):
+        import requests
+
+        from tests.test_sharded import _poll
+
+        server, base, tmp_path = served
+        rng = np.random.default_rng(0)
+        pos = ["great fun film", "loved this great movie",
+               "fun and great", "loved it"]
+        neg = ["terrible boring film", "hated this boring movie",
+               "boring and terrible", "hated it"]
+        rows = [(pos[i % 4], "pos") for i in range(60)] + \
+               [(neg[i % 4], "neg") for i in range(60)]
+        rng.shuffle(rows)
+        self._ingest_text_csv(base, tmp_path, "reviews", rows)
+
+        r = requests.post(f"{base}/transform/text", json={
+            "name": "reviews_tok", "parentName": "reviews",
+            "textField": "review", "labelField": "sentiment",
+            "vocabSize": 128, "maxLen": 16, "shardRows": 32,
+        })
+        assert r.status_code == 201, r.text
+        meta = _poll(base, "/transform/text/reviews_tok")
+        assert meta["sharded"] is True
+        assert meta["rows"] == 120
+        assert meta["featureShape"] == [16]
+        assert meta["labelClasses"] == ["neg", "pos"]
+        assert meta["vocabSize"] <= 128
+
+        # Unknown text field → 406 (validation, not a failed job).
+        bad = requests.post(f"{base}/transform/text", json={
+            "name": "bad", "parentName": "reviews",
+            "textField": "nope",
+        })
+        assert bad.status_code == 406, bad.text
+
+        # Train a small LSTM from the tokenized artifact — the
+        # streaming-fit surface, same request JSON as any dataset.
+        r = requests.post(f"{base}/model/tensorflow", json={
+            "name": "lstm",
+            "modulePath": "learningorchestra_tpu.models.text",
+            "class": "LSTMClassifier",
+            "classParameters": {
+                "vocab_size": 128, "embed_dim": 16, "hidden_dim": 16,
+                "num_classes": 2, "learning_rate": 5e-2,
+            },
+        })
+        assert r.status_code == 201, r.text
+        _poll(base, "/model/tensorflow/lstm")
+        r = requests.post(f"{base}/train/tensorflow", json={
+            "name": "lstmfit", "modelName": "lstm", "parentName": "lstm",
+            "method": "fit",
+            "methodParameters": {
+                "x": "$reviews_tok", "y": "$reviews_tok.label",
+                "epochs": 8, "batch_size": 32,
+            },
+        })
+        assert r.status_code == 201, r.text
+        _poll(base, "/train/tensorflow/lstmfit")
+        import requests as _rq
+
+        docs = _rq.get(f"{base}/train/tensorflow/lstmfit",
+                       params={"limit": 100}).json()
+        hist = [d for d in docs if d.get("docType") == "history"]
+        assert hist and hist[-1]["loss"] < hist[0]["loss"]
+
+        # Held-out split encoded with the TRAIN tokenizer.
+        self._ingest_text_csv(
+            base, tmp_path, "reviews_test",
+            [("great movie loved it", "pos"),
+             ("boring terrible film", "neg")] * 10,
+        )
+        r = requests.post(f"{base}/transform/text", json={
+            "name": "test_tok", "parentName": "reviews_test",
+            "textField": "review", "labelField": "sentiment",
+            "maxLen": 16, "tokenizerFrom": "reviews_tok",
+            "shardRows": 32,
+        })
+        assert r.status_code == 201, r.text
+        meta = _poll(base, "/transform/text/test_tok")
+        assert meta["tokenizer"] == "reviews_tok"
+
+        # PATCH re-run after the parent changes is accepted and
+        # reflects the parent's current rows.
+        r = requests.patch(f"{base}/transform/text/test_tok", json={})
+        assert r.status_code == 200, r.text
+        meta = _poll(base, "/transform/text/test_tok")
+        assert meta["rows"] == 20
+
+        # GET pages show data previews (sharded-CSV preview parity),
+        # and a re-run replaced (not duplicated) them.
+        docs = requests.get(f"{base}/transform/text/test_tok",
+                            params={"limit": 100}).json()
+        rows = [d for d in docs if "tokens" in d]
+        assert 0 < len(rows) <= 20
+        assert rows[0]["text"] and isinstance(rows[0]["tokens"], list)
+
+        # Malformed numeric params are a 406, not a 500.
+        r = requests.post(f"{base}/transform/text", json={
+            "name": "badlen", "parentName": "reviews",
+            "textField": "review", "maxLen": "long",
+        })
+        assert r.status_code == 406, (r.status_code, r.text)
+
+        # PATCH after the parent's schema dropped the text column → 406.
+        self._ingest_text_csv(base, tmp_path, "mut",
+                              [("nice fine good", "pos")] * 10)
+        r = requests.post(f"{base}/transform/text", json={
+            "name": "mut_tok", "parentName": "mut",
+            "textField": "review", "maxLen": 8,
+        })
+        assert r.status_code == 201
+        _poll(base, "/transform/text/mut_tok")
+        # Re-ingest the parent under the same name with a DIFFERENT
+        # schema (delete + create — datasets have no PATCH).
+        assert requests.delete(
+            f"{base}/dataset/csv/mut"
+        ).status_code == 200
+        path = tmp_path / "mut.csv"
+        with open(path, "w") as fh:
+            fh.write("body,sentiment\nhello,pos\n")
+        r = requests.post(f"{base}/dataset/csv", json={
+            "datasetName": "mut", "url": f"file://{path}",
+        })
+        assert r.status_code == 201, r.text
+        _poll(base, "/dataset/csv/mut")
+        r = requests.patch(f"{base}/transform/text/mut_tok", json={})
+        assert r.status_code == 406, (r.status_code, r.text)
+
+    def test_reserved_suffix_missing_labels_and_delete_cleanup(
+        self, served
+    ):
+        import requests
+
+        from tests.test_sharded import _poll
+
+        server, base, tmp_path = served
+        self._ingest_text_csv(base, tmp_path, "txt",
+                              [("good fine nice", "a")] * 20)
+
+        # '.tokenizer' names are reserved (they would collide with the
+        # trained-tokenizer binary in the shared transform volume).
+        r = requests.post(f"{base}/transform/text", json={
+            "name": "x.tokenizer", "parentName": "txt",
+            "textField": "review",
+        })
+        assert r.status_code == 406, r.text
+
+        # A row with a missing label must fail the JOB with a clear
+        # error — never become a phantom "None" class.
+        path = tmp_path / "holey.csv"
+        with open(path, "w") as fh:
+            fh.write("review,sentiment\ngood,pos\nbad,\nfine,pos\n")
+        r = requests.post(f"{base}/dataset/csv", json={
+            "datasetName": "holey", "url": f"file://{path}",
+        })
+        assert r.status_code == 201
+        _poll(base, "/dataset/csv/holey")
+        r = requests.post(f"{base}/transform/text", json={
+            "name": "holey_tok", "parentName": "holey",
+            "textField": "review", "labelField": "sentiment",
+        })
+        assert r.status_code == 201
+        with pytest.raises(AssertionError, match="no 'sentiment'"):
+            _poll(base, "/transform/text/holey_tok")
+
+        # DELETE removes the trained tokenizer too: a later
+        # tokenizerFrom pointing at the deleted artifact must 406.
+        r = requests.post(f"{base}/transform/text", json={
+            "name": "tok1", "parentName": "txt", "textField": "review",
+            "vocabSize": 64, "maxLen": 8,
+        })
+        assert r.status_code == 201, r.text
+        _poll(base, "/transform/text/tok1")
+        assert requests.delete(
+            f"{base}/transform/text/tok1"
+        ).status_code == 200
+        r = requests.post(f"{base}/transform/text", json={
+            "name": "tok2", "parentName": "txt", "textField": "review",
+            "maxLen": 8, "tokenizerFrom": "tok1",
+        })
+        assert r.status_code == 406, r.text
+
+        # Malformed tokenizerFrom values are 406s, not 500s.
+        for bad_tf in ("a/b", "", 5):
+            r = requests.post(f"{base}/transform/text", json={
+                "name": "tok3", "parentName": "txt",
+                "textField": "review", "tokenizerFrom": bad_tf,
+            })
+            assert r.status_code == 406, (bad_tf, r.status_code, r.text)
+
+        # PATCH re-run whose tokenizerFrom source was deleted → 406
+        # (not a job-time FileNotFoundError).
+        r = requests.post(f"{base}/transform/text", json={
+            "name": "src", "parentName": "txt", "textField": "review",
+            "vocabSize": 64, "maxLen": 8,
+        })
+        assert r.status_code == 201
+        _poll(base, "/transform/text/src")
+        r = requests.post(f"{base}/transform/text", json={
+            "name": "dep", "parentName": "txt", "textField": "review",
+            "maxLen": 8, "tokenizerFrom": "src",
+        })
+        assert r.status_code == 201
+        _poll(base, "/transform/text/dep")
+        assert requests.delete(
+            f"{base}/transform/text/src"
+        ).status_code == 200
+        r = requests.patch(f"{base}/transform/text/dep", json={})
+        assert r.status_code == 406, (r.status_code, r.text)
