@@ -233,9 +233,13 @@ class TransformService:
             raise ValidationError("textField is required")
 
         def _int(value, key):
-            # Malformed request input must be a 406, not an int() 500.
+            # Malformed request input must be a 406, not an int() 500 —
+            # and a non-integral float must not silently truncate.
             try:
-                return int(value)
+                out = int(value)
+                if isinstance(value, float) and value != out:
+                    raise ValueError
+                return out
             except (TypeError, ValueError):
                 raise ValidationError(
                     f"{key} must be an integer, got {value!r}"
@@ -330,6 +334,9 @@ class TransformService:
         def tokenize():
             import numpy as np
 
+            from learningorchestra_tpu.services.dataset import (
+                DatasetService,
+            )
             from learningorchestra_tpu.store.sharded import (
                 ShardedTensorWriter,
             )
@@ -342,22 +349,6 @@ class TransformService:
             )
             if not docs:
                 raise ValueError(f"dataset {parent_name!r} has no rows")
-            if tokenizer_from:
-                tok = self.ctx.volumes.read_object(
-                    TEXT_TYPE, _tokenizer_volume_name(tokenizer_from)
-                )
-            else:
-                wc = count_words(
-                    (d.get(text_field) or "" for d in docs),
-                    lowercase=bool(meta.get("lowercase", True)),
-                )
-                tok = BpeTokenizer.train(
-                    wc, vocab_size=int(meta["vocabSize"]),
-                    lowercase=bool(meta.get("lowercase", True)),
-                )
-                self.ctx.volumes.save_object(
-                    TEXT_TYPE, _tokenizer_volume_name(name), tok
-                )
 
             classes: list | None = None
             labels = None
@@ -383,7 +374,21 @@ class TransformService:
                     isinstance(v, (int, float))
                     and float(v) == int(v) for v in raw
                 ):
-                    labels = np.asarray([int(v) for v in raw], np.int64)
+                    ints = [int(v) for v in raw]
+                    uniq = sorted(set(ints))
+                    if uniq == list(range(len(uniq))):
+                        # Already dense [0, K) — store as-is.
+                        labels = np.asarray(ints, np.int64)
+                    else:
+                        # Sparse/negative integer classes ({-1,1},
+                        # {1,2}, ...): remap densely like strings —
+                        # out-of-range ids silently corrupt the
+                        # downstream one-hot (XLA clamps indices).
+                        lut = {c: i for i, c in enumerate(uniq)}
+                        labels = np.asarray(
+                            [lut[v] for v in ints], np.int64
+                        )
+                        classes = [str(c) for c in uniq]
                 else:
                     # String / non-integral labels: deterministic
                     # class ids (sorted order), recorded for decode.
@@ -392,6 +397,36 @@ class TransformService:
                     labels = np.asarray(
                         [lut[str(v)] for v in raw], np.int64
                     )
+
+            # Tokenizer work comes AFTER label validation: training is
+            # the expensive step, and saving the trained tokenizer
+            # before a validation failure would publish a live,
+            # tokenizerFrom-reachable artifact from a FAILED job.
+            if tokenizer_from:
+                try:
+                    tok = self.ctx.volumes.read_object(
+                        TEXT_TYPE, _tokenizer_volume_name(tokenizer_from)
+                    )
+                except FileNotFoundError:
+                    # Validated at request time, but a DELETE can land
+                    # between queueing and running — surface it as a
+                    # clear job error, not a raw traceback.
+                    raise ValueError(
+                        f"tokenizer {tokenizer_from!r} was deleted "
+                        "before this job ran"
+                    ) from None
+            else:
+                wc = count_words(
+                    (d.get(text_field) or "" for d in docs),
+                    lowercase=bool(meta.get("lowercase", True)),
+                )
+                tok = BpeTokenizer.train(
+                    wc, vocab_size=int(meta["vocabSize"]),
+                    lowercase=bool(meta.get("lowercase", True)),
+                )
+                # NOT saved yet: publish only after the shard writer
+                # succeeds, so a failed run can't leave a live (or, on
+                # PATCH, overwrite the previous good) tokenizer.
 
             root = self.ctx.volumes.path_for(TEXT_TYPE, name)
             if replace:
@@ -430,7 +465,7 @@ class TransformService:
                 # parity — dataset.py PREVIEW_ROWS); token rows are
                 # small, unlike image tensors, so previews are cheap.
                 for j in range(len(enc)):
-                    if len(preview) >= 20:
+                    if len(preview) >= DatasetService.PREVIEW_ROWS:
                         break
                     row = {
                         "text": str(docs[i + j].get(text_field) or ""),
@@ -440,6 +475,12 @@ class TransformService:
                         row["label"] = int(labels[i + j])
                     preview.append(row)
             manifest = writer.close()
+            if not tokenizer_from:
+                # Commit point: shards are on disk, now the freshly
+                # trained tokenizer may go live for tokenizerFrom.
+                self.ctx.volumes.save_object(
+                    TEXT_TYPE, _tokenizer_volume_name(name), tok
+                )
             if preview:
                 self.ctx.documents.insert_many(name, preview)
             out = {

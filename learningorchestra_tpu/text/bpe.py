@@ -96,6 +96,17 @@ class BpeTokenizer:
         alphabet = sorted({s for syms in words for s in syms})
         merges: list[tuple[str, str]] = []
         n_tokens = len(_SPECIALS) + len(alphabet)
+        if n_tokens > vocab_size:
+            # Specials + the full corpus alphabet are always in the
+            # vocab, so a smaller request can't be honored — and
+            # letting ids overflow the requested size silently breaks
+            # the downstream embedding gather (XLA clamps indices).
+            raise ValueError(
+                f"vocab_size={vocab_size} is smaller than the corpus "
+                f"alphabet ({len(alphabet)} symbols + "
+                f"{len(_SPECIALS)} specials = {n_tokens}); raise "
+                "vocab_size to at least that"
+            )
 
         # Best-pair selection via a lazy-invalidation max-heap: a full
         # max() over pair_counts per merge would be O(#distinct pairs)

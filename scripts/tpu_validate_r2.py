@@ -1,9 +1,14 @@
 """On-chip validation of round-2 additions — run when the TPU tunnel is up.
 
-Covers: ring-flash attention (compile + correctness + timing vs the jnp
-ring on ONE chip via a single-device sp=1... not meaningful -> skipped;
-ring needs multi-chip), causal flash timing (looped), MoE + pipeline
-models training a step on the chip, and the fused-epoch bench runner.
+Covers: causal/sliding-window flash timing + correctness (looped), ring-
+flash sp=1 composition, RoPE/GQA/window decode, KV-cache generate, a MoE
+train step, and the fused-epoch bench runner.
+
+Ordered cheapest-compile first: the tunnel flaps, and a hang mid-script
+should still leave the maximum recorded evidence (the 04:01 UTC attempt
+hung inside the FIRST step — then the MoE compile — and recorded
+nothing in 45 minutes).  Each step prints a STEP banner up front so the
+log shows exactly where a wedge happened.
 
 All timing uses the looped methodology (TPU_EVIDENCE.md): N iterations
 inside one jitted fori_loop, one scalar readback.
@@ -18,6 +23,10 @@ from jax import lax
 
 assert jax.devices()[0].platform == "tpu", jax.devices()
 print("device:", jax.devices()[0], flush=True)
+
+
+def step(name):
+    print(f"STEP {name} @ {time.strftime('%H:%M:%S')}", flush=True)
 
 
 def onchip_time(fn, args, est_ms, budget_ms=1500):
@@ -38,42 +47,22 @@ def onchip_time(fn, args, est_ms, budget_ms=1500):
     return (time.perf_counter() - t0) / iters
 
 
-# -- 1. MoE transformer train step on chip ---------------------------------
-from learningorchestra_tpu.models.moe import MoETransformerClassifier  # noqa: E402
-
+# -- 0. dispatch probe: a tiny matmul, so the log distinguishes "tunnel
+# dead on arrival" from "hung inside a heavy compile" ------------------
+step("probe")
 rng = np.random.default_rng(0)
-x = rng.integers(1, 1000, (64, 128), dtype=np.int32)
-y = rng.integers(0, 2, (64,), dtype=np.int32)
-est = MoETransformerClassifier(
-    vocab_size=1000, hidden_dim=256, num_layers=4, num_heads=8,
-    max_len=128, num_experts=8, mlp_dim=1024,
+t0 = time.perf_counter()
+_p = jnp.asarray(rng.standard_normal((256, 256)), jnp.float32)
+float(jnp.sum(jax.jit(lambda a: a @ a)(_p)))
+print(f"probe matmul ok in {time.perf_counter()-t0:.2f}s", flush=True)
+
+# -- 1. causal flash timing (fills the causal table) -----------------------
+from learningorchestra_tpu.ops.attention import (  # noqa: E402
+    flash_attention,
+    mha_reference,
 )
-t0 = time.perf_counter()
-est.fit(x, y, epochs=3, batch_size=32, verbose=0)
-print(f"MoE train 3 epochs ok, loss={est.history['loss'][-1]:.4f} "
-      f"({time.perf_counter()-t0:.1f}s incl compile)", flush=True)
 
-# -- 2. KV-cache generate on chip ------------------------------------------
-from learningorchestra_tpu.models.text import DecoderLM  # noqa: E402
-
-lm = DecoderLM(vocab_size=1000, hidden_dim=256, num_layers=4,
-               num_heads=8, max_len=256)
-xs = rng.integers(1, 1000, (16, 64), dtype=np.int32)
-tg = np.concatenate([xs[:, 1:], np.zeros((16, 1), np.int32)], 1)
-lm.fit(xs, tg, epochs=1, batch_size=16, verbose=0)
-t0 = time.perf_counter()
-out = lm.generate(xs[:4, :32], max_new_tokens=96)  # compile + run
-t1 = time.perf_counter()
-out = lm.generate(xs[:4, :32], max_new_tokens=96)  # cached fn
-t2 = time.perf_counter()
-assert out.shape == (4, 128)
-print(f"KV-cache generate 96 tok ok: first {t1-t0:.1f}s (compile), "
-      f"second {t2-t1:.2f}s -> {(t2-t1)/96*1e3:.1f} ms/token incl tunnel",
-      flush=True)
-
-# -- 3. causal flash timing (fills the causal table) -----------------------
-from learningorchestra_tpu.ops.attention import flash_attention  # noqa: E402
-
+step("causal flash bwd timing")
 for (b, h, t, d, est_ms) in [(1, 8, 4096, 64, 0.4), (1, 2, 32768, 64, 3)]:
     q = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.bfloat16)
     k = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.bfloat16)
@@ -86,7 +75,36 @@ for (b, h, t, d, est_ms) in [(1, 8, 4096, 64, 0.4), (1, 2, 32768, 64, 3)]:
     print(f"causal bwd B{b} H{h} T{t} D{d}: {tb*1e3:.2f} ms "
           f"({2.5*fl/2/tb/1e12:.0f} TF/s causal-effective)", flush=True)
 
-# -- 3b. ring-flash on the chip (sp=1 degenerate ring: proves the
+# -- 2. sliding-window flash on chip: correctness + the band
+# narrowing's O(T*W) scaling (time should track W, not T) -------------
+step("window flash")
+for (t, w, est_ms) in [(32768, 1024, 1), (32768, 4096, 2)]:
+    q = jnp.asarray(rng.standard_normal((1, 2, t, 64)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((1, 2, t, 64)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((1, 2, t, 64)), jnp.bfloat16)
+    tw = onchip_time(
+        lambda q, k, v: flash_attention(
+            q, k, v, causal=True, window=w, interpret=False
+        ), (q, k, v), est_ms,
+    )
+    band_fl = 4 * 2 * t * w * 64  # ~2*T*W keys per query pair of matmuls
+    print(f"window flash T={t} W={w}: {tw*1e3:.2f} ms "
+          f"(~{band_fl/tw/1e12:.0f} TF/s on the band)", flush=True)
+# correctness at a padded/odd config
+q = jnp.asarray(rng.standard_normal((2, 2, 1000, 64)), jnp.bfloat16)
+k = jnp.asarray(rng.standard_normal((2, 2, 1000, 64)), jnp.bfloat16)
+v = jnp.asarray(rng.standard_normal((2, 2, 1000, 64)), jnp.bfloat16)
+ow = flash_attention(q, k, v, causal=True, window=100, interpret=False)
+rw = mha_reference(
+    q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+    causal=True, window=100,
+)
+werr = float(jnp.max(jnp.abs(ow.astype(jnp.float32) - rw)))
+print(f"window flash correctness (T=1000, W=100): max err {werr:.4f}",
+      flush=True)
+assert werr < 0.05, werr
+
+# -- 3. ring-flash on the chip (sp=1 degenerate ring: proves the
 # shard_map + Pallas composition compiles and matches on real hardware;
 # the multi-chip ring itself is validated on the virtual mesh) ---------
 from learningorchestra_tpu.parallel.mesh import MeshSpec, build_mesh  # noqa: E402
@@ -95,6 +113,7 @@ from learningorchestra_tpu.parallel.ring_attention import (  # noqa: E402
     ring_flash_attention,
 )
 
+step("ring-flash sp=1")
 mesh1 = build_mesh(MeshSpec(dp=1, sp=1))
 b, t, h, d = 2, 2048, 4, 64
 q = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.bfloat16)
@@ -110,37 +129,27 @@ err = float(jnp.max(jnp.abs(o.astype(jnp.float32) - ref)))
 print(f"ring-flash (sp=1) on chip: max err {err:.4f}", flush=True)
 assert err < 0.05, err
 
-# -- 3c. sliding-window flash on chip: correctness + the band
-# narrowing's O(T*W) scaling (time should track W, not T) -------------
-for (t, w, est_ms) in [(32768, 1024, 1), (32768, 4096, 2)]:
-    q = jnp.asarray(rng.standard_normal((1, 2, t, 64)), jnp.bfloat16)
-    k = jnp.asarray(rng.standard_normal((1, 2, t, 64)), jnp.bfloat16)
-    v = jnp.asarray(rng.standard_normal((1, 2, t, 64)), jnp.bfloat16)
-    tw = onchip_time(
-        lambda q, k, v: flash_attention(
-            q, k, v, causal=True, window=w, interpret=False
-        ), (q, k, v), est_ms,
-    )
-    band_fl = 4 * 2 * t * w * 64  # ~2*T*W keys per query pair of matmuls
-    print(f"window flash T={t} W={w}: {tw*1e3:.2f} ms "
-          f"(~{band_fl/tw/1e12:.0f} TF/s on the band)", flush=True)
-# correctness at a padded/odd config
-from learningorchestra_tpu.ops.attention import mha_reference  # noqa: E402
+# -- 4. KV-cache generate on chip ------------------------------------------
+from learningorchestra_tpu.models.text import DecoderLM  # noqa: E402
 
-q = jnp.asarray(rng.standard_normal((2, 2, 1000, 64)), jnp.bfloat16)
-k = jnp.asarray(rng.standard_normal((2, 2, 1000, 64)), jnp.bfloat16)
-v = jnp.asarray(rng.standard_normal((2, 2, 1000, 64)), jnp.bfloat16)
-ow = flash_attention(q, k, v, causal=True, window=100, interpret=False)
-rw = mha_reference(
-    q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
-    causal=True, window=100,
-)
-werr = float(jnp.max(jnp.abs(ow.astype(jnp.float32) - rw)))
-print(f"window flash correctness (T=1000, W=100): max err {werr:.4f}",
+step("KV-cache generate")
+lm = DecoderLM(vocab_size=1000, hidden_dim=256, num_layers=4,
+               num_heads=8, max_len=256)
+xs = rng.integers(1, 1000, (16, 64), dtype=np.int32)
+tg = np.concatenate([xs[:, 1:], np.zeros((16, 1), np.int32)], 1)
+lm.fit(xs, tg, epochs=1, batch_size=16, verbose=0)
+t0 = time.perf_counter()
+out = lm.generate(xs[:4, :32], max_new_tokens=96)  # compile + run
+t1 = time.perf_counter()
+out = lm.generate(xs[:4, :32], max_new_tokens=96)  # cached fn
+t2 = time.perf_counter()
+assert out.shape == (4, 128)
+print(f"KV-cache generate 96 tok ok: first {t1-t0:.1f}s (compile), "
+      f"second {t2-t1:.2f}s -> {(t2-t1)/96*1e3:.1f} ms/token incl tunnel",
       flush=True)
-assert werr < 0.05, werr
 
-# -- 3d. RoPE + GQA + window decoder generates on chip ----------------
+# -- 5. RoPE + GQA + window decoder generates on chip ----------------
+step("RoPE+GQA+window decoder")
 rope_lm = DecoderLM(
     vocab_size=1000, hidden_dim=256, num_layers=2, num_heads=8,
     max_len=256, positional="rope", num_kv_heads=2,
@@ -151,11 +160,29 @@ out = rope_lm.generate(xs[:2, :16], max_new_tokens=32)
 assert out.shape == (2, 48) and (out[:, 16:] != 0).any()
 print("RoPE+GQA+window decoder generate ok on chip", flush=True)
 
-# -- 4. fused-epoch bench runner -------------------------------------------
+# -- 6. MoE transformer train step on chip (the heaviest compile of the
+# set — last, after everything else is on the record) ------------------
+from learningorchestra_tpu.models.moe import MoETransformerClassifier  # noqa: E402
+
+step("MoE train")
+x = rng.integers(1, 1000, (64, 128), dtype=np.int32)
+y = rng.integers(0, 2, (64,), dtype=np.int32)
+est = MoETransformerClassifier(
+    vocab_size=1000, hidden_dim=256, num_layers=4, num_heads=8,
+    max_len=128, num_experts=8, mlp_dim=1024,
+)
+t0 = time.perf_counter()
+est.fit(x, y, epochs=3, batch_size=32, verbose=0)
+print(f"MoE train 3 epochs ok, loss={est.history['loss'][-1]:.4f} "
+      f"({time.perf_counter()-t0:.1f}s incl compile)", flush=True)
+
+# -- 7. fused-epoch bench runner -------------------------------------------
 import subprocess, sys, os  # noqa: E402
+
+step("bench.py")
 r = subprocess.run([sys.executable, os.path.join(
     os.path.dirname(__file__), "..", "bench.py")],
-    capture_output=True, text=True, timeout=900)
+    capture_output=True, text=True, timeout=1500)
 print("bench.py:", r.stdout.strip().splitlines()[-1] if r.stdout else r.stderr[-500:],
       flush=True)
 print("ALL ON-CHIP CHECKS DONE", flush=True)

@@ -9,6 +9,11 @@
 set -u
 cd "$(dirname "$0")/.."
 export PYTHONPATH=/root/.axon_site:/root/repo
+# Persistent compilation cache: the tunnel flaps, and every retry repays
+# its compiles from scratch otherwise.  If the axon backend can't
+# serialize executables this is a harmless no-op warning.
+export JAX_COMPILATION_CACHE_DIR=/tmp/jax_cache
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=3
 LOGDIR=/tmp/tpu_chain
 mkdir -p "$LOGDIR"
 

@@ -85,6 +85,19 @@ class TestBpeCore:
         assert ids == list(range(len(ids)))
         assert tok.vocab["<pad>"] == PAD_ID == 0
 
+    def test_vocab_smaller_than_alphabet_is_an_error(self):
+        """Specials + the full alphabet always enter the vocab; a
+        request below that must fail loudly — ids past the requested
+        size would silently corrupt the downstream embedding gather
+        (XLA clamps out-of-range indices)."""
+        wc = count_words(CORPUS)
+        n_alpha = len({ch for w in wc for ch in w} | {"</w>"})
+        with pytest.raises(ValueError, match="alphabet"):
+            BpeTokenizer.train(wc, vocab_size=4 + n_alpha - 1)
+        # The exact boundary trains fine (zero merges).
+        tok = BpeTokenizer.train(wc, vocab_size=4 + n_alpha)
+        assert tok.vocab_size == 4 + n_alpha and not tok.merges
+
 
 class TestTextTransformREST:
     @pytest.fixture()
@@ -276,6 +289,45 @@ class TestTextTransformREST:
         assert r.status_code == 201
         with pytest.raises(AssertionError, match="no 'sentiment'"):
             _poll(base, "/transform/text/holey_tok")
+        # The failed job must NOT have published a reusable tokenizer
+        # (publication is deferred to the post-writer commit point).
+        r = requests.post(f"{base}/transform/text", json={
+            "name": "from_failed", "parentName": "txt",
+            "textField": "review", "tokenizerFrom": "holey_tok",
+        })
+        assert r.status_code == 406, (r.status_code, r.text)
+
+        # Sparse/negative integer labels ({-1,1}) are densely remapped
+        # with labelClasses recorded — stored as-is they would one-hot
+        # out of range downstream (XLA clamps, training silently
+        # degrades).
+        spath = tmp_path / "sparse.csv"
+        with open(spath, "w") as fh:
+            fh.write("review,sentiment\ngood,1\nbad,-1\nfine,1\n")
+        r = requests.post(f"{base}/dataset/csv", json={
+            "datasetName": "sparse", "url": f"file://{spath}",
+        })
+        assert r.status_code == 201
+        _poll(base, "/dataset/csv/sparse")
+        r = requests.post(f"{base}/transform/text", json={
+            "name": "sparse_tok", "parentName": "sparse",
+            "textField": "review", "labelField": "sentiment",
+        })
+        assert r.status_code == 201, r.text
+        meta = _poll(base, "/transform/text/sparse_tok")
+        assert meta["labelClasses"] == ["-1", "1"]
+        rows = [d for d in requests.get(
+            f"{base}/transform/text/sparse_tok",
+            params={"limit": 10},
+        ).json() if "label" in d]
+        assert sorted({d["label"] for d in rows}) == [0, 1]
+
+        # Non-integral float params must 406, not silently truncate.
+        r = requests.post(f"{base}/transform/text", json={
+            "name": "floaty", "parentName": "txt",
+            "textField": "review", "maxLen": 16.9,
+        })
+        assert r.status_code == 406, (r.status_code, r.text)
 
         # DELETE removes the trained tokenizer too: a later
         # tokenizerFrom pointing at the deleted artifact must 406.
