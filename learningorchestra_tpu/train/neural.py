@@ -21,6 +21,7 @@ This class accepts the same request shape but executes TPU-first:
 from __future__ import annotations
 
 import functools
+import re
 import time
 from typing import Any, Callable
 
@@ -37,6 +38,124 @@ def _train_logger():
     from learningorchestra_tpu.log import get_logger
 
     return get_logger("train")
+
+
+def _spec_get(spec: dict, snake: str, default=None, *, required=False):
+    """Read a spec key in snake_case OR camelCase — REST bodies use
+    camelCase (vocabSize, maxLen) while Python callers write snake."""
+    camel = re.sub(r"_(\w)", lambda m: m.group(1).upper(), snake)
+    for key in (snake, camel):
+        if key in spec:
+            return spec[key]
+    if required:
+        raise ValueError(f"learning-rate schedule needs {snake!r}")
+    return default
+
+
+def resolve_learning_rate(lr):
+    """A float passes through; a dict becomes an optax schedule.
+
+    JSON-expressible schedules so the REST surface (model
+    classParameters / train compile bodies, services/model.py) can
+    configure warmup and decay without shipping Python — the
+    reference's wrapped keras models took schedules via compile_code
+    (reference: binary_executor_image/training_function/
+    train_function.py:75-82); here the same knob is declarative:
+
+        {"schedule": "warmup_cosine", "peakValue": 3e-4,
+         "warmupSteps": 500, "decaySteps": 10000}
+
+    Kinds: constant, warmup_cosine, cosine, exponential, piecewise.
+    Steps are optimizer steps (one per batch), the optax convention.
+    """
+    if not isinstance(lr, dict):
+        return float(lr)
+    kind = str(lr.get("schedule", "")).lower()
+    if kind in ("warmup_cosine", "warmupcosine"):
+        peak = float(_spec_get(lr, "peak_value", required=True))
+        return optax.warmup_cosine_decay_schedule(
+            init_value=float(_spec_get(lr, "init_value", 0.0)),
+            peak_value=peak,
+            warmup_steps=int(_spec_get(lr, "warmup_steps", required=True)),
+            decay_steps=int(_spec_get(lr, "decay_steps", required=True)),
+            end_value=float(_spec_get(lr, "end_value", 0.0)),
+        )
+    if kind == "cosine":
+        return optax.cosine_decay_schedule(
+            init_value=float(_spec_get(lr, "init_value", required=True)),
+            decay_steps=int(_spec_get(lr, "decay_steps", required=True)),
+            alpha=float(_spec_get(lr, "alpha", 0.0)),
+        )
+    if kind == "exponential":
+        return optax.exponential_decay(
+            init_value=float(_spec_get(lr, "init_value", required=True)),
+            transition_steps=int(
+                _spec_get(lr, "transition_steps", required=True)
+            ),
+            decay_rate=float(_spec_get(lr, "decay_rate", required=True)),
+            staircase=bool(_spec_get(lr, "staircase", False)),
+        )
+    if kind == "piecewise":
+        # JSON object keys are strings; optax wants {int step: scale}.
+        raw = _spec_get(lr, "boundaries_and_scales", required=True)
+        return optax.piecewise_constant_schedule(
+            init_value=float(_spec_get(lr, "init_value", required=True)),
+            boundaries_and_scales={
+                int(k): float(v) for k, v in dict(raw).items()
+            },
+        )
+    if kind == "constant":
+        return float(_spec_get(lr, "value", required=True))
+    raise ValueError(
+        f"unknown learning-rate schedule {lr.get('schedule')!r}; "
+        "expected warmup_cosine | cosine | exponential | piecewise | "
+        "constant"
+    )
+
+
+_OPTIMIZER_FACTORIES = {
+    name: getattr(optax, name)
+    for name in ("adam", "adamw", "sgd", "rmsprop", "adagrad", "lamb",
+                 "lion", "novograd", "radam")
+    if hasattr(optax, name)
+}
+
+
+def resolve_optimizer(optimizer, learning_rate=1e-3):
+    """Turn a REST-expressible optimizer spec into an optax transform.
+
+    ``optimizer`` may be: None (adam at ``learning_rate``), an optax
+    object (passed through), a name string ("sgd"), or a dict
+    ``{"name": "adamw", "learningRate": ..., "weightDecay": 1e-2}`` —
+    extra keys forward to the optax factory (snake or camelCase); the
+    learning rate itself may be a schedule spec
+    (:func:`resolve_learning_rate`).
+    """
+    if optimizer is None:
+        return optax.adam(resolve_learning_rate(learning_rate))
+    if isinstance(optimizer, str):
+        optimizer = {"name": optimizer}
+    if not isinstance(optimizer, dict):
+        return optimizer  # already an optax GradientTransformation
+    spec = dict(optimizer)
+    name = str(spec.pop("name", "") or "").lower()
+    factory = _OPTIMIZER_FACTORIES.get(name)
+    if factory is None:
+        raise ValueError(
+            f"unknown optimizer {name!r}; expected one of "
+            f"{sorted(_OPTIMIZER_FACTORIES)}"
+        )
+    lr = None
+    for key in ("learning_rate", "learningRate"):
+        if key in spec:
+            lr = spec.pop(key)
+    if lr is None:
+        lr = learning_rate
+    kwargs = {
+        re.sub(r"([A-Z])", lambda m: "_" + m.group(1).lower(), k): v
+        for k, v in spec.items()
+    }
+    return factory(resolve_learning_rate(lr), **kwargs)
 
 
 class TrainHistory(dict):
@@ -386,7 +505,14 @@ class NeuralEstimator(Estimator):
         self.learning_rate = learning_rate
         self.seed = seed
         self.compute_dtype = compute_dtype
-        self.optimizer = optimizer or optax.adam(learning_rate)
+        self.optimizer = resolve_optimizer(optimizer, learning_rate)
+        # Remember the declarative spec (name/dict/None=adam) so a later
+        # compile(learning_rate=...) can rebuild the SAME optimizer kind;
+        # an opaque optax object can't be rebuilt at a new rate.
+        self._optimizer_spec = (
+            optimizer if isinstance(optimizer, (str, dict))
+            else ({"name": "adam"} if optimizer is None else None)
+        )
         self.params = None
         self.opt_state = None
         self.history = TrainHistory()
@@ -408,11 +534,46 @@ class NeuralEstimator(Estimator):
         self._device_epoch_key = None
         self._opt_version = getattr(self, "_opt_version", 0) + 1
 
-    def compile(self, optimizer=None, loss: str | None = None, **_) -> None:
+    def compile(self, optimizer=None, loss: str | None = None,
+                learning_rate=None, **kw) -> None:
         """Reconfigure optimizer/loss — the reference's ``compile_code``
-        contract, declaratively (train_function.py:75-82)."""
+        contract, declaratively (train_function.py:75-82).  ``optimizer``
+        accepts an optax object, a name string, or a REST-JSON dict spec
+        (:func:`resolve_optimizer`); ``learning_rate`` (or camelCase
+        ``learningRate``) alone rebuilds the current optimizer kind at
+        the new rate/schedule."""
+        if learning_rate is None:
+            learning_rate = kw.pop("learningRate", None)
+        if optimizer is None and learning_rate is not None:
+            # Rebuild the CURRENT optimizer kind at the new rate.
+            # (Missing attribute = artifact pickled before this field
+            # existed; those were always adam-default.)
+            spec = getattr(self, "_optimizer_spec", {"name": "adam"})
+            if spec is None:
+                raise ValueError(
+                    "current optimizer is an optax object whose rate "
+                    "is baked in; pass optimizer= explicitly to "
+                    "change it"
+                )
+            optimizer = spec
         if optimizer is not None:
-            self.optimizer = optimizer
+            if learning_rate is not None and not isinstance(
+                optimizer, (str, dict)
+            ):
+                raise ValueError(
+                    "learning_rate is ignored for optax optimizer "
+                    "objects — bake the rate into the object, or pass "
+                    "a name/dict spec"
+                )
+            self.optimizer = resolve_optimizer(
+                optimizer, learning_rate if learning_rate is not None
+                else self.learning_rate,
+            )
+            self._optimizer_spec = (
+                optimizer if isinstance(optimizer, (str, dict)) else None
+            )
+            if learning_rate is not None:
+                self.learning_rate = learning_rate
             # A fresh base optimizer voids any accumulation wrapper and
             # any state built for the old one.
             self._base_optimizer = None
