@@ -166,6 +166,101 @@ class TrainHistory(dict):
             self.setdefault(key, []).append(float(val))
 
 
+class EarlyStopping:
+    """Keras-parity early stopping, usable as a fit callback or (as a
+    JSON dict via the REST train surface) the ``early_stopping`` fit
+    parameter — the reference's wrapped keras models took this via
+    callback code strings (reference: binary_executor_image/
+    training_function/train_function.py:75-87).
+
+    ``monitor=None`` picks ``val_loss`` when validation runs, else
+    ``loss``.  ``mode="auto"`` minimizes unless the metric name looks
+    like accuracy/F1.  ``restore_best_weights=True`` snapshots the best
+    epoch's params (a device-side copy — the epoch runner donates its
+    input buffers, so holding a live reference would dangle)."""
+
+    def __init__(self, monitor: str | None = None, patience: int = 0,
+                 min_delta: float = 0.0, mode: str = "auto",
+                 restore_best_weights: bool = False, baseline=None):
+        if mode not in ("auto", "min", "max"):
+            raise ValueError(f"mode must be auto|min|max, got {mode!r}")
+        self.monitor = monitor
+        self.patience = int(patience)
+        self.min_delta = abs(float(min_delta))
+        self.mode = mode
+        self.restore_best_weights = bool(restore_best_weights)
+        self.baseline = baseline
+        self.reset()
+
+    def reset(self) -> None:
+        """Clear per-run state — fit() calls this at train start so a
+        reused instance doesn't carry best/wait (or a stale best-params
+        snapshot) from a previous fit into a new one."""
+        self.best = None
+        self.best_params = None
+        self.best_epoch = None
+        self.wait = 0
+
+    @classmethod
+    def from_spec(cls, spec) -> "EarlyStopping":
+        """Build from a REST-JSON dict (snake_case or camelCase)."""
+        if isinstance(spec, cls):
+            return spec
+        if spec is True:
+            return cls()
+        spec = dict(spec)
+        kw = {}
+        for snake in ("monitor", "patience", "min_delta", "mode",
+                      "restore_best_weights", "baseline"):
+            val = _spec_get(spec, snake)
+            if val is not None:
+                kw[snake] = val
+        return cls(**kw)
+
+    def _resolve(self, metrics: dict) -> tuple[str, bool]:
+        name = self.monitor or (
+            "val_loss" if "val_loss" in metrics else "loss"
+        )
+        if self.mode != "auto":
+            minimize = self.mode == "min"
+        else:
+            minimize = not any(
+                tag in name for tag in ("acc", "f1", "auc", "precision",
+                                        "recall")
+            )
+        return name, minimize
+
+    def __call__(self, epoch: int, metrics: dict, model) -> None:
+        name, minimize = self._resolve(metrics)
+        if name not in metrics:
+            return  # e.g. val_loss requested but no validation ran
+        value = float(metrics[name])
+        if self.best is None and self.baseline is not None:
+            # keras semantics: with a baseline, the first "best" to beat
+            # is the baseline itself, not the first epoch's value.
+            self.best = float(self.baseline)
+        improved = (
+            self.best is None
+            or (value < self.best - self.min_delta if minimize
+                else value > self.best + self.min_delta)
+        )
+        if improved:
+            self.best, self.best_epoch, self.wait = value, epoch, 0
+            if self.restore_best_weights:
+                self.best_params = jax.tree_util.tree_map(
+                    jnp.copy, model.params
+                )
+        else:
+            self.wait += 1
+        # keras parity: patience=N stops after N consecutive
+        # non-improving epochs (patience=0 → the first one).
+        if self.wait >= max(1, self.patience):
+            model.stop_training = True
+            if self.restore_best_weights and self.best_params is not None:
+                model.params = self.best_params
+                model.opt_state = None  # moments belong to later epochs
+
+
 def _batch_data(x: np.ndarray, y: np.ndarray, batch_size: int, rng):
     """Shuffle + pad to a whole number of batches; returns (xb, yb, mask)
     with shapes (n_batches, bs, ...).  Padding rows carry mask 0 so metrics
@@ -515,6 +610,7 @@ class NeuralEstimator(Estimator):
         )
         self.params = None
         self.opt_state = None
+        self.stop_training = False  # callbacks may set True mid-fit
         self.history = TrainHistory()
         self._step_fn = None
         self._eval_fn = None
@@ -676,6 +772,10 @@ class NeuralEstimator(Estimator):
         self._invalidate_jit()
         if self.params is None:
             return
+        if old_state is None:
+            # No live moments to carry over (e.g. a restore-best early
+            # stop dropped them); fit re-inits for the new optimizer.
+            return
         if accumulate_steps == 1:
             # Unwrap: the inner state IS the plain optimizer's state.
             self.opt_state = old_state.inner_opt_state if was_wrapped \
@@ -717,6 +817,7 @@ class NeuralEstimator(Estimator):
         accumulate_steps: int = 1,
         quantize_checkpoint: bool = False,
         checkpoint_async: bool = True,
+        early_stopping: dict | EarlyStopping | None = None,
         **_,
     ) -> "NeuralEstimator":
         """keras-fit surface plus managed in-loop checkpointing: with
@@ -746,8 +847,23 @@ class NeuralEstimator(Estimator):
         ``quantize_checkpoint=True`` marks the estimator so its SAVED
         artifact stores parameters int8 (ops/quant.py) with optimizer
         state dropped — a ~4-7x smaller serving binary; the live
-        in-memory model keeps full precision."""
+        in-memory model keeps full precision.
+
+        ``early_stopping`` (an :class:`EarlyStopping` or its REST-JSON
+        dict spec, e.g. ``{"monitor": "val_loss", "patience": 3,
+        "restoreBestWeights": true}``) stops the loop once the
+        monitored metric stalls; any callback may likewise set
+        ``model.stop_training = True``."""
         self._quantize_persist = bool(quantize_checkpoint)
+        self.stop_training = False
+        if early_stopping is not None:
+            callbacks = list(callbacks or [])
+            callbacks.append(EarlyStopping.from_spec(early_stopping))
+        for cb in callbacks or []:
+            # Train-begin reset: a reused EarlyStopping must not carry
+            # wait/best (or restore a previous fit's snapshot) forward.
+            if isinstance(cb, EarlyStopping):
+                cb.reset()
         if _is_sharded(x) or _is_sharded(y):
             return self._fit_streaming(
                 x, y, epochs=epochs, batch_size=batch_size,
@@ -882,13 +998,22 @@ class NeuralEstimator(Estimator):
                 for cb in callbacks or []:
                     if callable(cb):
                         cb(epoch_i, metrics, self)
+                if self.stop_training:
+                    # A callback (e.g. EarlyStopping) may have replaced
+                    # self.params with a restored snapshot — the loop's
+                    # own re-anchor above already covered the normal
+                    # path, so just stop; do NOT re-assign below.
+                    if verbose:
+                        _train_logger().info(
+                            "early stop after epoch %d", epoch_i + 1
+                        )
+                    break
         finally:
             if checkpoint_dir:
                 # The last async save must be durable when fit returns
                 # (and an exception mid-loop must not strand a pending
                 # write unpublished for a later fit in this process).
                 ckpt_mod.finalize_async(checkpoint_dir)
-        self.params, self.opt_state = params, opt_state
         return self
 
     def _fit_streaming(
@@ -1071,12 +1196,20 @@ class NeuralEstimator(Estimator):
                     for cb in callbacks or []:
                         if callable(cb):
                             cb(epoch_i, metrics, self)
+                    if self.stop_training:
+                        # Per-shard re-anchor above already synced
+                        # self.params; a callback may have replaced it
+                        # (restore-best), so don't re-assign below.
+                        if verbose:
+                            _train_logger().info(
+                                "early stop after epoch %d", epoch_i + 1
+                            )
+                        break
         finally:
             if checkpoint_dir:
                 # Same durability contract as the in-memory
                 # loop, incl. the exception path.
                 ckpt_mod.finalize_async(checkpoint_dir)
-        self.params, self.opt_state = params, opt_state
         return self
 
     def _evaluate_arrays(self, params, x, y, batch_size, loss_kind):

@@ -817,3 +817,97 @@ class TestOptimizerAndScheduleSpecs:
         est.compile(optimizer=optax.sgd(0.1))
         with pytest.raises(ValueError, match="baked in"):
             est.compile(learning_rate=0.01)
+
+
+class TestEarlyStopping:
+    def _data(self, n=64):
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((n, 4)).astype(np.float32)
+        y = (x.sum(1) > 0).astype(np.int32)
+        return x, y
+
+    def test_stops_on_plateau_and_restores_best(self):
+        from learningorchestra_tpu.models.mlp import MLPClassifier
+        from learningorchestra_tpu.train.neural import EarlyStopping
+
+        x, y = self._data()
+        est = MLPClassifier(hidden_layer_sizes=[8], num_classes=2,
+                            learning_rate=0.0)  # lr 0: loss can't improve
+        es = EarlyStopping(monitor="loss", patience=1,
+                           restore_best_weights=True)
+        est.fit(x, y, epochs=50, batch_size=16, callbacks=[es])
+        # epoch 0 sets best; epochs 1..2 don't improve -> stop early.
+        assert len(est.history["loss"]) < 50
+        assert est.stop_training
+        assert es.best_epoch == 0
+        # Restored params are the best snapshot; moments were dropped.
+        assert est.opt_state is None
+        # A later fit re-inits optimizer state and still works — even
+        # when it changes the accumulation wrapping (None opt_state must
+        # not crash _set_accumulation's moment-carrying surgery).
+        est.fit(x, y, epochs=1, batch_size=16, accumulate_steps=2)
+        assert np.isfinite(est.history["loss"][-1])
+        est.compile(learning_rate=0.05)
+        est.fit(x, y, epochs=2, batch_size=16)
+        assert np.isfinite(est.history["loss"][-1])
+
+    def test_rest_json_spec_and_val_monitor(self):
+        from learningorchestra_tpu.models.mlp import MLPClassifier
+
+        x, y = self._data()
+        # lr 0 freezes val_loss, so the stop point is deterministic:
+        # epoch 0 sets best, epochs 1-2 don't improve -> exactly 3.
+        est = MLPClassifier(hidden_layer_sizes=[8], num_classes=2,
+                            learning_rate=0.0)
+        est.fit(
+            x, y, epochs=30, batch_size=16, validation_split=0.25,
+            early_stopping={"monitor": "val_loss", "patience": 2,
+                             "minDelta": 0.0},
+        )
+        assert "val_loss" in est.history
+        assert len(est.history["loss"]) == 3
+        # stop_training resets on a fresh fit (no early_stopping now).
+        est.fit(x, y, epochs=2, batch_size=16)
+        assert not est.stop_training
+        assert len(est.history["loss"]) == 3 + 2
+
+    def test_reused_instance_resets(self):
+        from learningorchestra_tpu.models.mlp import MLPClassifier
+        from learningorchestra_tpu.train.neural import EarlyStopping
+
+        x, y = self._data()
+        es = EarlyStopping(monitor="loss", patience=1,
+                           restore_best_weights=True)
+        est = MLPClassifier(hidden_layer_sizes=[8], num_classes=2,
+                            learning_rate=0.0)
+        est.fit(x, y, epochs=10, batch_size=16, callbacks=[es])
+        assert est.stop_training and es.wait >= 1
+        # Second fit with the SAME instance starts from a clean slate —
+        # it must run (not instantly stop with the stale snapshot).
+        est2 = MLPClassifier(hidden_layer_sizes=[8], num_classes=2,
+                             learning_rate=0.0)
+        est2.fit(x, y, epochs=10, batch_size=16, callbacks=[es])
+        assert es.best_epoch == 0 and len(est2.history["loss"]) >= 2
+
+    def test_streaming_fit_early_stops(self, tmp_path):
+        from learningorchestra_tpu.models.mlp import MLPClassifier
+        from learningorchestra_tpu.store.sharded import (
+            ShardedDataset,
+            ShardedDatasetWriter,
+        )
+
+        x, y = self._data(96)
+        w = ShardedDatasetWriter(
+            tmp_path / "ds", [f"f{i}" for i in range(4)] + ["label"],
+            rows_per_shard=32,
+        )
+        for i in range(96):
+            w.append(list(x[i]) + [int(y[i])])
+        w.close()
+        ds = ShardedDataset(tmp_path / "ds")
+        est = MLPClassifier(hidden_layer_sizes=[8], num_classes=2,
+                            learning_rate=0.0)
+        est.fit(ds.feature_view(["label"]), ds.view("label"),
+                epochs=50, batch_size=32,
+                early_stopping={"monitor": "loss", "patience": 1})
+        assert len(est.history["loss"]) < 50
