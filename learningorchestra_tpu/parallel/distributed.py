@@ -233,6 +233,8 @@ class DistributedTrainer:
         resume: bool = True,
         accumulate_steps: int = 1,
         checkpoint_async: bool = True,
+        callbacks: list | None = None,
+        early_stopping=None,
         **_,
     ) -> "DistributedTrainer":
         """Same managed in-loop checkpointing contract as the
@@ -243,9 +245,24 @@ class DistributedTrainer:
         ``accumulate_steps`` mirrors the single-device knob (gradient
         accumulation via optax.MultiSteps).  Set EXPLICITLY per fit: a
         prior single-device fit's accumulation never leaks in — the
-        default resets to plain stepping."""
+        default resets to plain stepping.
+
+        ``callbacks``/``early_stopping`` mirror the single-device
+        surface: callbacks run per epoch as ``cb(epoch, metrics,
+        trainer)`` and may set ``trainer.stop_training = True``;
+        ``early_stopping`` takes the same REST-JSON spec, minus
+        ``restoreBestWeights`` (a sharded-state snapshot/rollback isn't
+        wired yet — requesting it raises rather than silently training
+        on)."""
         from learningorchestra_tpu.train.neural import _is_sharded
 
+        from learningorchestra_tpu.train.neural import (
+            build_stop_callbacks,
+        )
+
+        callbacks = build_stop_callbacks(
+            self, callbacks, early_stopping, allow_restore=False
+        )
         if _is_sharded(x) or _is_sharded(y):
             return self._fit_streaming(
                 x, y, epochs=epochs, batch_size=batch_size,
@@ -254,7 +271,7 @@ class DistributedTrainer:
                 checkpoint_every=checkpoint_every,
                 checkpoint_min_interval_s=checkpoint_min_interval_s,
                 resume=resume, accumulate_steps=accumulate_steps,
-                checkpoint_async=checkpoint_async,
+                checkpoint_async=checkpoint_async, callbacks=callbacks,
             )
         est = self.estimator
         # Explicit (re)configuration each fit: no silent inheritance of
@@ -317,7 +334,9 @@ class DistributedTrainer:
                 ms = self._put_global(mb, self._data_sharding(mb.ndim, False))
                 root_key = jax.random.PRNGKey(est.seed)
                 last_save = time.monotonic()
+                ran = 0  # epochs executed THIS call (early stop may cut short)
                 for epoch_i in range(start_epoch, epochs):
+                    ran += 1
                     t0 = time.perf_counter()
                     params, opt_state, metrics = self._epoch_fn(
                         params, opt_state, xs, ys, ms,
@@ -344,19 +363,21 @@ class DistributedTrainer:
                             }
                         )
                     self.history.append(metrics)
-                    final = epoch_i + 1 == epochs
-                    if checkpoint_dir and checkpoint_every > 0 and (
-                        final
-                        or (
-                            (epoch_i + 1) % checkpoint_every == 0
-                            and time.monotonic() - last_save
-                            >= checkpoint_min_interval_s
-                        )
-                    ):
-                        from learningorchestra_tpu.train import (
-                            checkpoint as ckpt,
-                        )
+                    # Callbacks run before the checkpoint decision so an
+                    # early stop still gets its "final epoch" save —
+                    # through the ONE shared policy (should_save).
+                    for cb in callbacks or []:
+                        if callable(cb):
+                            cb(epoch_i, metrics, self)
+                    from learningorchestra_tpu.train import (
+                        checkpoint as ckpt,
+                    )
 
+                    if checkpoint_dir and ckpt.should_save(
+                        epoch_i, epochs, checkpoint_every,
+                        checkpoint_min_interval_s, last_save,
+                        stopped=self.stop_training,
+                    ):
                         ckpt.save(
                             checkpoint_dir, epoch_i + 1,
                             {"params": params, "opt_state": opt_state},
@@ -370,6 +391,8 @@ class DistributedTrainer:
                         get_logger("train").info(
                             "epoch %d/%d: %s", epoch_i + 1, epochs, metrics
                         )
+                    if self.stop_training:
+                        break
 
         finally:
             if checkpoint_dir:
@@ -402,7 +425,6 @@ class DistributedTrainer:
         else:
             est.params = jax.device_get(params)
             est.opt_state = jax.device_get(opt_state)
-        ran = epochs - start_epoch  # epochs executed THIS call
         n_epochs = len(self.history.get("loss", ()))
         for i in range(n_epochs - ran, n_epochs):
             est.history.append(
@@ -414,7 +436,7 @@ class DistributedTrainer:
         self, x, y, *, epochs, batch_size, validation_data, shuffle,
         verbose, checkpoint_dir, checkpoint_every,
         checkpoint_min_interval_s, resume, accumulate_steps,
-        checkpoint_async: bool = True,
+        checkpoint_async: bool = True, callbacks: list | None = None,
     ) -> "DistributedTrainer":
         """Shard-streaming distributed fit over a beyond-RAM dataset.
 
@@ -497,10 +519,12 @@ class DistributedTrainer:
 
                 root_key = jax.random.PRNGKey(est.seed)
                 last_save = time.monotonic()
+                ran = 0  # epochs executed THIS call
                 with concurrent.futures.ThreadPoolExecutor(
                     max_workers=1, thread_name_prefix="shard-io"
                 ) as io:
                     for epoch_i in range(start_epoch, epochs):
+                        ran += 1
                         t0 = time.perf_counter()
                         # Same shard order on every process.
                         order = (
@@ -556,9 +580,20 @@ class DistributedTrainer:
                             checkpoint as ckpt,
                         )
 
+                        if verbose:
+                            from learningorchestra_tpu.log import get_logger
+
+                            get_logger("train").info(
+                                "epoch %d/%d: %s", epoch_i + 1, epochs,
+                                metrics,
+                            )
+                        for cb in callbacks or []:
+                            if callable(cb):
+                                cb(epoch_i, metrics, self)
                         if checkpoint_dir and ckpt.should_save(
                             epoch_i, epochs, checkpoint_every,
                             checkpoint_min_interval_s, last_save,
+                            stopped=self.stop_training,
                         ):
                             ckpt.save(
                                 checkpoint_dir, epoch_i + 1,
@@ -567,13 +602,8 @@ class DistributedTrainer:
                                 async_save=checkpoint_async,
                             )
                             last_save = time.monotonic()
-                        if verbose:
-                            from learningorchestra_tpu.log import get_logger
-
-                            get_logger("train").info(
-                                "epoch %d/%d: %s", epoch_i + 1, epochs,
-                                metrics,
-                            )
+                        if self.stop_training:
+                            break
 
         finally:
             if checkpoint_dir:
@@ -597,7 +627,6 @@ class DistributedTrainer:
         else:
             est.params = jax.device_get(params)
             est.opt_state = jax.device_get(opt_state)
-        ran = epochs - start_epoch
         n_epochs = len(self.history.get("loss", ()))
         for i in range(n_epochs - ran, n_epochs):
             est.history.append(

@@ -671,7 +671,8 @@ class PipelinedTransformer:
             checkpoint_dir: str | None = None,
             checkpoint_every: int = 1,
             checkpoint_min_interval_s: float = 60.0,
-            resume: bool = True, checkpoint_async: bool = True, **_):
+            resume: bool = True, checkpoint_async: bool = True,
+            callbacks: list | None = None, early_stopping=None, **_):
         """Same managed in-loop checkpointing contract as
         ``NeuralEstimator.fit``: with ``checkpoint_dir`` set the
         (stage-stacked) state persists every ``checkpoint_every``
@@ -683,8 +684,14 @@ class PipelinedTransformer:
         contract every fit surface carries, train/neural.py
         ``_fit_streaming``).
         """
-        from learningorchestra_tpu.train.neural import _is_sharded
+        from learningorchestra_tpu.train.neural import (
+            _is_sharded,
+            build_stop_callbacks,
+        )
 
+        callbacks = build_stop_callbacks(
+            self, callbacks, early_stopping, allow_restore=False
+        )
         if _is_sharded(x) or _is_sharded(y):
             return self._fit_streaming(
                 x, y, epochs=epochs, batch_size=batch_size,
@@ -693,6 +700,7 @@ class PipelinedTransformer:
                 checkpoint_every=checkpoint_every,
                 checkpoint_min_interval_s=checkpoint_min_interval_s,
                 resume=resume, checkpoint_async=checkpoint_async,
+                callbacks=callbacks,
             )
         x = np.asarray(x)
         y = np.asarray(y).astype(np.int32)
@@ -743,9 +751,13 @@ class PipelinedTransformer:
                 if verbose:
                     print(f"pipeline epoch: {self.history['loss'][-1]:.4f}",
                           flush=True)
+                for cb in callbacks or []:
+                    if callable(cb):
+                        cb(epoch_i, epoch_row, self)
                 if checkpoint_dir and ckpt_mod.should_save(
                     epoch_i, epochs, checkpoint_every,
                     checkpoint_min_interval_s, last_save,
+                    stopped=self.stop_training,
                 ):
                     ckpt_mod.save(
                         checkpoint_dir, epoch_i + 1,
@@ -755,6 +767,8 @@ class PipelinedTransformer:
                         async_save=checkpoint_async,
                     )
                     last_save = time.monotonic()
+                if self.stop_training:
+                    break
         finally:
             if checkpoint_dir:
                 # The last async save must be durable when fit
@@ -765,7 +779,7 @@ class PipelinedTransformer:
     def _fit_streaming(
         self, x, y, *, epochs, batch_size, shuffle, verbose,
         checkpoint_dir, checkpoint_every, checkpoint_min_interval_s,
-        resume, checkpoint_async,
+        resume, checkpoint_async, callbacks: list | None = None,
     ) -> "PipelinedTransformer":
         """Shard-streaming pipelined fit: the same microbatched step,
         fed shard by shard with IO-thread prefetch — token datasets
@@ -851,9 +865,13 @@ class PipelinedTransformer:
                             f"{self.history['loss'][-1]:.4f}",
                             flush=True,
                         )
+                    for cb in callbacks or []:
+                        if callable(cb):
+                            cb(epoch_i, epoch_row, self)
                     if checkpoint_dir and ckpt_mod.should_save(
                         epoch_i, epochs, checkpoint_every,
                         checkpoint_min_interval_s, last_save,
+                        stopped=self.stop_training,
                     ):
                         ckpt_mod.save(
                             checkpoint_dir, epoch_i + 1,
@@ -863,6 +881,8 @@ class PipelinedTransformer:
                             async_save=checkpoint_async,
                         )
                         last_save = time.monotonic()
+                    if self.stop_training:
+                        break
             finally:
                 if checkpoint_dir:
                     ckpt_mod.finalize_async(checkpoint_dir)

@@ -256,18 +256,22 @@ def resume_or_none(directory, template: dict):
 
 
 def should_save(epoch_i: int, epochs: int, every: int,
-                min_interval_s: float, last_save: float) -> bool:
+                min_interval_s: float, last_save: float,
+                *, stopped: bool = False) -> bool:
     """One save policy for every fit loop: periodic saves every
-    ``every`` epochs (``every <= 0`` disables checkpointing entirely)
-    throttled to one per ``min_interval_s`` (fast epochs on big models
-    must not stall the loop on full-state transfers); the FINAL epoch
-    always saves when checkpointing is enabled."""
+    ``every`` epochs (``every <= 0`` disables checkpointing entirely —
+    including the final/stop saves below) throttled to one per
+    ``min_interval_s`` (fast epochs on big models must not stall the
+    loop on full-state transfers); the FINAL epoch always saves when
+    checkpointing is enabled, and ``stopped=True`` (an early-stop
+    callback ended training) counts as final."""
     import time as _time
 
     if every <= 0:
         return False
     return (
         epoch_i + 1 == epochs
+        or stopped
         or (
             (epoch_i + 1) % every == 0
             and _time.monotonic() - last_save >= min_interval_s

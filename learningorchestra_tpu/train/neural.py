@@ -166,6 +166,31 @@ class TrainHistory(dict):
             self.setdefault(key, []).append(float(val))
 
 
+def build_stop_callbacks(owner, callbacks, early_stopping,
+                         *, allow_restore: bool = True) -> list:
+    """Shared fit-surface plumbing: normalize the callback list, fold
+    in an ``early_stopping`` spec, reset reused EarlyStopping
+    instances, and clear ``owner.stop_training``.  The distributed/
+    pipelined surfaces pass ``allow_restore=False`` — their state is
+    mesh-sharded and best-weights rollback isn't wired there."""
+    owner.stop_training = False
+    cbs = list(callbacks or [])
+    # False is the natural JSON off-toggle mirroring True — disabled,
+    # not a TypeError deep in from_spec.
+    if early_stopping is not None and early_stopping is not False:
+        cbs.append(EarlyStopping.from_spec(early_stopping))
+    for cb in cbs:
+        if isinstance(cb, EarlyStopping):
+            if cb.restore_best_weights and not allow_restore:
+                raise ValueError(
+                    "restoreBestWeights is not supported on this fit "
+                    "surface (sharded state); use the single-device "
+                    "fit, or drop the flag"
+                )
+            cb.reset()
+    return cbs
+
+
 class EarlyStopping:
     """Keras-parity early stopping, usable as a fit callback or (as a
     JSON dict via the REST train surface) the ``early_stopping`` fit
@@ -855,15 +880,7 @@ class NeuralEstimator(Estimator):
         monitored metric stalls; any callback may likewise set
         ``model.stop_training = True``."""
         self._quantize_persist = bool(quantize_checkpoint)
-        self.stop_training = False
-        if early_stopping is not None:
-            callbacks = list(callbacks or [])
-            callbacks.append(EarlyStopping.from_spec(early_stopping))
-        for cb in callbacks or []:
-            # Train-begin reset: a reused EarlyStopping must not carry
-            # wait/best (or restore a previous fit's snapshot) forward.
-            if isinstance(cb, EarlyStopping):
-                cb.reset()
+        callbacks = build_stop_callbacks(self, callbacks, early_stopping)
         if _is_sharded(x) or _is_sharded(y):
             return self._fit_streaming(
                 x, y, epochs=epochs, batch_size=batch_size,
@@ -978,26 +995,34 @@ class NeuralEstimator(Estimator):
                     )
                     metrics.update({f"val_{k}": v for k, v in vmetrics.items()})
                 self.history.append(metrics)
-                if checkpoint_dir and ckpt_mod.should_save(
-                    epoch_i, epochs, checkpoint_every,
-                    checkpoint_min_interval_s, last_save,
-                ):
-                    from learningorchestra_tpu.train import checkpoint as ckpt
-
-                    ckpt.save(
-                        checkpoint_dir, epoch_i + 1,
-                        {"params": params, "opt_state": opt_state},
-                        history=dict(self.history),
-                        async_save=checkpoint_async,
-                    )
-                    last_save = time.monotonic()
                 if verbose:
                     _train_logger().info(
                         "epoch %d/%d: %s", epoch_i + 1, epochs, metrics
                     )
+                # Callbacks run BEFORE the save decision so an early
+                # stop counts as the final epoch under the one shared
+                # policy (should_save stopped=...).
                 for cb in callbacks or []:
                     if callable(cb):
                         cb(epoch_i, metrics, self)
+                if checkpoint_dir and self.opt_state is not None \
+                        and ckpt_mod.should_save(
+                            epoch_i, epochs, checkpoint_every,
+                            checkpoint_min_interval_s, last_save,
+                            stopped=self.stop_training,
+                        ):
+                    # restore-best drops opt_state; those params
+                    # persist via the artifact path instead.
+                    from learningorchestra_tpu.train import checkpoint as ckpt
+
+                    ckpt.save(
+                        checkpoint_dir, epoch_i + 1,
+                        {"params": self.params,
+                         "opt_state": self.opt_state},
+                        history=dict(self.history),
+                        async_save=checkpoint_async,
+                    )
+                    last_save = time.monotonic()
                 if self.stop_training:
                     # A callback (e.g. EarlyStopping) may have replaced
                     # self.params with a restored snapshot — the loop's
@@ -1174,21 +1199,6 @@ class NeuralEstimator(Estimator):
                             {f"val_{k2}": v for k2, v in vmetrics.items()}
                         )
                     self.history.append(metrics)
-                    if checkpoint_dir and ckpt_mod.should_save(
-                        epoch_i, epochs, checkpoint_every,
-                        checkpoint_min_interval_s, last_save,
-                    ):
-                        from learningorchestra_tpu.train import (
-                            checkpoint as ckpt,
-                        )
-
-                        ckpt.save(
-                            checkpoint_dir, epoch_i + 1,
-                            {"params": params, "opt_state": opt_state},
-                            history=dict(self.history),
-                            async_save=checkpoint_async,
-                        )
-                        last_save = time.monotonic()
                     if verbose:
                         _train_logger().info(
                             "epoch %d/%d: %s", epoch_i + 1, epochs, metrics
@@ -1196,6 +1206,24 @@ class NeuralEstimator(Estimator):
                     for cb in callbacks or []:
                         if callable(cb):
                             cb(epoch_i, metrics, self)
+                    if checkpoint_dir and self.opt_state is not None \
+                            and ckpt_mod.should_save(
+                                epoch_i, epochs, checkpoint_every,
+                                checkpoint_min_interval_s, last_save,
+                                stopped=self.stop_training,
+                            ):
+                        from learningorchestra_tpu.train import (
+                            checkpoint as ckpt,
+                        )
+
+                        ckpt.save(
+                            checkpoint_dir, epoch_i + 1,
+                            {"params": self.params,
+                             "opt_state": self.opt_state},
+                            history=dict(self.history),
+                            async_save=checkpoint_async,
+                        )
+                        last_save = time.monotonic()
                     if self.stop_training:
                         # Per-shard re-anchor above already synced
                         # self.params; a callback may have replaced it

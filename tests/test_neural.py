@@ -889,6 +889,40 @@ class TestEarlyStopping:
         est2.fit(x, y, epochs=10, batch_size=16, callbacks=[es])
         assert es.best_epoch == 0 and len(est2.history["loss"]) >= 2
 
+    def test_early_stop_checkpoint_policy(self, tmp_path):
+        """The stop epoch counts as final under the ONE shared save
+        policy: it saves when checkpointing is enabled, and
+        checkpoint_every=0 disables ALL saves — stop included."""
+        import json
+
+        from learningorchestra_tpu.models.mlp import MLPClassifier
+
+        x, y = self._data()
+        ck = tmp_path / "ck"
+        est = MLPClassifier(hidden_layer_sizes=[8], num_classes=2,
+                            learning_rate=0.0)
+        est.fit(x, y, epochs=50, batch_size=16, checkpoint_dir=str(ck),
+                checkpoint_every=10, checkpoint_min_interval_s=0.0,
+                early_stopping={"monitor": "loss", "patience": 1})
+        ran = len(est.history["loss"])
+        assert ran == 2  # stopped long before epoch 10's periodic save
+        marker = json.loads((ck / "latest.json").read_text())
+        assert marker["step"] == ran  # the stop epoch saved
+
+        ck2 = tmp_path / "ck2"
+        est2 = MLPClassifier(hidden_layer_sizes=[8], num_classes=2,
+                             learning_rate=0.0)
+        est2.fit(x, y, epochs=50, batch_size=16,
+                 checkpoint_dir=str(ck2), checkpoint_every=0,
+                 early_stopping={"monitor": "loss", "patience": 1})
+        assert not (ck2 / "latest.json").exists()  # fully disabled
+
+        # early_stopping=False is the JSON off-toggle, not a crash.
+        est3 = MLPClassifier(hidden_layer_sizes=[8], num_classes=2,
+                             learning_rate=0.0)
+        est3.fit(x, y, epochs=3, batch_size=16, early_stopping=False)
+        assert len(est3.history["loss"]) == 3
+
     def test_streaming_fit_early_stops(self, tmp_path):
         from learningorchestra_tpu.models.mlp import MLPClassifier
         from learningorchestra_tpu.store.sharded import (

@@ -109,6 +109,35 @@ def test_distributed_fit_learns_and_matches_contract():
     assert "accuracy" in trainer.history
 
 
+def test_distributed_early_stopping():
+    """The distributed surface honors the same early_stopping spec as
+    the single-device fit; restore-best is refused loudly (sharded
+    state has no rollback wired)."""
+    import pytest as _pytest
+
+    from learningorchestra_tpu.models.mlp import MLPClassifier
+
+    x, y = _toy_problem()
+    est = MLPClassifier(
+        hidden_layer_sizes=(16,), num_classes=4, seed=1, learning_rate=0.0
+    )
+    trainer = DistributedTrainer(est, spec=MeshSpec(dp=8))
+    trainer.fit(
+        x, y, epochs=20, batch_size=64,
+        early_stopping={"monitor": "loss", "patience": 2},
+    )
+    # lr 0: epoch 0 best, epochs 1-2 don't improve -> exactly 3 run,
+    # and the stitched estimator history matches the actual count.
+    assert len(trainer.history["loss"]) == 3
+    assert len(est.history["loss"]) == 3
+    with _pytest.raises(ValueError, match="restoreBestWeights"):
+        trainer.fit(
+            x, y, epochs=2, batch_size=64,
+            early_stopping={"monitor": "loss", "patience": 1,
+                             "restoreBestWeights": True},
+        )
+
+
 def test_distributed_matches_single_device_loss_first_epoch():
     """Same seed, no shuffle → DP-sharded epoch ≈ single-device epoch."""
     from learningorchestra_tpu.models.mlp import MLPClassifier

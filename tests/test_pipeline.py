@@ -129,6 +129,15 @@ class TestPipelinedTransformer:
         est.fit(x, y, epochs=10, batch_size=16, shuffle=False, verbose=0)
         assert est.history["loss"][-1] < est.history["loss"][0]
 
+    def test_early_stopping(self):
+        est = _built_estimator(pp=2, dp=2, num_layers=2,
+                               learning_rate=0.0)
+        x, y = _toy(n=16)
+        est.fit(x, y, epochs=10, batch_size=16, verbose=0,
+                early_stopping={"monitor": "loss", "patience": 1})
+        # lr 0: epoch 0 best, epoch 1 doesn't improve -> exactly 2 ran.
+        assert len(est.history["loss"]) == 2
+
     def test_predict_and_evaluate(self):
         est = _built_estimator(pp=2, dp=2, num_layers=2)
         x, y = _toy(n=16)
