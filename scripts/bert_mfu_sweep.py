@@ -30,6 +30,10 @@ from learningorchestra_tpu.models.text import BertModel  # noqa: E402
 PEAK = _peak_flops("tpu")
 rng = np.random.default_rng(0)
 
+_p = jnp.asarray(rng.standard_normal((256, 256)), jnp.float32)
+assert float(jnp.sum(jax.jit(lambda a: a @ a)(_p))) != 0
+print("probe matmul ok; lowering HLO check next", flush=True)
+
 # One-time: prove the TRAIN path really lowers to the Pallas flash
 # kernel on chip (VERDICT r3 item 2's "not mha_reference" check) —
 # Mosaic kernels appear as tpu_custom_call in the HLO.

@@ -31,6 +31,10 @@ from learningorchestra_tpu.models.vision import ResNet50  # noqa: E402
 PEAK = _peak_flops("tpu")
 rng = np.random.default_rng(0)
 
+_p = jnp.asarray(rng.standard_normal((256, 256)), jnp.float32)
+assert float(jnp.sum(jax.jit(lambda a: a @ a)(_p))) != 0
+print("probe matmul ok; sweep next", flush=True)
+
 GRID = [(64, False), (128, False), (128, True), (256, True), (512, True)]
 
 results = []

@@ -32,6 +32,17 @@ print("device:", jax.devices()[0], flush=True)
 
 rng = np.random.default_rng(0)
 
+
+def step(name):
+    import time as _t
+    print(f"STEP {name} @ {_t.strftime('%H:%M:%S')}", flush=True)
+
+step("probe")
+_p = jnp.asarray(rng.standard_normal((256, 256)), jnp.float32)
+assert float(jnp.sum(jax.jit(lambda a: a @ a)(_p)) ** 0) == 1.0
+print("probe matmul ok", flush=True)
+
+step("streaming vs resident")
 # -- 1. streaming fit vs device-resident, same data ------------------------
 # Wide-MLP on flat features: the tabular surface sharded ingest feeds.
 from learningorchestra_tpu.models.mlp import MLPClassifier  # noqa: E402
@@ -76,6 +87,7 @@ print(json.dumps({
     "ok": streaming_sps >= 0.9 * resident_sps,
 }), flush=True)
 
+step("int8 kernels")
 # -- 2. int8 kernels for real (interpret=False) ----------------------------
 from learningorchestra_tpu.ops.quant import (  # noqa: E402
     dequantize_rowwise,
@@ -94,6 +106,7 @@ print(json.dumps({
     "ok": err <= bound + 1e-6,
 }), flush=True)
 
+step("quant artifact")
 # -- 3. quantized artifact round trip on chip ------------------------------
 import dill  # noqa: E402
 
@@ -114,6 +127,7 @@ print(json.dumps({
     "ok": agree > 0.97,
 }), flush=True)
 
+step("1f1b pp=1")
 # -- 4. 1F1B degenerate (pp=1) train step on chip --------------------------
 from learningorchestra_tpu.parallel.pipeline import (  # noqa: E402
     PipelinedTransformer,
@@ -132,6 +146,7 @@ print(json.dumps({
     "ok": bool(np.isfinite(pt.history["loss"][-1])),
 }), flush=True)
 
+step("kv decode")
 # -- 5. KV-cache decode throughput (tokens/sec) ----------------------------
 from learningorchestra_tpu.models.text import DecoderLM  # noqa: E402
 
