@@ -1,0 +1,76 @@
+"""Minimal on-chip evidence grab — the FIRST thing to run in a tunnel
+window.  The tunnel has been flapping in ~minute-long windows; the full
+validation chain needs 10+ minutes of it.  This script gets the round's
+two headline numbers (bf16 MNIST-CNN and BERT-base train throughput +
+MFU, the BENCH/BASELINE configs 2 and 4) in one short run so even a
+brief window banks the evidence that matters most.
+
+    PYTHONPATH=/root/.axon_site:/root/repo python scripts/tpu_quick_evidence.py
+
+Timing is the fused-epoch methodology (TPU_EVIDENCE.md): k vs 3k epochs
+as single dispatches, differenced, so tunnel round-trips cancel.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+assert jax.devices()[0].platform == "tpu", jax.devices()
+print("device:", jax.devices()[0], flush=True)
+
+
+def step(name):
+    print(f"STEP {name} @ {time.strftime('%H:%M:%S')}", flush=True)
+
+
+step("probe")
+rng = np.random.default_rng(0)
+_p = jnp.asarray(rng.standard_normal((256, 256)), jnp.float32)
+t0 = time.perf_counter()
+assert float(jnp.sum(jax.jit(lambda a: a @ a)(_p))) != 0
+print(f"probe matmul ok in {time.perf_counter()-t0:.2f}s", flush=True)
+
+from bench import (  # noqa: E402 — repo root on PYTHONPATH
+    _fused_throughput,
+    _model_flops_per_sample,
+    _peak_flops,
+)
+
+PEAK = _peak_flops("tpu")
+
+# -- MNIST-CNN, bf16, bs 1024 (the headline continuity metric) --------
+from learningorchestra_tpu.models.vision import MnistCNN  # noqa: E402
+
+step("mnist bf16 bs1024")
+x = rng.standard_normal((16384, 28, 28, 1)).astype(np.float32)
+y = rng.integers(0, 10, (16384,), dtype=np.int32)
+est = MnistCNN()
+est._init_params(jnp.asarray(x[:1]))
+thr = _fused_throughput(est, x, y, 1024, k=4)
+per = _model_flops_per_sample(est, jnp.asarray(x[:1]))
+print(json.dumps({
+    "model": "mnist_cnn_bf16", "batch": 1024,
+    "samples_per_sec": round(thr, 1),
+    "mfu": round(thr * per / PEAK, 4) if per else None,
+}), flush=True)
+
+# -- BERT-base seq128, bf16, bs 32 (config 4's shape) -----------------
+from learningorchestra_tpu.models.text import BertModel  # noqa: E402
+
+step("bert-base bf16 seq128 bs32")
+tok = rng.integers(0, 30522, (2048, 128), dtype=np.int32)
+lab = rng.integers(0, 2, (2048,), dtype=np.int32)
+bert = BertModel(max_len=128)
+bert._init_params(jnp.asarray(tok[:1]))
+thr = _fused_throughput(bert, tok, lab, 32, k=2)
+per = _model_flops_per_sample(bert, jnp.asarray(tok[:1]))
+print(json.dumps({
+    "model": "bert_base_bf16_seq128", "batch": 32,
+    "samples_per_sec": round(thr, 1),
+    "mfu": round(thr * per / PEAK, 4) if per else None,
+}), flush=True)
+
+print("QUICK EVIDENCE DONE", flush=True)

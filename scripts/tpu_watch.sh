@@ -18,7 +18,7 @@ LOGDIR=/tmp/tpu_chain
 mkdir -p "$LOGDIR"
 
 probe() {
-    timeout 120 python -u -c "
+    timeout 90 python -u -c "
 import jax, numpy as np, jax.numpy as jnp
 assert jax.devices()[0].platform == 'tpu'
 x = jax.device_put(np.ones((128, 128), np.float32))
@@ -27,7 +27,10 @@ print('PROBE_OK')
 " 2>/dev/null | grep -q PROBE_OK
 }
 
+# Quick-evidence first: the tunnel flickers in short windows, and the
+# two headline numbers must bank before the long validations start.
 STAGES=(
+  "scripts/tpu_quick_evidence.py:900"
   "scripts/tpu_validate_r2.py:2700"
   "scripts/tpu_validate_r3.py:2700"
   "scripts/bert_mfu_sweep.py:5400"
@@ -45,7 +48,7 @@ while true; do
         all_done=0
         if ! probe; then
             echo "$(date -u +%H:%M:%S) tunnel down (next: $name)" >> "$LOGDIR/watch.log"
-            sleep 180
+            sleep 120
             continue 2
         fi
         tmo="${s##*:}"
