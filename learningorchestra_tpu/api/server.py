@@ -494,6 +494,28 @@ class APIServer:
             ),
         )
 
+        def curves_create(m, body, query):
+            meta = self.explore.create_curves(
+                body.get("name"),
+                body.get("parentName"),
+                fields=body.get("fields"),
+            )
+            return self._created("explore/curves", meta)
+
+        # Specific before the generic /explore/{TOOL} routes — the
+        # dispatcher is first-match; GET image/metadata/list fall
+        # through to the shared TOOL handlers below.
+        add("POST", r"/explore/curves", curves_create)
+        add(
+            "PATCH", r"/explore/curves/" + NAME,
+            lambda m, b, q: (
+                200,
+                {"metadata": self.explore.update_curves(
+                    m.group("name"), fields=(b or {}).get("fields"),
+                )},
+            ),
+        )
+
         def explore_create(m, body, query):
             tool = m.group("tool")
             meta = self.explore.create_plot(

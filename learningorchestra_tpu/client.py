@@ -66,6 +66,7 @@ class Context:
         self.histogram = _Histogram(self)
         self.explore = _Explore(self, "tensorflow")
         self.explore_sklearn = _Explore(self, "scikitlearn")
+        self.explore_curves = _Curves(self)
         self.model = _Model(self, "tensorflow")
         self.tune = _Executor(self, "tune", "tensorflow")
         self.train = _Executor(self, "train", "tensorflow")
@@ -301,6 +302,26 @@ class _Histogram(_Service):
             {"histogramName": histogram_name, "datasetName": dataset_name,
              "fields": fields},
         )
+
+
+class _Curves(_Service):
+    """Training-curves PNG from a train artifact's history rows."""
+
+    service_path = "explore/curves"
+
+    def create(self, name: str, train_name: str,
+               fields: list[str] | None = None) -> dict:
+        return self.ctx.request(
+            "POST", "/explore/curves",
+            {"name": name, "parentName": train_name, "fields": fields},
+        )
+
+    def update(self, name: str) -> dict:
+        """PATCH re-run — re-reads the parent's current history."""
+        return self.ctx.request("PATCH", f"/explore/curves/{name}", {})
+
+    def image(self, name: str) -> bytes:
+        return self.ctx.request("GET", f"/explore/curves/{name}", raw=True)
 
 
 class _Explore(_Service):

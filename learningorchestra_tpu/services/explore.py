@@ -20,6 +20,7 @@ from learningorchestra_tpu.services.context import (
 from learningorchestra_tpu.toolkit import registry
 
 HISTOGRAM_TYPE = "explore/histogram"
+CURVES_TYPE = "explore/curves"
 
 
 class ExploreService:
@@ -61,6 +62,132 @@ class ExploreService:
             on_success=lambda r: r,
         )
         return meta
+
+    # -- training curves ------------------------------------------------------
+
+    def create_curves(
+        self, name: str, parent_name: str,
+        fields: list[str] | None = None,
+    ) -> dict:
+        """Render a train artifact's per-epoch history (the durable
+        ``docType=history`` rows every fit surface stores) as a curves
+        PNG — loss-family on the left axis, score-family on the right.
+        The reference offers no training visualization beyond raw
+        TensorBoard; this serves the keras-history contract as an
+        explore artifact behind the same GET-the-PNG route."""
+        self.ctx.require_finished_parent(parent_name)
+        self.ctx.require_new_name(name)
+        meta = self.ctx.artifacts.metadata.create(
+            name, CURVES_TYPE, parent_name=parent_name,
+            extra={"fields": fields},
+        )
+        self._submit_curves(name, parent_name, fields)
+        return meta
+
+    def update_curves(self, name: str,
+                      fields: list[str] | None = None) -> dict:
+        """PATCH re-run: re-reads the parent's CURRENT history rows —
+        the natural refresh after more training epochs land.  A new
+        ``fields`` selection replaces the stored one (same PATCH
+        semantics as ``update_plot``); omitted, the original sticks."""
+        meta = self.ctx.require_not_running(name)
+        if meta.get("type") != CURVES_TYPE:
+            raise ValidationError(f"{name!r} is not a curves explore")
+        self.ctx.require_finished_parent(meta.get("parentName"))
+        if fields is None:
+            fields = meta.get("fields")
+        else:
+            self.ctx.artifacts.metadata.update(name, {"fields": fields})
+        self.ctx.artifacts.metadata.restart(name)
+        self._submit_curves(name, meta["parentName"], fields)
+        return self.ctx.artifacts.metadata.read(name)
+
+    def _submit_curves(self, name, parent_name, fields) -> None:
+        def run():
+            rows = self.ctx.documents.find(
+                parent_name, query={"docType": "history"}
+            )
+            if not rows:
+                raise ValueError(
+                    f"{parent_name!r} has no history rows — train it "
+                    "first (or it is not a train artifact)"
+                )
+            rows.sort(key=lambda r: r.get("epoch", 0))
+            series: dict[str, list] = {}
+            for row in rows:
+                for key, val in row.items():
+                    if key in ("_id", "docType", "epoch"):
+                        continue
+                    if isinstance(val, (int, float)):
+                        series.setdefault(key, []).append(float(val))
+            if fields:
+                missing = [f for f in fields if f not in series]
+                if missing:
+                    raise ValueError(
+                        f"metrics not in history: {missing}; "
+                        f"available: {sorted(series)}"
+                    )
+                series = {k: series[k] for k in fields}
+            else:
+                # Default view: drop throughput/timing bookkeeping.
+                series = {
+                    k: v for k, v in series.items()
+                    if k not in ("epoch_time", "samples_per_sec")
+                } or series
+            png_path = self._render_curves(name, series)
+            return {
+                "image": str(png_path),
+                "epochs": max(len(v) for v in series.values()),
+                "metrics": sorted(series),
+            }
+
+        self.ctx.engine.submit(
+            name, run,
+            description=f"training curves of {parent_name}",
+            on_success=lambda r: r,
+        )
+
+    def _save_png(self, fig, name: str, artifact_type: str):
+        """Shared PNG commit for every explore renderer: one place for
+        the path layout and savefig knobs."""
+        import matplotlib.pyplot as plt
+
+        path = self.ctx.volumes.path_for(artifact_type, name)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        fig.savefig(path, format="png", bbox_inches="tight")
+        plt.close(fig)
+        return path
+
+    def _render_curves(self, name, series: dict):
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots(figsize=(8, 6), dpi=120)
+        loss_like = {
+            k: v for k, v in series.items()
+            if "loss" in k or "perplexity" in k
+        }
+        score_like = {k: v for k, v in series.items() if k not in loss_like}
+        for key, vals in sorted(loss_like.items()):
+            ax.plot(range(1, len(vals) + 1), vals, marker="o",
+                    markersize=3, label=key)
+        ax.set_xlabel("epoch")
+        ax.set_ylabel("loss")
+        handles, labels = ax.get_legend_handles_labels()
+        if score_like:
+            ax2 = ax.twinx()
+            for key, vals in sorted(score_like.items()):
+                ax2.plot(range(1, len(vals) + 1), vals, marker="s",
+                         markersize=3, linestyle="--", label=key)
+            ax2.set_ylabel("score")
+            h2, l2 = ax2.get_legend_handles_labels()
+            handles, labels = handles + h2, labels + l2
+        if handles:
+            ax.legend(handles, labels, loc="best", fontsize=8)
+        ax.set_title(name)
+        return self._save_png(fig, name, CURVES_TYPE)
 
     # -- plot-producing execution --------------------------------------------
 
@@ -180,11 +307,7 @@ class ExploreService:
         if colors is not None:
             fig.colorbar(sc, ax=ax)
         ax.set_title(name)
-        path = self.ctx.volumes.path_for(artifact_type, name)
-        path.parent.mkdir(parents=True, exist_ok=True)
-        fig.savefig(path, format="png", bbox_inches="tight")
-        plt.close(fig)
-        return path
+        return self._save_png(fig, name, artifact_type)
 
     def read_image(self, name: str) -> bytes:
         """GET the rendered PNG (reference streams it with send_file,

@@ -347,6 +347,80 @@ def test_explore_plot_png(api):
     assert img.content[:8] == b"\x89PNG\r\n\x1a\n"
 
 
+def test_explore_training_curves(api):
+    """POST /explore/curves renders a train artifact's history rows as
+    a PNG; PATCH re-renders after more training lands."""
+    base, _ = api
+    resp = requests.post(
+        f"{base}/model/tensorflow",
+        json={
+            "name": "curves_mlp",
+            "modulePath": "learningorchestra_tpu.models.mlp",
+            "class": "MLPClassifier",
+            "classParameters": {"hidden_layer_sizes": [8],
+                                 "num_classes": 2},
+        },
+    )
+    assert resp.status_code == 201, resp.text
+    poll(base, "/model/tensorflow/curves_mlp")
+    resp = requests.post(
+        f"{base}/train/tensorflow",
+        json={
+            "name": "curves_fit",
+            "parentName": "curves_mlp",
+            "modelName": "curves_mlp",
+            "method": "fit",
+            "methodParameters": {
+                "x": "$mini_X", "y": "$mini.label",
+                "epochs": 3, "batch_size": 32,
+            },
+        },
+    )
+    assert resp.status_code == 201, resp.text
+    poll(base, "/train/tensorflow/curves_fit")
+
+    resp = requests.post(
+        f"{base}/explore/curves",
+        json={"name": "fit_curves", "parentName": "curves_fit"},
+    )
+    assert resp.status_code == 201, resp.text
+    meta = poll(base, "/explore/curves/fit_curves/metadata")
+    assert meta["epochs"] == 3
+    assert "loss" in meta["metrics"]
+    img = requests.get(f"{base}/explore/curves/fit_curves")
+    assert img.status_code == 200
+    assert img.content[:8] == b"\x89PNG\r\n\x1a\n"
+
+    # Unknown metric -> failed job with a clear message.
+    resp = requests.post(
+        f"{base}/explore/curves",
+        json={"name": "bad_curves", "parentName": "curves_fit",
+              "fields": ["nope"]},
+    )
+    assert resp.status_code == 201
+    with pytest.raises(AssertionError, match="not in history"):
+        poll(base, "/explore/curves/bad_curves/metadata")
+
+    # PATCH re-run refreshes from the parent's current history; a new
+    # fields selection replaces the stored one (update_plot parity).
+    resp = requests.patch(
+        f"{base}/explore/curves/fit_curves", json={"fields": ["loss"]}
+    )
+    assert resp.status_code == 200, resp.text
+    meta = poll(base, "/explore/curves/fit_curves/metadata")
+    assert meta["epochs"] == 3
+    assert meta["metrics"] == ["loss"]
+
+    # Parent without history rows -> clear failure, not a crash.
+    resp = requests.post(
+        f"{base}/explore/curves",
+        json={"name": "nohist_curves", "parentName": "mini"},
+    )
+    assert resp.status_code == 201
+    with pytest.raises(AssertionError, match="no history rows"):
+        poll(base, "/explore/curves/nohist_curves/metadata")
+
+
 def test_observe_blocks_until_finished(api):
     base, _ = api
     resp = requests.post(
