@@ -840,6 +840,42 @@ class APIServer:
                 _time.sleep(0.1)
             return 200, {"metadata": self.ctx.artifacts.metadata.read(name)}
 
+        # ---- Observe event feed + wildcard webhooks (before the NAME
+        # routes: "events"/"webhook" would otherwise match as artifact
+        # names; the dispatcher is first-match) ----
+        def observe_events(m, body, query):
+            try:
+                since = int(query.get("sinceId", -1))
+                limit = int(query.get("limit", 100))
+            except (TypeError, ValueError):
+                raise BadRequest("sinceId/limit must be integers")
+            return 200, {"result": self.ctx.webhooks.events(since, limit)}
+
+        add("GET", r"/observe/events", observe_events)
+
+        def webhook_register_all(m, body, query):
+            try:
+                hook = self.ctx.webhooks.register(
+                    "*", body.get("url"), body.get("events")
+                )
+            except ValueError as exc:
+                raise ValidationError(str(exc)) from None
+            return 201, {"result": hook}
+
+        add("POST", r"/observe/webhook", webhook_register_all)
+        add(
+            "GET", r"/observe/webhook",
+            lambda m, b, q: (200, {"result": self.ctx.webhooks.list("*")}),
+        )
+        add(
+            "DELETE", r"/observe/webhook/(?P<hook>[0-9]+)",
+            lambda m, b, q: (
+                (200, {"result": "deleted"})
+                if self.ctx.webhooks.unregister("*", int(m.group("hook")))
+                else (404, {"error": "no such webhook"})
+            ),
+        )
+
         # Deliberate long-poll: exempt from the gateway deadline.
         add("GET", r"/observe/" + NAME, observe_wait, no_timeout=True)
 
@@ -870,7 +906,10 @@ class APIServer:
             elif meta.get("finished"):
                 event = "finished"
             if event is not None and event in hook["events"]:
-                self.ctx.webhooks.notify(name, event, meta)
+                # deliver_to, not notify: the transition already hit
+                # the event feed and wildcard hooks when it happened —
+                # only THIS late registration needs the catch-up POST.
+                self.ctx.webhooks.deliver_to(hook, name, event, meta)
                 hook = {**hook, "firedImmediately": event}
             return 201, {"result": hook}
 

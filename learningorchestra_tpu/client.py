@@ -590,3 +590,19 @@ class _Observe:
         self.ctx.request(
             "DELETE", f"/observe/{name}/webhook/{hook_id}"
         )
+
+    def webhook_all(self, url: str, events: list | None = None) -> dict:
+        """Wildcard registration: ``url`` fires for EVERY artifact's
+        finish/fail — the reference Observe's watch-anything shape."""
+        body: dict = {"url": url}
+        if events is not None:
+            body["events"] = list(events)
+        return self.ctx.request("POST", "/observe/webhook", body)["result"]
+
+    def events(self, since_id: int = -1, limit: int = 100) -> list:
+        """The global event feed, oldest-first; cursor on the last
+        row's ``_id``: ``events(since_id=rows[-1]["_id"])``."""
+        return self.ctx.request(
+            "GET", "/observe/events",
+            query={"sinceId": int(since_id), "limit": int(limit)},
+        )["result"]

@@ -105,6 +105,10 @@ class JobEngine:
             while True:
                 meta.mark_running(name)
                 logger.info(kv(job=name, state="running", method=method))
+                # Feed-only event (no webhook fires for "running" —
+                # registrations are finished/failed; the global event
+                # feed still records the transition).
+                self._notify(name, "running")
                 # Rebound by the capture context; the empty default
                 # keeps the except-path buf.getvalue() calls safe if
                 # capture setup itself ever raises.

@@ -118,6 +118,13 @@ class ServiceContext:
                 f"artifact name {name!r} uses the reserved "
                 "'.tokenizer' suffix"
             )
+        # Reserved: these segments are fixed observe sub-routes
+        # (GET /observe/events, POST /observe/webhook); an artifact so
+        # named would be silently shadowed off the observe long-poll.
+        if name in ("events", "webhook"):
+            raise ValidationError(
+                f"artifact name {name!r} is reserved (observe route)"
+            )
         if self.artifacts.metadata.exists(name):
             raise ConflictError(f"duplicate artifact name: {name!r}")
 

@@ -24,9 +24,13 @@ import time
 import urllib.request
 
 from learningorchestra_tpu.log import get_logger, kv
+from learningorchestra_tpu.store.document_store import NoSuchCollection
 
 COLLECTION = "observe_webhooks"
+EVENTS_COLLECTION = "observe_events"
 EVENTS = ("finished", "failed")
+WILDCARD = "*"  # register against every artifact
+EVENT_RETAIN = 10_000  # feed rows kept (pruned probabilistically)
 
 
 class WebhookNotifier:
@@ -41,6 +45,8 @@ class WebhookNotifier:
 
     def register(self, artifact: str, url: str,
                  events: list[str] | None = None) -> dict:
+        """``artifact="*"`` registers a WILDCARD hook fired for every
+        artifact — the reference Observe's watch-anything shape."""
         if not url or not url.startswith(("http://", "https://")):
             raise ValueError(
                 f"webhook url must be http(s), got {url!r}"
@@ -69,20 +75,42 @@ class WebhookNotifier:
         return self.documents.delete_one(COLLECTION, hook_id)
 
     def list(self, artifact: str) -> list[dict]:
-        return self.documents.find(
-            COLLECTION, query={"artifact": artifact}
-        )
+        try:
+            return self.documents.find(
+                COLLECTION, query={"artifact": artifact}
+            )
+        except NoSuchCollection:
+            return []  # nothing ever registered on this store
 
     # -- firing ---------------------------------------------------------------
+
+    def deliver_to(self, hook: dict, artifact: str, event: str,
+                   metadata: dict) -> None:
+        """Deliver one registration's POST without touching the event
+        feed or other hooks — the immediate-fire path for a webhook
+        registered on an ALREADY-terminal artifact (the transition was
+        recorded and wildcard-delivered when it actually happened)."""
+        payload = json.dumps({
+            "name": artifact,
+            "event": event,
+            "metadata": metadata,
+        }).encode()
+        threading.Thread(
+            target=self._deliver_all,
+            args=([hook], payload),
+            name="webhook-notify",
+            daemon=True,
+        ).start()
 
     def notify(self, artifact: str, event: str, metadata: dict) -> None:
         """Fire registered webhooks for (artifact, event) — returns
         immediately; delivery happens on a daemon thread so a slow or
         dead endpoint can never stall the job engine's completion
         path."""
+        self.record_event(artifact, event, metadata)
         try:
             hooks = [
-                h for h in self.list(artifact)
+                h for h in self.list(artifact) + self.list(WILDCARD)
                 if event in h.get("events", EVENTS)
             ]
         except Exception:  # noqa: BLE001 — notify must never raise
@@ -135,3 +163,46 @@ class WebhookNotifier:
                     # would only delay delivery to the next hook.
                     time.sleep(min(2 ** attempt, 5))
         return None, last_err
+
+    # -- event feed -----------------------------------------------------------
+
+    def record_event(self, artifact: str, event: str,
+                     metadata: dict) -> None:
+        """Append to the global event feed (collection
+        ``observe_events``) — the pull twin of the webhook push: one
+        ordered stream of every artifact state transition, cursorable
+        by ``_id`` (atomic per-collection ids are the sequence).
+        Never raises; the feed is bookkeeping, jobs must finish."""
+        try:
+            _id = self.documents.insert_one(EVENTS_COLLECTION, {
+                "artifact": artifact,
+                "event": event,
+                "artifactType": metadata.get("type"),
+                "ts": time.time(),
+            })
+            if _id % 256 == 0:
+                # Probabilistic pruning keeps the feed bounded without
+                # a scan per insert.
+                for old in self.documents.find(
+                    EVENTS_COLLECTION,
+                    query={"_id": {"$lt": _id - EVENT_RETAIN}},
+                ):
+                    self.documents.delete_one(
+                        EVENTS_COLLECTION, old["_id"]
+                    )
+        except Exception:  # noqa: BLE001
+            pass
+
+    def events(self, since_id: int = -1, limit: int = 100) -> list[dict]:
+        """Events with ``_id > since_id``, oldest first, at most
+        ``limit`` — poll with the last seen ``_id`` as the cursor.
+        The default (-1) returns from the beginning: feed ids start
+        at 0."""
+        try:
+            return self.documents.find(
+                EVENTS_COLLECTION,
+                query={"_id": {"$gt": int(since_id)}},
+                limit=max(1, min(int(limit), 1000)),
+            )
+        except NoSuchCollection:
+            return []  # no event ever recorded

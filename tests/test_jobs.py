@@ -368,3 +368,112 @@ def test_webhook_on_terminal_artifact_fires_immediately(tmp_path):
     finally:
         server.shutdown()
         httpd.shutdown()
+
+
+def test_event_feed_and_wildcard_webhook(tmp_path):
+    """The global event feed records every artifact state transition
+    (cursorable by _id), and a wildcard webhook fires for ANY
+    artifact's completion — the reference Observe's watch-anything
+    shape, pull and push twins."""
+    import http.server
+    import json as _json
+    import threading
+    import time
+
+    import requests
+
+    from learningorchestra_tpu.api.server import APIServer
+    from learningorchestra_tpu.config import Config
+
+    received = []
+    got_event = threading.Event()
+
+    class Receiver(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length") or 0)
+            received.append(_json.loads(self.rfile.read(length)))
+            got_event.set()
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Receiver)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    hook_url = f"http://127.0.0.1:{httpd.server_address[1]}/hook"
+
+    cfg = Config()
+    cfg.store.root = str(tmp_path / "store")
+    cfg.store.volume_root = str(tmp_path / "volumes")
+    server = APIServer(cfg)
+    port = server.start_background()
+    base = f"http://127.0.0.1:{port}/api/learningOrchestra/v1"
+    try:
+        # Wildcard hook BEFORE any artifact exists.
+        r = requests.post(f"{base}/observe/webhook",
+                          json={"url": hook_url})
+        assert r.status_code == 201, r.text
+        hook = r.json()["result"]
+        assert hook["artifact"] == "*"
+        assert requests.get(
+            f"{base}/observe/webhook"
+        ).json()["result"][0]["_id"] == hook["_id"]
+
+        r = requests.post(f"{base}/function/python",
+                          json={"name": "anyjob",
+                                "function": "response = 1"})
+        assert r.status_code == 201
+        assert got_event.wait(30), "wildcard webhook never fired"
+        assert received[0]["name"] == "anyjob"
+        assert received[0]["event"] == "finished"
+
+        # Event feed: running + finished recorded, ordered, cursorable.
+        deadline = time.time() + 10
+        rows = []
+        while time.time() < deadline:
+            rows = requests.get(
+                f"{base}/observe/events"
+            ).json()["result"]
+            if any(e["event"] == "finished" for e in rows):
+                break
+            time.sleep(0.1)
+        kinds = [(e["artifact"], e["event"]) for e in rows]
+        assert ("anyjob", "running") in kinds
+        assert ("anyjob", "finished") in kinds
+        ids = [e["_id"] for e in rows]
+        assert ids == sorted(ids)
+        # Cursor: only events after since_id come back.
+        later = requests.get(
+            f"{base}/observe/events",
+            params={"sinceId": ids[0]},
+        ).json()["result"]
+        assert all(e["_id"] > ids[0] for e in later)
+
+        # A failing job lands in the feed too.
+        requests.post(f"{base}/function/python",
+                      json={"name": "sadjob",
+                            "function": "raise ValueError('x')"})
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            rows = requests.get(
+                f"{base}/observe/events"
+            ).json()["result"]
+            if ("sadjob", "failed") in [
+                (e["artifact"], e["event"]) for e in rows
+            ]:
+                break
+            time.sleep(0.1)
+        assert ("sadjob", "failed") in [
+            (e["artifact"], e["event"]) for e in rows
+        ]
+
+        # Unregister the wildcard hook via its dedicated route.
+        r = requests.delete(f"{base}/observe/webhook/{hook['_id']}")
+        assert r.status_code == 200
+        assert requests.get(
+            f"{base}/observe/webhook"
+        ).json()["result"] == []
+    finally:
+        server.shutdown()
+        httpd.shutdown()
