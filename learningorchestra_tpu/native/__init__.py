@@ -100,6 +100,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.lods_compact.argtypes = [i64, c_char_p]
     lib.lods_csv_parse.argtypes = [c_char_p, i64, ctypes.c_int, p_i64]
     lib.lods_csv_parse.restype = buf_t
+    lib.lods_csv_numeric_chunk.argtypes = [
+        c_char_p, i64, ctypes.c_int, i64,
+        ctypes.POINTER(ctypes.c_double), i64, p_i64, p_i64,
+    ]
+    lib.lods_csv_numeric_chunk.restype = i64
     lib.lods_project.argtypes = [i64, c_char_p, c_char_p, c_char_p]
     lib.lods_project.restype = i64
     return lib
@@ -142,6 +147,43 @@ def _take(lib: ctypes.CDLL, ptr: int, length: int) -> bytes:
 def _dumps(doc: dict) -> bytes:
     d = {k: v for k, v in doc.items() if k != "_id"}
     return json.dumps(d, default=str).encode()
+
+
+def csv_numeric_chunk(data: bytes, ncols: int, *, is_final: bool,
+                      bad_counts, max_rows: int | None = None):
+    """Numeric CSV records → ((rows, ncols) float64 array, consumed).
+
+    Only complete newline-terminated records are consumed unless
+    ``is_final``; feed ``data[consumed:]`` + the next read back in.
+    ``bad_counts`` is a caller-owned int64 array of length ``ncols``
+    accumulating non-empty-unparseable cell counts across chunks (the
+    "column is not numeric" contract check happens at close)."""
+    import numpy as np
+
+    lib = load_library()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    if max_rows is None:
+        # A minimal record is ncols-1 commas + a newline = ncols bytes
+        # (all-empty cells), so bytes/ncols bounds the row count —
+        # far below a byte-per-row worst-case buffer.
+        max_rows = len(data) // max(1, ncols) + 2
+    out = np.empty((max_rows, ncols), np.float64)
+    consumed = ctypes.c_int64()
+    rows = lib.lods_csv_numeric_chunk(
+        data, len(data), 1 if is_final else 0, ncols,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        max_rows,
+        bad_counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.byref(consumed),
+    )
+    if rows < 0:
+        _raise_native(lib)
+    if rows < max_rows:
+        # A view would pin the whole worst-case allocation (~8x the
+        # chunk bytes) in the caller's block queue until shard flush.
+        return out[:rows].copy(), consumed.value
+    return out, consumed.value
 
 
 def csv_parse(data: bytes, infer_types: bool = True):

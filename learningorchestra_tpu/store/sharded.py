@@ -67,6 +67,8 @@ class ShardedDatasetWriter:
         self.fields = list(fields)
         self.rows_per_shard = rows_per_shard
         self._buf: list[list] = []
+        self._blocks: list[np.ndarray] = []
+        self._block_rows = 0
         self._shard_rows: list[int] = []
         self._dtypes: dict[str, np.dtype] = {}
         self._closed = False
@@ -79,9 +81,69 @@ class ShardedDatasetWriter:
                 f"row has {len(row)} values, header has "
                 f"{len(self.fields)} fields"
             )
+        if self._blocks:
+            raise RuntimeError("append after append_block: pick one")
         self._buf.append(row)
         if len(self._buf) >= self.rows_per_shard:
             self._flush()
+
+    def append_block(self, block) -> None:
+        """Bulk append a ``(n, n_fields)`` float64 array (the native
+        CSV parser's output) — no per-row Python objects.  Integral
+        columns narrow back to int32 at flush, mirroring what
+        ``append``'s ``np.asarray`` inference does for int rows.  Row
+        and block modes don't mix on one writer (ordering would
+        interleave wrongly)."""
+        if self._buf:
+            raise RuntimeError("append_block after append: pick one")
+        block = np.asarray(block, np.float64)
+        if block.ndim != 2 or block.shape[1] != len(self.fields):
+            raise ValueError(
+                f"block shape {block.shape} != (n, {len(self.fields)})"
+            )
+        self._blocks.append(block)
+        self._block_rows += len(block)
+        while self._block_rows >= self.rows_per_shard:
+            self._flush_block(self.rows_per_shard)
+
+    def _take_block_rows(self, n: int) -> np.ndarray:
+        """Pop exactly n rows off the block queue (concat-free when a
+        single block covers them)."""
+        out, need = [], n
+        while need > 0:
+            head = self._blocks[0]
+            if len(head) <= need:
+                out.append(head)
+                need -= len(head)
+                self._blocks.pop(0)
+            else:
+                out.append(head[:need])
+                self._blocks[0] = head[need:]
+                need = 0
+        self._block_rows -= n
+        return out[0] if len(out) == 1 else np.concatenate(out, axis=0)
+
+    def _flush_block(self, n: int) -> None:
+        if n <= 0:
+            return
+        rows = self._take_block_rows(n)
+        cols = {}
+        for i, field in enumerate(self.fields):
+            arr = rows[:, i]
+            # Mirror the row path's dtype inference: a column of
+            # integral finite values stores int32; anything else f32.
+            if np.all(np.isfinite(arr)) and np.all(
+                arr == np.floor(arr)
+            ) and np.all(np.abs(arr) < 2**31):
+                arr = arr.astype(np.int32)
+            else:
+                arr = arr.astype(np.float32)
+            cols[field] = arr
+            prev = self._dtypes.get(field)
+            self._dtypes[field] = arr.dtype if prev is None else np.dtype(
+                _narrow(np.promote_types(prev, arr.dtype))
+            )
+        self._publish_shard(cols, n)
 
     def _flush(self) -> None:
         if not self._buf:
@@ -111,6 +173,11 @@ class ShardedDatasetWriter:
                 self._dtypes[field] = np.dtype(
                     _narrow(np.promote_types(prev, arr.dtype))
                 )
+        n = len(self._buf)
+        self._buf = []
+        self._publish_shard(cols, n)
+
+    def _publish_shard(self, cols: dict, n: int) -> None:
         k = len(self._shard_rows)
         # Atomic publish: a crashed ingest must not leave a torn .npz a
         # later open() would try to read.
@@ -118,8 +185,7 @@ class ShardedDatasetWriter:
         with open(tmp, "wb") as fh:
             np.savez(fh, **cols)
         os.replace(tmp, self.root / _SHARD_FMT.format(k))
-        self._shard_rows.append(len(self._buf))
-        self._buf = []
+        self._shard_rows.append(n)
 
     def close(self) -> dict:
         """Flush the tail shard and publish the manifest (the artifact
@@ -127,6 +193,7 @@ class ShardedDatasetWriter:
         if self._closed:
             raise RuntimeError("writer already closed")
         self._flush()
+        self._flush_block(self._block_rows)
         self._closed = True
         manifest = {
             "fields": self.fields,

@@ -28,6 +28,7 @@
 #include <cstring>
 #include <dirent.h>
 #include <fcntl.h>
+#include <limits>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -505,10 +506,15 @@ void clean_header(std::vector<std::string> &header) {
 }
 
 // Parse one CSV record starting at *pos; returns false at EOF.
+// *clean_end (optional) reports whether the record terminated on an
+// UNQUOTED newline — chunked callers roll back records that merely ran
+// out of buffer (possibly inside a quoted field containing '\n').
 bool next_record(const char *s, size_t n, size_t *pos,
-                 std::vector<std::string> &fields) {
+                 std::vector<std::string> &fields,
+                 bool *clean_end = nullptr) {
   fields.clear();
   size_t i = *pos;
+  if (clean_end) *clean_end = false;
   if (i >= n) return false;
   std::string cur;
   bool in_quotes = false, any = false;
@@ -541,6 +547,7 @@ bool next_record(const char *s, size_t n, size_t *pos,
     } else if (c == '\n' || c == '\r') {
       if (c == '\r' && i + 1 < n && s[i + 1] == '\n') i++;
       i++;
+      if (clean_end) *clean_end = true;
       break;
     } else {
       cur += c;
@@ -1003,6 +1010,86 @@ int64_t lods_project(int64_t h, const char *src_name, const char *dst_name,
 // dataset service's default); infer=0 keeps every value a string (the
 // reference's raw behavior, database_api_image/database.py:124-137).
 // ---------------------------------------------------------------------------
+
+// Numeric chunk parse for SHARDED (beyond-RAM) ingest: complete CSV
+// records from buf land row-major in out (ncols doubles per row).
+// Empty/missing cells -> NaN; non-empty unparseable cells -> NaN AND
+// bad_counts[col]++ (the Python writer's "column is not numeric"
+// contract checks these); extra columns are ignored.  Unless is_final,
+// a trailing record not terminated by a newline is NOT consumed — the
+// caller re-feeds it with the next chunk (*consumed reports the bytes
+// eaten).  Returns rows parsed, or -1 (see lods_last_error).
+int64_t lods_csv_numeric_chunk(const char *buf, int64_t len, int is_final,
+                               int64_t ncols, double *out,
+                               int64_t max_rows, int64_t *bad_counts,
+                               int64_t *consumed) {
+  if (ncols <= 0 || max_rows < 0) {
+    set_error("bad ncols/max_rows");
+    return -1;
+  }
+  const double nan = std::numeric_limits<double>::quiet_NaN();
+  std::vector<std::string> row;
+  size_t pos = 0, n = (size_t)len;
+  int64_t rows = 0;
+  while (rows < max_rows) {
+    size_t start = pos;
+    bool clean_end = false;
+    if (!next_record(buf, n, &pos, row, &clean_end)) break;  // EOF
+    if (!clean_end && !is_final) {
+      // Record ran out of buffer without an UNQUOTED newline (maybe
+      // mid-cell, maybe inside a quoted field containing '\n'): roll
+      // back, wait for more bytes.
+      pos = start;
+      break;
+    }
+    if (row.empty() || (row.size() == 1 && row[0].empty()))
+      continue;  // blank line
+    double *dst = out + rows * ncols;
+    for (int64_t c = 0; c < ncols; c++) {
+      if ((size_t)c >= row.size()) {
+        dst[c] = nan;  // short row pads NaN (Python parity)
+        continue;
+      }
+      const std::string &cell = row[c];
+      size_t a = 0, b = cell.size();
+      while (a < b && (cell[a] == ' ' || cell[a] == '\t')) a++;
+      while (b > a && (cell[b - 1] == ' ' || cell[b - 1] == '\t')) b--;
+      if (a == b) {
+        dst[c] = nan;  // empty cell
+        continue;
+      }
+      // Mirror services/dataset.py::_infer exactly — the two ingest
+      // paths must agree on what "numeric" means: no '_'/hex
+      // spellings, and inf/nan RESULTS (incl. overflow) are
+      // non-numeric; subnormal underflow is a fine number.
+      std::string trimmed = cell.substr(a, b - a);
+      bool badcell = false;
+      for (char ch : trimmed) {
+        if (ch == '_' || ch == 'x' || ch == 'X') {
+          badcell = true;
+          break;
+        }
+      }
+      double v = nan;
+      if (!badcell) {
+        char *end = nullptr;
+        v = strtod(trimmed.c_str(), &end);
+        if (end == trimmed.c_str() || *end != '\0' || v != v ||
+            v > 1.7976931348623157e308 || v < -1.7976931348623157e308)
+          badcell = true;
+      }
+      if (badcell) {
+        dst[c] = nan;
+        if (bad_counts) bad_counts[c]++;
+      } else {
+        dst[c] = v;
+      }
+    }
+    rows++;
+  }
+  if (consumed) *consumed = (int64_t)pos;
+  return rows;
+}
 
 char *lods_csv_parse(const char *buf, int64_t len, int infer,
                      int64_t *out_len) {

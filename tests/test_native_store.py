@@ -297,3 +297,185 @@ class TestNativeProjection:
     def test_project_missing_source(self, store):
         with pytest.raises((NoSuchCollection, RuntimeError)):
             store.project("ghost", "dst2", ["a"])
+
+
+class TestNativeNumericChunkParser:
+    """lods_csv_numeric_chunk — the sharded-ingest hot path."""
+
+    def test_chunk_semantics_and_nan_contract(self):
+        import numpy as np
+
+        data = b"1,2.5,3\n4,,x\n7,8,9"
+        bad = np.zeros(3, np.int64)
+        block, consumed = native.csv_numeric_chunk(
+            data, 3, is_final=False, bad_counts=bad
+        )
+        # Partial trailing record ("7,8,9" without newline) held back.
+        assert consumed == len(b"1,2.5,3\n4,,x\n")
+        assert block.shape == (2, 3)
+        assert block[0].tolist() == [1, 2.5, 3]
+        assert block[1][0] == 4
+        assert np.isnan(block[1][1])  # empty cell -> NaN, not bad
+        assert np.isnan(block[1][2])  # unparseable -> NaN AND bad
+        assert bad.tolist() == [0, 0, 1]
+        block2, c2 = native.csv_numeric_chunk(
+            data[consumed:], 3, is_final=True, bad_counts=bad
+        )
+        assert block2.shape == (1, 3)
+        assert block2[0].tolist() == [7, 8, 9]
+
+    def test_chunk_boundary_inside_quoted_field(self):
+        """A chunk ending on a newline INSIDE a quoted field must roll
+        the record back (buf[-1]=='\\n' alone is not record-complete)."""
+        import numpy as np
+
+        bad = np.zeros(2, np.int64)
+        full = b'1,2\n3,"4\n'  # quoted cell containing the newline...
+        block, consumed = native.csv_numeric_chunk(
+            full, 2, is_final=False, bad_counts=bad
+        )
+        assert block.shape == (1, 2) and block[0].tolist() == [1, 2]
+        assert consumed == len(b"1,2\n")  # partial quoted record held
+        rest = full[consumed:] + b'5"\n'
+        block2, c2 = native.csv_numeric_chunk(
+            rest, 2, is_final=True, bad_counts=bad
+        )
+        # The quoted cell "4\n5" is non-numeric -> NaN + bad count,
+        # but the record boundary is right.
+        assert block2.shape == (1, 2) and block2[0][0] == 3
+        assert bad.tolist() == [0, 1]
+
+    def test_numeric_contract_matches_python_infer(self):
+        """inf/nan/hex/'_' spellings are non-numeric (same as _infer);
+        subnormal underflow is a fine number."""
+        import numpy as np
+
+        bad = np.zeros(5, np.int64)
+        data = b"inf,nan,0x10,1_0,1e-310\n"
+        block, consumed = native.csv_numeric_chunk(
+            data, 5, is_final=True, bad_counts=bad
+        )
+        assert consumed == len(data)
+        assert bad.tolist() == [1, 1, 1, 1, 0]
+        assert np.isnan(block[0][:4]).all()
+        assert block[0][4] == 1e-310
+
+    def test_quotes_short_rows_and_blanks(self):
+        import numpy as np
+
+        bad = np.zeros(4, np.int64)
+        data = b'"5","6.5",7,8\n\n1,2\n'
+        block, consumed = native.csv_numeric_chunk(
+            data, 4, is_final=True, bad_counts=bad
+        )
+        assert consumed == len(data)
+        assert block.shape == (2, 4)
+        assert block[0].tolist() == [5, 6.5, 7, 8]
+        assert block[1][0] == 1 and block[1][1] == 2
+        assert np.isnan(block[1][2]) and np.isnan(block[1][3])
+        assert bad.sum() == 0  # short rows pad NaN without flagging
+
+
+class TestNativeShardedIngest:
+    """REST sharded ingest runs through the native block path and
+    matches the Python row path bit-for-bit."""
+
+    def _serve(self, tmp_path):
+        from learningorchestra_tpu.api.server import APIServer
+        from learningorchestra_tpu.config import Config
+
+        cfg = Config()
+        cfg.store.root = str(tmp_path / "store")
+        cfg.store.volume_root = str(tmp_path / "volumes")
+        server = APIServer(cfg)
+        port = server.start_background()
+        return server, f"http://127.0.0.1:{port}/api/learningOrchestra/v1"
+
+    def test_parity_with_python_path(self, tmp_path):
+        import glob as _glob
+        import time
+
+        import numpy as np
+        import requests
+
+        import learningorchestra_tpu.services.dataset as dsmod
+        from learningorchestra_tpu.store.sharded import ShardedDataset
+
+        rng = np.random.default_rng(0)
+        n = 3000
+        X = rng.standard_normal((n, 3)).astype(np.float32)
+        y = (X.sum(1) > 0).astype(np.int32)
+        path = tmp_path / "d.csv"
+        with open(path, "w") as fh:
+            fh.write("a,b,c,label\n")
+            for i in range(n):
+                fh.write(",".join(f"{v:.5f}" for v in X[i])
+                         + f",{y[i]}\n")
+
+        server, base = self._serve(tmp_path)
+
+        def poll(p):
+            for _ in range(300):
+                m = requests.get(base + p).json()[0]
+                if m.get("jobState") in ("finished", "failed"):
+                    return m
+                time.sleep(0.05)
+            raise AssertionError("timeout")
+
+        try:
+            r = requests.post(base + "/dataset/csv", json={
+                "datasetName": "nat", "url": f"file://{path}",
+                "shardRows": 1024})
+            assert r.status_code == 201, r.text
+            m = poll("/dataset/csv/nat")
+            assert m["jobState"] == "finished", m
+            assert m.get("engine") == "native"
+            assert m["rows"] == n and m["shards"] == 3
+            assert m["previewRows"] == 100
+
+            orig = dsmod.DatasetService._ingest_sharded_native
+            dsmod.DatasetService._ingest_sharded_native = (
+                lambda *a, **k: None
+            )
+            try:
+                r = requests.post(base + "/dataset/csv", json={
+                    "datasetName": "pyp", "url": f"file://{path}",
+                    "shardRows": 1024})
+                assert r.status_code == 201, r.text
+                m2 = poll("/dataset/csv/pyp")
+                assert m2["jobState"] == "finished", m2
+                assert "engine" not in m2
+            finally:
+                dsmod.DatasetService._ingest_sharded_native = orig
+
+            vols = str(tmp_path / "volumes")
+            dsn = ShardedDataset(
+                _glob.glob(vols + "/**/nat", recursive=True)[0]
+            )
+            dsp = ShardedDataset(
+                _glob.glob(vols + "/**/pyp", recursive=True)[0]
+            )
+            assert dsn.dtypes == dsp.dtypes  # int label survives
+            for k in range(dsn.n_shards):
+                sa = dsn.load_shard(k)
+                sb = dsp.load_shard(k)
+                for col in sa:
+                    np.testing.assert_allclose(
+                        sa[col], sb[col], atol=1e-5
+                    )
+
+            # Non-numeric column fails the job with the same message
+            # shape as the Python path.
+            bad_csv = tmp_path / "bad.csv"
+            bad_csv.write_text(
+                "a,word\n1,hello\n2,world\n"
+            )
+            r = requests.post(base + "/dataset/csv", json={
+                "datasetName": "badn", "url": f"file://{bad_csv}",
+                "shardRows": 8})
+            assert r.status_code == 201
+            m3 = poll("/dataset/csv/badn")
+            assert m3["jobState"] == "failed"
+            assert "not numeric" in str(m3.get("exception", m3))
+        finally:
+            server.shutdown()
