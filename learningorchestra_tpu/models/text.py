@@ -15,7 +15,10 @@ from __future__ import annotations
 import jax.numpy as jnp
 from flax import linen as nn
 
-from learningorchestra_tpu.ops.layers import MultiHeadSelfAttention
+from learningorchestra_tpu.ops.layers import (
+    MultiHeadSelfAttention,
+    remat_block,
+)
 from learningorchestra_tpu.toolkit.registry import register
 from learningorchestra_tpu.train.neural import NeuralEstimator
 
@@ -152,7 +155,7 @@ class BertEncoder(nn.Module):
     # jax.checkpoint each block: activations rematerialize in the
     # backward pass — trades ~1 extra forward of FLOPs for O(layers)
     # less HBM, the standard long-sequence/large-batch headroom knob.
-    remat: bool = False
+    remat: bool | str = False
 
     @nn.compact
     def __call__(self, tokens):
@@ -165,8 +168,7 @@ class BertEncoder(nn.Module):
         # for every non-pad query row; pad query rows produce values no
         # one reads — the [CLS] head pools position 0 only.
         pad_mask = tokens != 0  # (B, T)
-        block_cls = nn.remat(TransformerBlock) if self.remat \
-            else TransformerBlock
+        block_cls = remat_block(TransformerBlock, self.remat)
         for i in range(self.num_layers):
             # Explicit names keep the parameter tree identical whether
             # remat is on or off (auto-naming would differ:
@@ -213,7 +215,7 @@ class BertModel(NeuralEstimator):
         num_classes: int = 2,
         learning_rate: float = 2e-5,
         seed: int = 0,
-        remat: bool = False,
+        remat: bool | str = False,
     ):
         self.vocab_size = vocab_size
         self.hidden_dim = hidden_dim
@@ -279,7 +281,7 @@ class _DecoderLM(nn.Module):
     max_len: int
     dtype: jnp.dtype | None = None  # None = promote (bf16 when the train step casts params)
     use_flash: bool | None = None
-    remat: bool = False
+    remat: bool | str = False
     decode: bool = False
     window: int | None = None  # sliding-window attention
     num_kv_heads: int | None = None  # grouped-query attention
@@ -301,8 +303,7 @@ class _DecoderLM(nn.Module):
             )
         if key_mask is None:
             key_mask = tokens != 0  # (B, T), pad id 0
-        block_cls = nn.remat(TransformerBlock) if self.remat \
-            else TransformerBlock
+        block_cls = remat_block(TransformerBlock, self.remat)
         for i in range(self.num_layers):
             x = block_cls(
                 hidden_dim=self.hidden_dim,
@@ -477,7 +478,7 @@ class DecoderLM(GreedyDecodeMixin, NeuralEstimator):
         max_len: int = 1024,
         learning_rate: float = 3e-4,
         seed: int = 0,
-        remat: bool = False,
+        remat: bool | str = False,
         attention_window: int | None = None,
         num_kv_heads: int | None = None,
         positional: str = "learned",

@@ -20,6 +20,7 @@ from typing import Sequence
 
 from flax import linen as nn
 
+from learningorchestra_tpu.ops.layers import remat_block
 from learningorchestra_tpu.toolkit.registry import register
 from learningorchestra_tpu.train.neural import NeuralEstimator
 
@@ -119,7 +120,7 @@ class _ResNet(nn.Module):
     # jax.checkpoint each residual block: activations rematerialize in
     # the backward pass — the batch-size headroom knob for conv nets,
     # where activation HBM (B x H x W x C per block) dominates params.
-    remat: bool = False
+    remat: bool | str = False
 
     @nn.compact
     def __call__(self, x):
@@ -129,7 +130,7 @@ class _ResNet(nn.Module):
         x = nn.GroupNorm(num_groups=min(32, self.width))(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
-        block_cls = nn.remat(self.block) if self.remat else self.block
+        block_cls = remat_block(self.block, self.remat)
         idx = 0
         for stage, n_blocks in enumerate(self.stage_sizes):
             for block_i in range(n_blocks):
@@ -154,7 +155,7 @@ class ResNet18(NeuralEstimator):
         num_classes: int = 1000,
         learning_rate: float = 1e-3,
         seed: int = 0,
-        remat: bool = False,
+        remat: bool | str = False,
     ):
         self.num_classes = num_classes
         self.remat = remat
@@ -178,7 +179,7 @@ class ResNet50(NeuralEstimator):
         num_classes: int = 1000,
         learning_rate: float = 1e-3,
         seed: int = 0,
-        remat: bool = False,
+        remat: bool | str = False,
     ):
         self.num_classes = num_classes
         self.remat = remat

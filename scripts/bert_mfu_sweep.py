@@ -56,7 +56,7 @@ GRID = [
 
 results = []
 for seq, bs in GRID:
-    for remat in (False, True):
+    for remat in (False, True, "dots"):
         n = max(4 * bs, 256)
         tok = rng.integers(0, 30522, (n, seq), dtype=np.int32)
         lab = rng.integers(0, 2, (n,), dtype=np.int32)

@@ -177,12 +177,14 @@ def test_bert_remat_trains_and_matches():
     kwargs = dict(vocab_size=32, hidden_dim=16, num_layers=2, num_heads=2,
                   max_len=8, seed=7)
     plain = BertModel(**kwargs)
-    remat = BertModel(remat=True, **kwargs)
     plain.fit(x, y, epochs=1, batch_size=8, shuffle=False)
-    remat.fit(x, y, epochs=1, batch_size=8, shuffle=False)
-    np.testing.assert_allclose(
-        plain.history["loss"], remat.history["loss"], rtol=1e-4
-    )
+    for mode in (True, "dots"):
+        remat = BertModel(remat=mode, **kwargs)
+        remat.fit(x, y, epochs=1, batch_size=8, shuffle=False)
+        np.testing.assert_allclose(
+            plain.history["loss"], remat.history["loss"], rtol=1e-4,
+            err_msg=f"remat={mode}",
+        )
 
 
 def test_resnet_remat_trains_and_matches():
