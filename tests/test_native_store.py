@@ -479,3 +479,108 @@ class TestNativeShardedIngest:
             assert "not numeric" in str(m3.get("exception", m3))
         finally:
             server.shutdown()
+
+
+class TestNativeIngestProperty:
+    def test_random_csvs_match_python_path(self, tmp_path):
+        """Property check: random numeric CSVs (empties, short rows,
+        \\r\\n, quoted cells, blank lines) shard identically through
+        the native block path and the Python row path."""
+        import glob as _glob
+        import time
+
+        import numpy as np
+        import requests
+
+        import learningorchestra_tpu.services.dataset as dsmod
+        from learningorchestra_tpu.api.server import APIServer
+        from learningorchestra_tpu.config import Config
+        from learningorchestra_tpu.store.sharded import ShardedDataset
+
+        rng = np.random.default_rng(7)
+        cfg = Config()
+        cfg.store.root = str(tmp_path / "store")
+        cfg.store.volume_root = str(tmp_path / "volumes")
+        server = APIServer(cfg)
+        port = server.start_background()
+        base = f"http://127.0.0.1:{port}/api/learningOrchestra/v1"
+
+        def poll(p):
+            for _ in range(400):
+                m = requests.get(base + p).json()[0]
+                if m.get("jobState") in ("finished", "failed"):
+                    return m
+                time.sleep(0.05)
+            raise AssertionError("timeout")
+
+        def random_csv(path, n, ncols, seed):
+            r = np.random.default_rng(seed)
+            eol = "\r\n" if seed % 2 else "\n"
+            with open(path, "w", newline="") as fh:
+                fh.write(",".join(f"c{i}" for i in range(ncols)) + eol)
+                for _ in range(n):
+                    cells = []
+                    for c in range(ncols):
+                        u = r.random()
+                        if u < 0.05:
+                            cells.append("")  # empty -> NaN
+                        elif u < 0.1:
+                            cells.append(f'"{r.integers(0, 99)}"')
+                        elif u < 0.5:
+                            cells.append(str(int(r.integers(-50, 50))))
+                        else:
+                            cells.append(f"{r.standard_normal():.6f}")
+                    if r.random() < 0.05:
+                        cells = cells[: max(1, ncols - 2)]  # short row
+                    fh.write(",".join(cells) + eol)
+                    if r.random() < 0.03:
+                        fh.write(eol)  # blank line
+        try:
+            for seed in range(3):
+                n, ncols = int(rng.integers(200, 800)), int(
+                    rng.integers(2, 6)
+                )
+                path = tmp_path / f"r{seed}.csv"
+                random_csv(path, n, ncols, seed)
+                names = []
+                for label, patch in (("nat", False), ("pyp", True)):
+                    name = f"{label}{seed}"
+                    names.append(name)
+                    orig = dsmod.DatasetService._ingest_sharded_native
+                    if patch:
+                        dsmod.DatasetService._ingest_sharded_native = (
+                            lambda *a, **k: None
+                        )
+                    try:
+                        r = requests.post(base + "/dataset/csv", json={
+                            "datasetName": name,
+                            "url": f"file://{path}",
+                            "shardRows": 128})
+                        assert r.status_code == 201, r.text
+                        m = poll(f"/dataset/csv/{name}")
+                        assert m["jobState"] == "finished", m
+                    finally:
+                        dsmod.DatasetService._ingest_sharded_native = orig
+                vols = str(tmp_path / "volumes")
+                a = ShardedDataset(_glob.glob(
+                    vols + f"/**/{names[0]}", recursive=True)[0])
+                b = ShardedDataset(_glob.glob(
+                    vols + f"/**/{names[1]}", recursive=True)[0])
+                assert a.n_rows == b.n_rows == n
+                assert a.dtypes == b.dtypes, (seed, a.dtypes, b.dtypes)
+                for k in range(a.n_shards):
+                    sa, sb = a.load_shard(k), b.load_shard(k)
+                    for col in sa:
+                        np.testing.assert_array_equal(
+                            np.isnan(sa[col].astype(np.float64)),
+                            np.isnan(sb[col].astype(np.float64)),
+                            err_msg=f"seed {seed} shard {k} {col}",
+                        )
+                        np.testing.assert_allclose(
+                            np.nan_to_num(sa[col].astype(np.float64)),
+                            np.nan_to_num(sb[col].astype(np.float64)),
+                            atol=1e-6,
+                            err_msg=f"seed {seed} shard {k} {col}",
+                        )
+        finally:
+            server.shutdown()
