@@ -637,6 +637,26 @@ class DistributedTrainer:
     def evaluate(
         self, x, y, batch_size: int = 128, _params=None, **_
     ) -> dict:
+        from learningorchestra_tpu.train.neural import _is_sharded
+
+        if _is_sharded(x) or _is_sharded(y):
+            # Shard-streaming evaluate — beyond-RAM datasets never
+            # materialize on host (same contract as the single-device
+            # surface, neural.py::_evaluate_streaming).
+            from learningorchestra_tpu.store import sharded as sh
+
+            x, y = sh.resolve_xy_views(x, y)
+            acc = sh.WeightedMetrics()
+            for k in range(x.dataset.n_shards):
+                xs = x.load_shard(k)
+                acc.add(
+                    self.evaluate(
+                        xs, y.load_shard(k), batch_size=batch_size,
+                        _params=_params,
+                    ),
+                    len(xs),
+                )
+            return acc.result()
         est = self.estimator
         x = np.asarray(as_array(x))
         y_arr = np.asarray(y if not hasattr(y, "to_numpy") else y.to_numpy())

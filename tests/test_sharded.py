@@ -248,6 +248,15 @@ class TestDistributedStreaming:
         # Trained state lands back on the estimator (artifact contract):
         # its own single-device evaluate agrees the model learned.
         assert est.evaluate(x, y)["accuracy"] > 0.5
+        # The trainer's own evaluate streams sharded views too, and
+        # row-weighted shard metrics agree with the in-memory answer.
+        streamed = trainer.evaluate(ds, ds["label"])
+        resident = trainer.evaluate(x, y)
+        # Same data, different batch composition (per-shard padded
+        # batches vs one resident batching) → bf16 activation sums
+        # differ in the last bits; row-weighting itself is exact.
+        assert abs(streamed["loss"] - resident["loss"]) < 0.02
+        assert abs(streamed["accuracy"] - resident["accuracy"]) < 0.02
 
     def test_batch_divisibility_enforced(self, tmp_path):
         from learningorchestra_tpu.models.mlp import MLPClassifier
