@@ -329,9 +329,12 @@ class GreedyDecodeMixin:
 
     def generate(self, prompts, max_new_tokens: int = 32,
                  temperature: float | None = None,
-                 top_k: int | None = None, seed: int = 0):
+                 top_k: int | None = None,
+                 top_p: float | None = None, seed: int = 0):
         """Continuation of int32 prompts (B, T0): greedy by default,
-        sampled with ``temperature`` (optionally ``top_k``-truncated).
+        sampled with ``temperature`` (optionally ``top_k``-truncated
+        and/or ``top_p`` nucleus-truncated — keep the smallest set of
+        tokens whose probabilities sum past ``top_p``).
 
         KV-cache decoding: the whole generation (prompt prefill +
         continuation) is ONE jitted ``lax.scan`` over buffer positions
@@ -352,6 +355,13 @@ class GreedyDecodeMixin:
                 "top_k requires a positive temperature (top_k without "
                 "sampling silently degrades to greedy)"
             )
+        if top_p is not None:
+            if not sample:
+                raise ValueError(
+                    "top_p requires a positive temperature"
+                )
+            if not 0.0 < top_p <= 1.0:
+                raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if top_k == 1:
             # Deterministic by definition — use the greedy path (also
             # sidesteps tie-breaking drift vs argmax in low precision).
@@ -375,7 +385,7 @@ class GreedyDecodeMixin:
         fns = getattr(self, "_decode_fns", None)
         if fns is None:
             fns = self._decode_fns = {}
-        key = (bsz, total, t0, sample, top_k)
+        key = (bsz, total, t0, sample, top_k, top_p is not None)
         entry = fns.get(key)
         if entry is not None:
             fns[key] = fns.pop(key)  # refresh recency (LRU, not FIFO)
@@ -394,7 +404,9 @@ class GreedyDecodeMixin:
                 jnp.zeros((bsz, total), jnp.int32),
             )["cache"]
 
-            def decode(variables, cache, buf, temp, key):
+            use_top_p = top_p is not None
+
+            def decode(variables, cache, buf, temp, p_nucleus, key):
                 def step(carry, i):
                     cache, buf = carry
                     tok = lax.dynamic_slice(buf, (0, i), (bsz, 1))
@@ -425,9 +437,25 @@ class GreedyDecodeMixin:
                             step_logits = jnp.where(
                                 step_logits < kth, -jnp.inf, step_logits
                             )
+                        scaled = step_logits / temp
+                        if use_top_p:
+                            # Nucleus: drop tokens outside the smallest
+                            # prefix (by descending prob) summing past
+                            # p.  The threshold prob is found via sort+
+                            # cumsum; p is a runtime arg (no recompile).
+                            probs = jax.nn.softmax(scaled, -1)
+                            srt = jnp.sort(probs, -1)[..., ::-1]
+                            csum = jnp.cumsum(srt, -1)
+                            cut = jnp.sum(
+                                csum < p_nucleus, -1, keepdims=True
+                            )
+                            thresh = jnp.take_along_axis(srt, cut, -1)
+                            scaled = jnp.where(
+                                probs < thresh, -jnp.inf, scaled
+                            )
                         nxt = jax.random.categorical(
                             jax.random.fold_in(key, i),
-                            step_logits / temp, axis=-1,
+                            scaled, axis=-1,
                         )
                     nxt = nxt.astype(jnp.int32)
                     prev = lax.dynamic_slice(buf, (0, i + 1), (bsz, 1))
@@ -452,6 +480,7 @@ class GreedyDecodeMixin:
         return np.asarray(decode(
             dict(self.params), cache0, buf0,
             jnp.float32(temperature if sample else 1.0),
+            jnp.float32(top_p if top_p is not None else 1.0),
             jax.random.PRNGKey(seed),
         ))
 

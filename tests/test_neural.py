@@ -409,6 +409,24 @@ def test_generate_sampling_modes():
     np.testing.assert_array_equal(
         greedy, est.generate(prompts, max_new_tokens=8, temperature=None)
     )
+    # Nucleus: a tiny top_p keeps only the argmax token -> greedy even
+    # at high temperature; deterministic per seed at moderate top_p.
+    np.testing.assert_array_equal(
+        greedy,
+        est.generate(prompts, max_new_tokens=8, temperature=5.0,
+                     top_p=1e-6, seed=3),
+    )
+    n1 = est.generate(prompts, max_new_tokens=8, temperature=5.0,
+                      top_p=0.9, seed=2)
+    n2 = est.generate(prompts, max_new_tokens=8, temperature=5.0,
+                      top_p=0.9, seed=2)
+    np.testing.assert_array_equal(n1, n2)
+    # top_p=1.0 truncates nothing: same draw as plain sampling.
+    np.testing.assert_array_equal(
+        est.generate(prompts, max_new_tokens=8, temperature=5.0, seed=1,
+                     top_p=1.0),
+        s1,
+    )
 
 
 def test_generate_sampling_guards():
@@ -423,6 +441,10 @@ def test_generate_sampling_guards():
     est.fit(x, x, epochs=1, batch_size=4, verbose=0)
     with pytest.raises(ValueError, match="temperature"):
         est.generate(x[:1, :3], top_k=5)
+    with pytest.raises(ValueError, match="temperature"):
+        est.generate(x[:1, :3], top_p=0.9)
+    with pytest.raises(ValueError, match="top_p must be"):
+        est.generate(x[:1, :3], temperature=1.0, top_p=1.5)
     # Sampling never emits pad id 0.
     out = est.generate(x[:2, :3], max_new_tokens=8, temperature=10.0,
                        seed=3)
