@@ -5,7 +5,9 @@
 # stage failure we fall back to probing and re-run the FAILED stage
 # when the tunnel returns (stages are idempotent).
 #
-# Usage: bash scripts/tpu_watch.sh  (logs to /tmp/tpu_chain/)
+# Usage: bash scripts/tpu_watch.sh  (logs to <repo>/tpu_chain_logs/ —
+# IN the repo so a chain that completes after the session ends still
+# leaves its evidence where the next commit picks it up)
 set -u
 cd "$(dirname "$0")/.."
 export PYTHONPATH=/root/.axon_site:/root/repo
@@ -14,7 +16,7 @@ export PYTHONPATH=/root/.axon_site:/root/repo
 # serialize executables this is a harmless no-op warning.
 export JAX_COMPILATION_CACHE_DIR=/tmp/jax_cache
 export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=3
-LOGDIR=/tmp/tpu_chain
+LOGDIR="$(pwd)/tpu_chain_logs"
 mkdir -p "$LOGDIR"
 
 probe() {
