@@ -163,3 +163,51 @@ def test_client_patch_and_metrics_surface(ctx):
     assert callable(ctx.transform_sklearn.create)
     assert callable(ctx.explore.update)
     assert callable(ctx.train_distributed.update)
+
+
+def test_client_events_curves_and_wildcard(ctx):
+    """Round-3 client additions: the global event feed, wildcard
+    webhook registration, and training-curves explore."""
+    ctx, csv = ctx
+
+    # Run a job so the feed has rows regardless of test selection.
+    ctx.function.create("evprobe", function="response = 1")
+    ctx.observe.wait("evprobe")
+    rows = ctx.observe.events()
+    assert rows and all("artifact" in r and "event" in r for r in rows)
+    ids = [r["_id"] for r in rows]
+    assert ids == sorted(ids)
+    assert all(
+        r["_id"] > ids[0] for r in ctx.observe.events(since_id=ids[0])
+    )
+
+    # Wildcard webhook registers, lists, and unregisters via the
+    # dedicated /observe/webhook routes.
+    hook = ctx.observe.webhook_all("http://127.0.0.1:9/nope")
+    assert hook["artifact"] == "*"
+    listed = ctx.request("GET", "/observe/webhook")["result"]
+    assert any(h["_id"] == hook["_id"] for h in listed)
+    ctx.request("DELETE", f"/observe/webhook/{hook['_id']}")
+    assert ctx.request("GET", "/observe/webhook")["result"] == []
+    # Training curves from the fixture's train artifact.
+    ctx.dataset_csv.insert("cdata", f"file://{csv}")
+    ctx.observe.wait("cdata")
+    ctx.projection.create("cx", "cdata", ["f1", "f2"])
+    ctx.observe.wait("cx")
+    ctx.model.create(
+        "evmlp", module_path="learningorchestra_tpu.models.mlp",
+        class_name="MLPClassifier",
+        class_parameters={"hidden_layer_sizes": [8], "num_classes": 2},
+    )
+    ctx.observe.wait("evmlp")
+    ctx.train.create(
+        "evfit", model_name="evmlp", parent_name="evmlp", method="fit",
+        method_parameters={"x": "$cx", "y": "$cdata.label",
+                            "epochs": 3, "batch_size": 64},
+    )
+    ctx.observe.wait("evfit", timeout=300)
+    ctx.explore_curves.create("evfit_curves", "evfit")
+    meta = ctx.explore_curves.wait("evfit_curves")
+    assert meta["epochs"] == 3 and "loss" in meta["metrics"]
+    png = ctx.explore_curves.image("evfit_curves")
+    assert png[:8] == b"\x89PNG\r\n\x1a\n"
