@@ -27,7 +27,9 @@ import os
 import time
 
 
-def _prior_best(metric: str, *, allow_cross_backend: bool) -> float | None:
+def _prior_best(
+    metric: str, *, allow_cross_backend: bool, bench_dir: str | None = None
+) -> float | None:
     """Best prior round's headline value with the same metric (same
     backend suffix).  ``allow_cross_backend`` (TPU rounds only) falls
     back to any prior metric so a first-ever TPU round still reports
@@ -35,8 +37,9 @@ def _prior_best(metric: str, *, allow_cross_backend: bool) -> float | None:
     ratioing a degraded round against a TPU best would print exactly
     the fake catastrophic regression this function exists to prevent."""
     same, anyb = None, None
-    for path in glob.glob(os.path.join(os.path.dirname(__file__) or ".",
-                                       "BENCH_r*.json")):
+    if bench_dir is None:
+        bench_dir = os.path.dirname(__file__) or "."
+    for path in glob.glob(os.path.join(bench_dir, "BENCH_r*.json")):
         try:
             with open(path) as f:
                 rec = json.load(f)
@@ -193,7 +196,14 @@ def _fused_throughput(est, x, y, batch_size, k: int = 4) -> float:
 
     best = 0.0
     run(k), run(3 * k)  # compile both
-    for _ in range(2):
+    # Two clean measurements normally; up to four so one scheduler/GC
+    # hiccup during a short timed call (negative delta) costs a retry,
+    # not the whole bench — the smoke's millisecond-scale calls hit
+    # this where the on-chip shapes never do.
+    positives = 0
+    for _ in range(4):
+        if positives >= 2:
+            break
         t0 = time.perf_counter()
         run(k)
         t1 = time.perf_counter()
@@ -201,6 +211,7 @@ def _fused_throughput(est, x, y, batch_size, k: int = 4) -> float:
         t2 = time.perf_counter()
         dt = (t2 - t1) - (t1 - t0)
         if dt > 0:
+            positives += 1
             best = max(best, 2 * k * n / dt)
     if best <= 0:
         raise RuntimeError("fused timing produced non-positive delta")
@@ -222,12 +233,34 @@ def _bench_model(est, x, y, batch_size, peak, k: int = 4) -> dict:
     return out
 
 
-def _tpu_suite(peak) -> dict:
-    """MNIST headline + BERT-base + ResNet-50, all bf16 on chip.
+# Shapes for the on-chip suite (BASELINE.md configs 2/4/5 scaled to one
+# chip's HBM; batch sizes from the sweeps in TPU_EVIDENCE.md) and a
+# structurally identical tiny-shape smoke used by
+# tests/test_bench_smoke.py: the smoke drives the EXACT _tpu_suite /
+# _assemble_tpu code path on CPU so a shape or key bug is caught before
+# it wastes a live tunnel window (VERDICT r3 item 3).  The smoke keeps
+# the SAME seq values so the bert_base_seq{128,512} keys — which
+# _assemble_tpu consumes by name — are produced identically.
+FULL_SUITE = {
+    "mnist": {"n": 16384, "bs": 1024, "k": 4},
+    # (seq, batch_size, n_samples) per BERT point; kwargs shrink the
+    # model for the smoke only.
+    "bert": {"configs": [(128, 32, 2048), (512, 16, 512)],
+             "kwargs": {}, "k": 2},
+    "resnet": {"n": 512, "bs": 64, "hw": 224, "k": 2},
+}
+SMOKE_SUITE = {
+    "mnist": {"n": 64, "bs": 32, "k": 2},
+    "bert": {"configs": [(128, 4, 16), (512, 2, 4)],
+             "kwargs": {"hidden_dim": 32, "num_layers": 1,
+                        "num_heads": 2},
+             "k": 1},
+    "resnet": {"n": 8, "bs": 4, "hw": 56, "k": 1},
+}
 
-    Shapes follow BASELINE.md configs 2/4/5 scaled to one chip's HBM;
-    batch sizes from the on-chip sweeps recorded in TPU_EVIDENCE.md.
-    """
+
+def _tpu_suite(peak, suite: dict = FULL_SUITE) -> dict:
+    """MNIST headline + BERT-base + ResNet-50, all bf16 on chip."""
     import numpy as np
 
     from learningorchestra_tpu.models.text import BertModel
@@ -241,9 +274,11 @@ def _tpu_suite(peak) -> dict:
     # The headline model runs UNPROTECTED (a failure here should fail
     # the bench loudly); the riders degrade to an error field so one
     # OOM can never cost the driver the whole round's number.
-    x = rng.standard_normal((16384, 28, 28, 1), dtype=np.float32)
-    y = rng.integers(0, 10, (16384,), dtype=np.int32)
-    out["mnist"] = _bench_model(MnistCNN(), x, y, 1024, peak)
+    mn = suite["mnist"]
+    x = rng.standard_normal((mn["n"], 28, 28, 1), dtype=np.float32)
+    y = rng.integers(0, 10, (mn["n"],), dtype=np.int32)
+    out["mnist"] = _bench_model(MnistCNN(), x, y, mn["bs"], peak,
+                                k=mn["k"])
 
     def guarded(fn):
         # Record-don't-die for rider models: the value is either the
@@ -255,31 +290,57 @@ def _tpu_suite(peak) -> dict:
 
     # BERT-base fine-tune shape (config 4): seq 128 primary; the seq-512
     # point (where the flash kernel pays off in-model) rides along.
+    bert_cfg = suite["bert"]
+
     def bench_bert(seq, bs, n):
         tok = rng.integers(0, 30522, (n, seq), dtype=np.int32)
         lab = rng.integers(0, 2, (n,), dtype=np.int32)
-        est = BertModel(max_len=seq)
+        est = BertModel(max_len=seq, **bert_cfg["kwargs"])
         return {
             "batch_size": bs,
-            **_bench_model(est, tok, lab, bs, peak, k=2),
+            **_bench_model(est, tok, lab, bs, peak, k=bert_cfg["k"]),
         }
 
-    for seq, bs, n in ((128, 32, 2048), (512, 16, 512)):
+    for seq, bs, n in bert_cfg["configs"]:
         out[f"bert_base_seq{seq}"] = guarded(
             lambda seq=seq, bs=bs, n=n: bench_bert(seq, bs, n)
         )
 
     # ResNet-50 / ImageNet shape (config 5, one-chip slice).
+    rn = suite["resnet"]
+
     def bench_resnet():
-        xi = rng.standard_normal((512, 224, 224, 3), dtype=np.float32)
-        yi = rng.integers(0, 1000, (512,), dtype=np.int32)
+        xi = rng.standard_normal((rn["n"], rn["hw"], rn["hw"], 3),
+                                 dtype=np.float32)
+        yi = rng.integers(0, 1000, (rn["n"],), dtype=np.int32)
         return {
-            "batch_size": 64,
-            **_bench_model(ResNet50(), xi, yi, 64, peak, k=2),
+            "batch_size": rn["bs"],
+            **_bench_model(ResNet50(), xi, yi, rn["bs"], peak,
+                           k=rn["k"]),
         }
 
     out["resnet50"] = guarded(bench_resnet)
     return out
+
+
+def _assemble_tpu(suite: dict) -> tuple[float, dict]:
+    """Fold a _tpu_suite result into (headline throughput, extra JSON
+    fields) — the exact shape prior rounds' BENCH records use."""
+    suite = dict(suite)
+    mnist = suite.pop("mnist")
+    throughput = mnist["samples_per_sec"]
+    extra: dict = {}
+    # Keep the headline model's MFU fields at top level (prior
+    # rounds' JSON shape) alongside the per-model sub-dicts.
+    for key in ("mfu", "model_flops_per_sample"):
+        if key in mnist:
+            extra[key] = mnist[key]
+    extra.update(suite)
+    bert = extra.get("bert_base_seq128")
+    if isinstance(bert, dict) and "mfu" in bert:
+        # isinstance guard: a failed rider stores a string here.
+        extra["bert_mfu"] = bert["mfu"]
+    return throughput, extra
 
 
 def main() -> None:
@@ -294,19 +355,7 @@ def main() -> None:
     extra: dict = {}
 
     if platform == "tpu":
-        suite = _tpu_suite(peak)
-        mnist = suite.pop("mnist")
-        throughput = mnist["samples_per_sec"]
-        # Keep the headline model's MFU fields at top level (prior
-        # rounds' JSON shape) alongside the per-model sub-dicts.
-        for key in ("mfu", "model_flops_per_sample"):
-            if key in mnist:
-                extra[key] = mnist[key]
-        extra.update(suite)
-        bert = extra.get("bert_base_seq128")
-        if isinstance(bert, dict) and "mfu" in bert:
-            # isinstance guard: a failed rider stores a string here.
-            extra["bert_mfu"] = bert["mfu"]
+        throughput, extra = _assemble_tpu(_tpu_suite(peak))
     else:
         # Degraded-tunnel fallback: MNIST only, f32 pinned (bf16 is
         # emulated on CPU — letting it leak in turned round 2's number

@@ -69,6 +69,7 @@ def dataset(api, tmp_path_factory):
     return "dd"
 
 
+@pytest.mark.slow  # mesh train-step compile dominates (~20 s on one core)
 def test_distributed_train_route(api, dataset):
     base, _ = api
     resp = requests.post(

@@ -9,6 +9,8 @@ distributed fit matching the single-device run.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # compile-heavy: full tier only
+
 import jax
 import jax.numpy as jnp
 

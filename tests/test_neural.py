@@ -4,6 +4,8 @@ import jax
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # compile-heavy: full tier only
+
 from learningorchestra_tpu.models import (
     LSTMClassifier,
     MLPClassifier,

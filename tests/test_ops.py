@@ -10,6 +10,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # compile-heavy: full tier only
+
 from learningorchestra_tpu.ops import flash_attention, mha_reference
 
 B, H, T, D = 2, 3, 48, 16

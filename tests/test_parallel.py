@@ -9,6 +9,8 @@ control plane.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # compile-heavy: full tier only
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P

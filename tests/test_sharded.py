@@ -403,6 +403,7 @@ class TestTensorSharded:
             w.append_rows({"x": np.zeros((2, 4)),
                            "label": np.zeros(3)})
 
+    @pytest.mark.slow  # CNN fit compile dominates (~25 s on one core)
     def test_tensor_ingest_and_cnn_train_via_rest(self, tmp_path):
         """BASELINE config 5's shape end-to-end: image-shaped .npy
         sources ingest sharded (mmap'd, O(chunk) host memory) and a

@@ -10,6 +10,8 @@ import time
 
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # compile-heavy: full tier only
 import requests
 
 from learningorchestra_tpu.api import APIServer

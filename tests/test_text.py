@@ -10,6 +10,8 @@ shards that the jitted/streaming fit surfaces consume unchanged.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # compile-heavy: full tier only
+
 from learningorchestra_tpu.text import BpeTokenizer
 from learningorchestra_tpu.text.bpe import (
     BOS_ID,

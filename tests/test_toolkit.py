@@ -4,6 +4,8 @@ SURVEY §2.3 toolkit row)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # compile-heavy: full tier only
+
 from learningorchestra_tpu.toolkit import registry
 
 
