@@ -66,6 +66,18 @@ trap cleanup INT TERM
 supervise coordinator python -m learningorchestra_tpu coordinator \
   --host 127.0.0.1 --port "$COORD_PORT"
 supervise api python -m learningorchestra_tpu serve
+# Store HA (LO_HA_STANDBY=1): a warm standby ships the primary's WALs
+# and promotes itself on sustained health-check failure — the mongo
+# replica set's automatic election (store/ha.py).  A fenced old
+# primary's restart exits cleanly, ending its supervision loop.
+if [ "${LO_HA_STANDBY:-0}" = "1" ]; then
+  STANDBY_PORT="${LO_HA_STANDBY_PORT:-$((API_PORT + 1))}"
+  supervise standby python -m learningorchestra_tpu standby \
+    --primary "127.0.0.1:$API_PORT" \
+    --primary-store "$LO_TPU_STORE_ROOT" \
+    --replica "$DATA_ROOT/store-replica" \
+    --port "$STANDBY_PORT" --host 127.0.0.1
+fi
 for i in $(seq 1 "$N_AGENTS"); do
   supervise "agent$i" python -m learningorchestra_tpu agent \
     --coordinator "127.0.0.1:$COORD_PORT" --id "agent$i"

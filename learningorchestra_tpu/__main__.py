@@ -12,6 +12,13 @@
             [--id ID] [--capacity N]
         Per-host worker: registers, heartbeats, leases distributed
         tasks (replaces a Ray worker joining the head node).
+
+    python -m learningorchestra_tpu standby --primary HOST:PORT \\
+            --primary-store DIR --replica DIR --port N
+        Warm standby: ships the primary's WALs, health-checks it, and
+        on sustained failure promotes itself to the serving primary
+        (replaces the mongo replica set's automatic election,
+        reference: docker-compose.yml:42-90; see store/ha.py).
 """
 
 from __future__ import annotations
@@ -73,6 +80,21 @@ def _cmd_agent(args) -> int:
     return 0
 
 
+def _cmd_standby(args) -> int:
+    from learningorchestra_tpu.store.ha import run_standby
+
+    run_standby(
+        args.primary,
+        args.primary_store,
+        args.replica,
+        args.port,
+        check_interval=args.interval,
+        max_misses=args.misses,
+        host=args.host,
+    )
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="learningorchestra_tpu")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -89,11 +111,29 @@ def main(argv: list[str] | None = None) -> int:
     agent.add_argument("--id", default=None)
     agent.add_argument("--capacity", type=int, default=1)
 
+    standby = sub.add_parser(
+        "standby", help="warm standby with automatic promotion"
+    )
+    standby.add_argument("--primary", required=True,
+                         help="primary API HOST:PORT to health-check")
+    standby.add_argument("--primary-store", required=True,
+                         help="primary's store directory (WAL source)")
+    standby.add_argument("--replica", required=True,
+                         help="local replica directory")
+    standby.add_argument("--port", type=int, required=True,
+                         help="port to serve on after promotion")
+    standby.add_argument("--host", default="0.0.0.0")
+    standby.add_argument("--interval", type=float, default=0.5,
+                         help="seconds between sync+health probes")
+    standby.add_argument("--misses", type=int, default=4,
+                         help="consecutive failed probes before takeover")
+
     args = parser.parse_args(argv)
     return {
         "serve": _cmd_serve,
         "coordinator": _cmd_coordinator,
         "agent": _cmd_agent,
+        "standby": _cmd_standby,
     }[args.command](args)
 
 

@@ -1209,6 +1209,23 @@ class APIServer:
 
 
 def serve(config: Config | None = None) -> None:
+    from learningorchestra_tpu.store.ha import is_fenced
+
+    config = config or get_config()
+    fence = is_fenced(config.store.store_path())
+    if fence is not None:
+        # A standby promoted itself over this store: serving from it
+        # now would split-brain the cluster.  Exit CLEANLY so the
+        # supervisor's restart-on-failure loop ends instead of
+        # resurrecting a fenced primary (store/ha.py).
+        print(
+            "store is fenced — a standby promoted itself to "
+            f"{fence.get('promoted_to') or 'a new primary'}; refusing "
+            "to serve. Re-join by running this node as a standby of "
+            "the new primary.",
+            flush=True,
+        )
+        return
     APIServer(config).serve_forever()
 
 

@@ -48,12 +48,23 @@ class Context:
     """Connection to a learningorchestra_tpu cluster."""
 
     def __init__(self, cluster: str, port: int = 80,
-                 prefix: str = "/api/learningOrchestra/v1"):
-        if cluster.startswith(("http://", "https://")):
-            base = cluster.rstrip("/")
-        else:
-            base = f"http://{cluster}:{port}"
-        self.base = base + prefix
+                 prefix: str = "/api/learningOrchestra/v1",
+                 failover: str | None = None,
+                 request_timeout: float = 330.0):
+        self.base = self._make_base(cluster, port) + prefix
+        # Standby address for automatic store failover (store/ha.py):
+        # on a connection-level failure the client retries ONCE against
+        # the standby and — mirroring mongo driver re-discovery — keeps
+        # talking to it for the rest of the session.
+        self._failover_base = (
+            self._make_base(failover, port) + prefix if failover else None
+        )
+        # Per-request socket timeout.  A hung-but-accepting primary
+        # (SIGSTOP, black-holed path) must eventually raise so the
+        # failover retry can fire; the default sits above the server's
+        # 300 s observe long-poll cap (api/server.py observe_wait) so
+        # legitimate long polls never trip it.
+        self.request_timeout = request_timeout
 
         self.dataset_csv = _Dataset(self, "csv")
         self.dataset_generic = _Dataset(self, "generic")
@@ -80,33 +91,68 @@ class Context:
 
     # -- transport ----------------------------------------------------------
 
+    @staticmethod
+    def _make_base(cluster: str, port: int) -> str:
+        if cluster.startswith(("http://", "https://")):
+            return cluster.rstrip("/")
+        if ":" in cluster:
+            return f"http://{cluster}"
+        return f"http://{cluster}:{port}"
+
     def request(self, verb: str, path: str, body: dict | None = None,
                 query: dict | None = None, raw: bool = False):
-        url = self.base + path
+        qs = ""
         if query:
-            url += "?" + urllib.parse.urlencode(
+            qs = "?" + urllib.parse.urlencode(
                 {k: v if isinstance(v, str) else json.dumps(v)
                  for k, v in query.items()}
             )
+        try:
+            return self._one_request(self.base, verb, path, qs, body, raw)
+        except urllib.error.HTTPError as exc:
+            raise self._client_error(exc) from None
+        except (urllib.error.URLError, ConnectionError, OSError):
+            # Connection-level failure (refused/reset/timeout) — NOT an
+            # HTTP status.  If a standby was configured, the primary may
+            # have died and the standby promoted itself: retry once
+            # there, and on success stay repointed.
+            if self._failover_base is None:
+                raise
+            try:
+                result = self._one_request(
+                    self._failover_base, verb, path, qs, body, raw
+                )
+            except urllib.error.HTTPError as exc:
+                # The standby answered with an HTTP error: it IS alive
+                # and promoted — repoint, then surface the error as-is.
+                self.base, self._failover_base = self._failover_base, None
+                raise self._client_error(exc) from None
+            self.base, self._failover_base = self._failover_base, None
+            return result
+
+    def _one_request(self, base, verb, path, qs, body, raw):
         req = urllib.request.Request(
-            url,
+            base + path + qs,
             method=verb,
             data=json.dumps(body).encode() if body is not None else None,
             headers={"Content-Type": "application/json"},
         )
+        with urllib.request.urlopen(
+            req, timeout=self.request_timeout
+        ) as resp:
+            data = resp.read()
+            if raw:
+                return data
+            return json.loads(data) if data else {}
+
+    @staticmethod
+    def _client_error(exc: urllib.error.HTTPError) -> "ClientError":
+        data = exc.read()
         try:
-            with urllib.request.urlopen(req) as resp:
-                data = resp.read()
-                if raw:
-                    return data
-                return json.loads(data) if data else {}
-        except urllib.error.HTTPError as exc:
-            data = exc.read()
-            try:
-                payload = json.loads(data)
-            except Exception:
-                payload = data.decode(errors="replace")
-            raise ClientError(exc.code, payload) from None
+            payload = json.loads(data)
+        except Exception:
+            payload = data.decode(errors="replace")
+        return ClientError(exc.code, payload)
 
     # -- conveniences over the universal GET/poll path ----------------------
 

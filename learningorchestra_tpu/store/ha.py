@@ -1,0 +1,248 @@
+"""Automatic store failover — the reference's replica-set election.
+
+The reference deploys MongoDB as a 3-node replica set whose secondaries
+take over automatically when the primary dies (reference:
+docker-compose.yml:42-90 — ``replSetInitiate`` + driver re-discovery).
+Here the store is embedded in the API server process, so HA is a
+process-pair story instead of a database protocol:
+
+- The PRIMARY is an ordinary ``serve`` process over its store directory.
+- A STANDBY process (``python -m learningorchestra_tpu standby``) runs a
+  :class:`StandbyMonitor`: it ships the primary's WALs continuously
+  (:class:`~learningorchestra_tpu.store.replica.WalReplica`), probes the
+  primary's ``/health`` route every ``check_interval`` seconds, and
+  after ``max_misses`` consecutive failed probes performs the election
+  a Mongo secondary would win:
+
+  1. **final sync** — ship every complete WAL record still readable from
+     the primary's directory.  On a shared filesystem (the local
+     deployment) a kill -9'd primary loses NO acknowledged writes: they
+     are all in its WALs, and only the torn tail — which the primary's
+     own restart recovery would also discard — is withheld.  Across
+     hosts the loss window is the replication lag, exactly Mongo's
+     w:1 rollback window.
+  2. **fence** — write a ``.fenced`` marker into the old primary's store
+     directory.  A supervised restart of the old primary sees the marker
+     and refuses to serve (clean exit), preventing the split-brain a
+     revived Mongo primary avoids via election terms.
+  3. **promote** — the replica directory is a valid store directory, so
+     the standby opens it writable and starts the FULL API server on its
+     own port: the new primary.
+
+Clients pass ``failover=`` to :class:`~learningorchestra_tpu.client.Context`
+and retry once against the standby address on connection failure — the
+driver-side half of Mongo's automatic server re-discovery.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+import urllib.error
+import urllib.request
+from datetime import datetime, timezone
+from pathlib import Path
+
+from learningorchestra_tpu.log import get_logger
+from learningorchestra_tpu.store.replica import WalReplica
+
+log = get_logger("lo.ha")
+
+#: Marker file a promotion writes into the OLD primary's store dir.
+FENCE_FILE = ".fenced"
+
+
+def is_fenced(store_root: str | Path) -> dict | None:
+    """Return the fence record if ``store_root`` was fenced by a
+    promotion, else None.  ``serve`` checks this at startup so a
+    supervisor-restarted old primary exits instead of split-braining."""
+    path = Path(store_root) / FENCE_FILE
+    if not path.exists():
+        return None
+    try:
+        return json.loads(path.read_text())
+    except ValueError:
+        return {"reason": "unreadable fence marker"}
+
+
+class StandbyMonitor:
+    """Ship WALs from a primary and decide when to take over."""
+
+    def __init__(
+        self,
+        primary_addr: str,
+        primary_store: str | Path,
+        replica_root: str | Path,
+        *,
+        check_interval: float = 0.5,
+        max_misses: int = 4,
+        probe_timeout: float = 1.0,
+        new_primary_addr: str = "",
+    ):
+        self.primary_addr = primary_addr
+        self.primary_store = Path(primary_store)
+        self.replica = WalReplica(primary_store, replica_root)
+        self.check_interval = check_interval
+        self.max_misses = max_misses
+        self.probe_timeout = probe_timeout
+        self.new_primary_addr = new_primary_addr
+        self.misses = 0
+
+    def probe(self) -> bool:
+        """One /health round-trip: is the primary PROCESS alive?
+
+        ANY HTTP response — including the gateway's 503 backpressure
+        when ``max_inflight`` is saturated — proves a live process
+        still serving its store; only connection-level failure
+        (refused/reset/timeout) counts as a miss.  Promoting over a
+        merely-saturated primary would split-brain the cluster.
+        """
+        url = (
+            f"http://{self.primary_addr}/api/learningOrchestra/v1/health"
+        )
+        try:
+            with urllib.request.urlopen(
+                url, timeout=self.probe_timeout
+            ):
+                return True
+        except urllib.error.HTTPError:
+            return True  # it answered: alive
+        except (urllib.error.URLError, OSError, TimeoutError):
+            return False
+
+    def step(self) -> bool:
+        """One monitor iteration: sync, probe, count misses.
+
+        Returns True when the takeover threshold is reached.  Sync
+        happens BEFORE the probe so the replication lag at the moment
+        of a detected death is one interval, not two.
+        """
+        try:
+            self.replica.sync()
+        except OSError as exc:
+            # A vanishing primary directory is itself a failure signal;
+            # keep probing — the health check decides.
+            log.warning(f"standby sync error: {exc}")
+        if self.probe():
+            self.misses = 0
+            return False
+        self.misses += 1
+        log.warning(
+            f"primary {self.primary_addr} missed health check "
+            f"({self.misses}/{self.max_misses})"
+        )
+        return self.misses >= self.max_misses
+
+    def run_until_takeover(self) -> Path:
+        """Block until the primary is declared dead, then promote.
+
+        Returns the replica root, now fenced-off from the old primary
+        and ready to open as the new system-of-record.
+        """
+        while not self.step():
+            time.sleep(self.check_interval)
+        return self.promote()
+
+    def promote(self) -> Path:
+        """Final-sync, fence the old primary, hand over the directory."""
+        try:
+            shipped = self.replica.sync()
+        except OSError:
+            shipped = {}
+        self._write_fence()
+        total = sum(shipped.values())
+        log.info(
+            f"promoted replica {self.replica.replica_root} "
+            f"(final sync shipped {total} bytes)"
+        )
+        return self.replica.replica_root
+
+    def _write_fence(self) -> None:
+        record = {
+            "promoted_to": self.new_primary_addr,
+            "replica_root": str(self.replica.replica_root),
+            "at": datetime.now(timezone.utc).isoformat(),
+        }
+        try:
+            self.primary_store.mkdir(parents=True, exist_ok=True)
+            fence = self.primary_store / FENCE_FILE
+            fence.write_text(json.dumps(record))
+        except OSError as exc:
+            # The primary's disk may be gone entirely — promotion must
+            # still proceed; the fence is best-effort protection for the
+            # shared-filesystem deployment where a restart CAN race us.
+            log.warning(f"could not fence old primary: {exc}")
+
+
+def run_standby(
+    primary_addr: str,
+    primary_store: str | Path,
+    replica_root: str | Path,
+    port: int,
+    *,
+    check_interval: float = 0.5,
+    max_misses: int = 4,
+    host: str = "0.0.0.0",
+) -> None:
+    """The ``standby`` CLI role: monitor, then become the API server.
+
+    Blocks forever: first in the monitor loop, then — after promotion —
+    serving the full REST API over the promoted directory on ``port``.
+    """
+    # Pay the heavy server import while the primary is still healthy —
+    # takeover latency must be probe-bound, not import-bound.
+    from learningorchestra_tpu.api.server import APIServer
+    from learningorchestra_tpu.config import Config, set_config
+
+    # The advertised address lands in the fence record and the fenced
+    # primary's operator guidance — a bind-all wildcard is useless
+    # there, so substitute the host's name.
+    advertised_host = (
+        socket.gethostname() if host in ("0.0.0.0", "::") else host
+    )
+
+    def become_primary(promoted: Path) -> None:
+        config = Config.from_env()
+        config.store.root = str(promoted)
+        config.api.port = port
+        set_config(config)  # services resolving get_config() must agree
+        APIServer(config).serve_forever(host=host, port=port)
+
+    fence = is_fenced(primary_store)
+    if fence is not None:
+        # The old primary is already fenced.  If WE fenced it (same
+        # replica root), this is a standby RESTART after promotion: the
+        # replica dir is the current system of record — syncing from
+        # the dead primary again would classify our own post-failover
+        # WAL growth as a rewrite and roll it back.  Serve immediately.
+        if Path(fence.get("replica_root", "")).resolve() == (
+            Path(replica_root).resolve()
+        ):
+            log.info(
+                "store already promoted to this replica — resuming as "
+                "primary without re-sync"
+            )
+            become_primary(Path(replica_root))
+            return
+        raise SystemExit(
+            f"{primary_store} is fenced in favor of "
+            f"{fence.get('replica_root')!r} (promoted_to="
+            f"{fence.get('promoted_to')!r}) — refusing to stand by for "
+            "a dead primary; re-point --primary/--primary-store at the "
+            "current one."
+        )
+
+    monitor = StandbyMonitor(
+        primary_addr,
+        primary_store,
+        replica_root,
+        check_interval=check_interval,
+        max_misses=max_misses,
+        new_primary_addr=f"{advertised_host}:{port}",
+    )
+    log.info(
+        f"standby shipping {primary_store} -> {replica_root}, "
+        f"watching http://{primary_addr}/health"
+    )
+    become_primary(monitor.run_until_takeover())
