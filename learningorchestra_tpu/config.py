@@ -80,6 +80,12 @@ class JobConfig:
     # Reference Ray placement-group timeout
     # (binary_executor_image/server.py:16).
     start_timeout_s: float = 120.0
+    # Weighted-fair dispatch weights per job class (service type) —
+    # the reference's fairscheduler pool weights (fairscheduler.xml).
+    # Unlisted classes weigh 1; weights are consecutive dispatches per
+    # round-robin turn, so {"train": 2} gives training twice the share
+    # under contention.  Env: LO_TPU_JOB_WEIGHTS='{"train": 2}'.
+    class_weights: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -176,6 +182,13 @@ class Config:
             )
         if "LO_TPU_MAX_WORKERS" in env:
             cfg.jobs.max_workers = int(env["LO_TPU_MAX_WORKERS"])
+        if "LO_TPU_JOB_WEIGHTS" in env:
+            import json as _json
+
+            cfg.jobs.class_weights = {
+                str(k): int(v)
+                for k, v in _json.loads(env["LO_TPU_JOB_WEIGHTS"]).items()
+            }
         if "LO_TPU_TASK_COORDINATOR" in env:
             cfg.dist.task_coordinator = env["LO_TPU_TASK_COORDINATOR"]
         if "LO_TPU_JAX_COORDINATOR" in env:

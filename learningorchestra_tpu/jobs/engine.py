@@ -14,7 +14,14 @@ This engine keeps that durable contract but adds what the reference lacks
   polling the store;
 - structured retry for preemptible hardware: a job function may raise
   ``Preempted`` to request re-execution (TPU preemption is a first-class
-  event, not a crash).
+  event, not a crash);
+- weighted-fair scheduling across job CLASSES (classes = service types),
+  the reference's Spark FAIR scheduler pools (reference:
+  builder_image/fairscheduler.xml:1-7, projection_image/server.py:51-69
+  assign each service a pool so one service's burst can't monopolise
+  executors).  Submissions enqueue per class; freed workers are handed
+  to classes by weighted round-robin, so a ``function`` flood cannot
+  queue-starve a training submission.
 """
 
 from __future__ import annotations
@@ -23,6 +30,7 @@ import io
 import threading
 import time
 import traceback
+from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable
 
@@ -50,8 +58,10 @@ class JobEngine:
         artifacts: ArtifactStore,
         max_workers: int = 8,
         max_preemption_retries: int = 3,
+        class_weights: dict[str, int] | None = None,
     ):
         self.artifacts = artifacts
+        self.max_workers = max_workers
         self.pool = ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix="lo-job"
         )
@@ -59,6 +69,17 @@ class JobEngine:
         self._futures: dict[str, Future] = {}
         self._last_tracebacks: dict[str, str] = {}
         self._lock = threading.Lock()
+        # Weighted-fair dispatch state: per-class FIFO queues served by
+        # weighted round-robin as workers free up.  A class's weight is
+        # how many consecutive dispatches it gets per turn (default 1 —
+        # equal shares, the reference fairscheduler's FAIR default).
+        self.class_weights = dict(class_weights or {})
+        self._queues: dict[str, deque] = {}
+        self._rr_order: list[str] = []
+        self._rr_idx = 0
+        self._credits: dict[str, int] = {}
+        self._inflight = 0
+        self._shutdown = False
         # Optional push-notification sink (services/webhooks.py): set
         # by the service context; completion paths call _notify.
         self.notifier = None
@@ -86,6 +107,7 @@ class JobEngine:
         parameters: Any = None,
         capture_stdout: bool = False,
         on_success: Callable[[Any], dict | None] | None = None,
+        job_class: str = "default",
     ) -> Future:
         """Run ``fn`` asynchronously as the job for artifact ``name``.
 
@@ -95,6 +117,10 @@ class JobEngine:
 
         ``on_success(result)`` may return extra metadata fields to merge into
         the finished metadata doc (e.g. result row counts, checkpoint paths).
+
+        ``job_class`` is the fairness pool (services pass their service
+        type): queued work is dispatched to freed workers by weighted
+        round-robin across classes, not global FIFO.
         """
 
         def run() -> Any:
@@ -179,11 +205,87 @@ class JobEngine:
                 self._notify(name, "finished")
                 return result
 
-        future = self.pool.submit(run)
+        future: Future = Future()
         with self._lock:
+            if self._shutdown:
+                # Same contract as handing the job to a shut-down
+                # executor (the pre-fairness behavior).
+                raise RuntimeError(
+                    "cannot submit jobs after engine shutdown"
+                )
+            queue = self._queues.get(job_class)
+            if queue is None:
+                queue = self._queues[job_class] = deque()
+                self._rr_order.append(job_class)
+                self._credits[job_class] = self._weight(job_class)
+            queue.append((run, future))
             self._futures[name] = future
             self._prune_locked()
+            self._dispatch_locked()
         return future
+
+    # -- weighted-fair dispatch ----------------------------------------------
+
+    def _weight(self, job_class: str) -> int:
+        return max(1, int(self.class_weights.get(job_class, 1)))
+
+    def _dispatch_locked(self) -> None:
+        """Hand freed workers to queued jobs, class by class (WRR)."""
+        while self._inflight < self.max_workers:
+            item = self._pick_locked()
+            if item is None:
+                return
+            runner, future = item
+            if not future.set_running_or_notify_cancel():
+                continue  # cancelled while queued — skip, pick again
+            self._inflight += 1
+            self.pool.submit(self._run_dispatched, runner, future)
+
+    def _pick_locked(self):
+        """Next queued job under weighted round-robin.
+
+        The pointer stays on a class while it has queued work AND
+        remaining credits (its weight's worth of consecutive
+        dispatches), then refills that class's credits and advances —
+        so over any contention window each class with work receives
+        dispatches proportional to its weight.
+        """
+        # Jobs cancelled while queued are discarded without charging
+        # their class's credits — a burst of cancellations must not
+        # burn the class's turn.  cancel() runs under the same lock,
+        # so cancelled() is stable here.
+        for queue in self._queues.values():
+            while queue and queue[0][1].cancelled():
+                queue.popleft()
+        if not any(self._queues.values()):
+            return None
+        # Two full passes bound the scan: the first may only refill
+        # exhausted credits, the second must then land on a nonempty
+        # class with fresh credits.
+        for _ in range(2 * len(self._rr_order)):
+            cls = self._rr_order[self._rr_idx % len(self._rr_order)]
+            queue = self._queues[cls]
+            while queue and queue[0][1].cancelled():
+                queue.popleft()
+            if queue and self._credits.get(cls, 0) > 0:
+                self._credits[cls] -= 1
+                return queue.popleft()
+            self._credits[cls] = self._weight(cls)
+            self._rr_idx += 1
+        return None
+
+    def _run_dispatched(self, runner, future: Future) -> None:
+        try:
+            result = runner()
+        except BaseException as exc:  # pragma: no cover — run() is
+            # exception-safe by construction; never leak a worker.
+            future.set_exception(exc)
+        else:
+            future.set_result(result)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                self._dispatch_locked()
 
     # Cap retained completed futures/tracebacks so a long-lived API process
     # doesn't accumulate every past job's result object.
@@ -237,4 +339,22 @@ class JobEngine:
             return [n for n, f in self._futures.items() if not f.done()]
 
     def shutdown(self, wait: bool = True) -> None:
+        with self._lock:
+            self._shutdown = True
+            # Flush every still-queued job into the executor in fair
+            # order before shutting it down: the executor's worker
+            # count still caps concurrency, and shutdown(wait=True)
+            # must run every accepted job — exactly the pre-fairness
+            # contract, where submit() handed jobs straight to the
+            # pool.  Without this, jobs queued above max_workers would
+            # be orphaned with their metadata stuck at "pending".
+            while True:
+                item = self._pick_locked()
+                if item is None:
+                    break
+                runner, future = item
+                if not future.set_running_or_notify_cancel():
+                    continue
+                self._inflight += 1
+                self.pool.submit(self._run_dispatched, runner, future)
         self.pool.shutdown(wait=wait)

@@ -217,7 +217,8 @@ class BuilderService:
             extra={"classifiers": classifiers, "hidden": True},
         )
         self.ctx.engine.submit(
-            coordinator, run_all, description=description or "builder run"
+            coordinator, run_all, description=description or "builder run",
+            job_class="builder",
         )
         return metas
 

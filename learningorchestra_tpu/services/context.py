@@ -50,7 +50,9 @@ class ServiceContext:
         self.artifacts = ArtifactStore(self.documents)
         self.volumes = VolumeStorage(self.config.store.volume_path())
         self.engine = JobEngine(
-            self.artifacts, max_workers=self.config.jobs.max_workers
+            self.artifacts,
+            max_workers=self.config.jobs.max_workers,
+            class_weights=self.config.jobs.class_weights,
         )
         self.loader = StoreLoader(self)
         from learningorchestra_tpu.services.webhooks import (

@@ -209,6 +209,7 @@ class DatasetService:
             ingest,
             description=f"csv ingest from {url}",
             on_success=lambda r: r,
+            job_class="dataset",
         )
         return meta
 
@@ -543,6 +544,7 @@ class DatasetService:
             ingest,
             description=f"tensor ingest from {url}",
             on_success=lambda r: r,
+            job_class="dataset",
         )
         return meta
 
@@ -595,6 +597,7 @@ class DatasetService:
             ingest,
             description=f"generic ingest from {url}",
             on_success=lambda r: r,
+            job_class="dataset",
         )
         return meta
 

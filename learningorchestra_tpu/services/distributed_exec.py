@@ -282,6 +282,7 @@ class DistributedExecutorService:
             method="fit",
             parameters=_json_safe(training_parameters),
             on_success=lambda extra: extra,
+            job_class="distributed",
         )
 
     # trainingParameters the cluster path can ship to agents: arrays go
@@ -435,6 +436,7 @@ class DistributedExecutorService:
             method="fit",
             parameters=_json_safe(training_parameters),
             on_success=lambda extra: extra,
+            job_class="distributed",
         )
 
     # -- distributed builder --------------------------------------------------
@@ -499,5 +501,6 @@ class DistributedExecutorService:
             method=fn_name,
             parameters=_json_safe(function_parameters),
             on_success=lambda extra: extra,
+            job_class="distributed",
         )
         return meta

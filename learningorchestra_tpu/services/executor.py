@@ -219,6 +219,7 @@ class ExecutorService:
             method=method,
             parameters=_json_safe(method_parameters),
             on_success=lambda extra: extra,
+            job_class="executor",
         )
 
     def _store_result_rows(self, name: str, result: Any) -> None:
@@ -397,6 +398,7 @@ class ExecutorService:
             name, run, description=description or f"grid search {parent_name}",
             method=method, parameters=_json_safe(param_grid),
             on_success=lambda extra: extra,
+            job_class="executor",
         )
         return meta
 

@@ -60,6 +60,7 @@ class ExploreService:
         self.ctx.engine.submit(
             name, run, description=f"histogram of {parent_name}.{fields}",
             on_success=lambda r: r,
+            job_class="explore",
         )
         return meta
 
@@ -145,6 +146,7 @@ class ExploreService:
             name, run,
             description=f"training curves of {parent_name}",
             on_success=lambda r: r,
+            job_class="explore",
         )
 
     def _save_png(self, fig, name: str, artifact_type: str):
@@ -287,6 +289,7 @@ class ExploreService:
             name, run, description=description or f"{class_name} plot",
             method=method, parameters=method_parameters,
             on_success=lambda r: r,
+            job_class="explore",
         )
 
     def _render_scatter(self, name, artifact_type, points, colors=None):

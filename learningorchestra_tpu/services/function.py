@@ -107,6 +107,7 @@ class FunctionService:
         self.ctx.engine.submit(
             name, run, description=description or "python function",
             capture_stdout=False,
+            job_class="function",
         )
 
     def delete(self, name: str) -> None:

@@ -82,6 +82,7 @@ class ModelService:
         self.ctx.engine.submit(
             name, run, description=description or f"instantiate {name}",
             parameters=class_parameters,
+            job_class="model",
         )
 
     def delete(self, name: str) -> None:

@@ -142,6 +142,7 @@ class TransformService:
             name, project, description=f"projection of {parent_name}",
             parameters={"fields": fields},
             on_success=lambda r: r,
+            job_class="transform",
         )
 
     # -- dtype casting --------------------------------------------------------
@@ -195,6 +196,7 @@ class TransformService:
         self.ctx.engine.submit(
             parent_name, cast, description=f"dtype cast {fields}",
             on_success=lambda r: r,
+            job_class="transform",
         )
         return self.ctx.artifacts.metadata.read(parent_name)
 
@@ -500,6 +502,7 @@ class TransformService:
             name, tokenize,
             description=f"BPE tokenization of {parent_name}.{text_field}",
             on_success=lambda r: r,
+            job_class="transform",
         )
 
     # -- generic transform (registry class + method) --------------------------
@@ -600,4 +603,5 @@ class TransformService:
         self.ctx.engine.submit(
             name, run, description=description or f"{class_name}.{method}",
             method=method, parameters=method_parameters,
+            job_class="transform",
         )

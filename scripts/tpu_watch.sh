@@ -37,6 +37,7 @@ STAGES=(
   "scripts/tpu_validate_r3.py:2700"
   "scripts/bert_mfu_sweep.py:5400"
   "scripts/resnet_mfu_sweep.py:3600"
+  "bench.py:3600"
 )
 declare -A DONE
 declare -A FAILS
