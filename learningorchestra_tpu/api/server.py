@@ -207,8 +207,147 @@ class APIServer:
             threading.BoundedSemaphore(n_inflight)
             if n_inflight > 0 else None
         )
+        import time as _time
+
+        self._t_start = _time.time()
 
     # -- helpers --------------------------------------------------------------
+
+    def _render_status(self) -> str:
+        """The ops status page body: agents, leases, jobs, fairness
+        queues, and recent events, rendered server-side from state the
+        process already holds — no polling scripts, a meta-refresh
+        keeps it live in a browser during bring-up."""
+        import html as _html
+        import time as _time
+
+        esc = _html.escape
+
+        def table(headers, rows):
+            head = "".join(f"<th>{esc(str(h))}</th>" for h in headers)
+            body = "".join(
+                "<tr>" + "".join(
+                    f"<td>{esc(str(c))}</td>" for c in row
+                ) + "</tr>"
+                for row in rows
+            )
+            return (f"<table><thead><tr>{head}</tr></thead>"
+                    f"<tbody>{body or ''}</tbody></table>")
+
+        sections: list[str] = []
+
+        # -- cluster agents (coordinator fetch, cluster mode only) ----
+        coord = self.config.dist.task_coordinator
+        if coord:
+            try:
+                import urllib.request as _rq
+
+                with _rq.urlopen(
+                    f"http://{coord}/agents", timeout=2
+                ) as resp:
+                    agents = json.loads(resp.read()).get("agents", {})
+                rows = [
+                    (aid, a.get("capacity", ""), a.get("in_use", ""),
+                     f"{_time.time() - a.get('last_seen', 0):.1f}s ago"
+                     if a.get("last_seen") else "never")
+                    for aid, a in sorted(agents.items())
+                ]
+                sections.append(
+                    f"<h2>Agents ({len(rows)})</h2>"
+                    + table(("agent", "capacity", "in use",
+                             "heartbeat"), rows)
+                )
+            except Exception as exc:  # noqa: BLE001 — page must render
+                sections.append(
+                    f"<h2>Agents</h2><p class=err>coordinator "
+                    f"{esc(coord)} unreachable: {esc(repr(exc))}</p>"
+                )
+        else:
+            sections.append(
+                "<h2>Agents</h2><p>in-process mode "
+                "(no task coordinator configured)</p>"
+            )
+
+        # -- chip leases (snapshot never forces device discovery:
+        # that could block on remote hardware) -------------------------
+        snap = self.ctx.leaser.snapshot()
+        free, all_devs, recent = snap["free"], snap["all"], snap["recent"]
+        if snap["initialized"]:
+            sections.append(
+                f"<h2>Device leases</h2><p>{len(free)}/{len(all_devs)}"
+                f" free — {esc(', '.join(all_devs) or 'cpu (no-op)')}"
+                "</p>"
+                + table(
+                    ("job", "device", "held"),
+                    [(label, dev, f"{t1 - t0:.2f}s")
+                     for label, dev, t0, t1 in recent],
+                )
+            )
+        else:
+            sections.append(
+                "<h2>Device leases</h2><p>no lease taken yet "
+                "(device discovery is lazy)</p>"
+            )
+
+        # -- jobs: running + queued per fairness class ----------------
+        running = self.ctx.engine.running_jobs()
+        rows = []
+        for name in running[:50]:
+            meta = self.ctx.artifacts.metadata.read(name) or {}
+            rows.append((name, meta.get("type", ""),
+                         meta.get("jobState", "")))
+        depths = self.ctx.engine.queue_depths()
+        sections.append(
+            f"<h2>Jobs ({len(running)} live)</h2>"
+            + table(("artifact", "type", "state"), rows)
+            + ("<p>queued per class: " + esc(json.dumps(depths))
+               + "</p>" if depths else "")
+        )
+
+        # -- recent events, failures highlighted ----------------------
+        events = self.ctx.webhooks.latest_events(20)
+        ev_rows = "".join(
+            "<tr class={cls}><td>{ts}</td><td>{name}</td>"
+            "<td>{event}</td><td>{typ}</td></tr>".format(
+                cls="err" if e.get("event") == "failed" else "ok",
+                ts=_time.strftime(
+                    "%H:%M:%S", _time.localtime(e.get("ts", 0))
+                ),
+                name=esc(str(e.get("artifact", ""))),
+                event=esc(str(e.get("event", ""))),
+                typ=esc(str(e.get("artifactType") or "")),
+            )
+            for e in reversed(events)
+        )
+        sections.append(
+            "<h2>Recent events</h2><table><thead><tr><th>time</th>"
+            "<th>artifact</th><th>event</th><th>type</th></tr></thead>"
+            f"<tbody>{ev_rows}</tbody></table>"
+        )
+
+        uptime = _time.time() - self._t_start
+        return (
+            "<!doctype html><html><head>"
+            "<title>learningorchestra_tpu status</title>"
+            '<meta http-equiv="refresh" content="5">'
+            "<style>"
+            "body{font-family:system-ui,sans-serif;margin:2em;"
+            "color:#222}"
+            "table{border-collapse:collapse;margin:0.5em 0}"
+            "td,th{border:1px solid #ccc;padding:4px 10px;"
+            "text-align:left;font-size:14px}"
+            "th{background:#f0f0f0}"
+            "tr.err td{background:#fde8e8}"
+            ".err{color:#b00}"
+            "h2{margin-top:1.2em;font-size:16px}"
+            "</style></head><body>"
+            "<h1>learningorchestra_tpu</h1>"
+            f"<p>uptime {uptime:.0f}s — store backend "
+            f"{type(self.ctx.documents).__name__} — "
+            f"{len(running)} live jobs</p>"
+            + "".join(sections)
+            + "</body></html>"
+        )
 
     def _uri(self, service_path: str, name: str) -> str:
         return f"{self.config.api.api_prefix}/{service_path}/{name}"
@@ -967,6 +1106,16 @@ class APIServer:
         # Per-route request counts/latencies — the krakend :8090
         # metrics exporter's role (SURVEY §5.1).
         add("GET", r"/metrics", metrics_view)
+
+        # ---- Ops status page (the reference's Portainer GUI role,
+        # reference: docker-compose.yml:102-129): one human-readable
+        # HTML view over the JSON the system already exposes — jobs,
+        # fairness queues, chip leases, cluster agents, recent events.
+        def status_view(m, body, query):
+            return 200, ("text/html; charset=utf-8",
+                         self._render_status().encode())
+
+        add("GET", r"/status", status_view)
 
     # -- HTTP plumbing --------------------------------------------------------
 

@@ -326,8 +326,14 @@ class JobEngine:
         same as the reference, where a running job dies only with its
         container; SURVEY §5.3)."""
         with self._lock:
+            # future.cancel() under the engine lock: the dispatcher's
+            # cancelled() checks in _pick_locked run under the same
+            # lock, so a cancellation can never land between a queue
+            # pop and its dispatch — the no-credit-burn guarantee
+            # depends on this.
             future = self._futures.get(name)
-        if future is not None and future.cancel():
+            cancelled = future is not None and future.cancel()
+        if cancelled:
             self.artifacts.metadata.update(
                 name, {"jobState": JobState.CANCELLED, "finished": False}
             )
@@ -337,6 +343,14 @@ class JobEngine:
     def running_jobs(self) -> list[str]:
         with self._lock:
             return [n for n, f in self._futures.items() if not f.done()]
+
+    def queue_depths(self) -> dict[str, int]:
+        """Queued-but-undispatched jobs per class (the fairness pools) —
+        the ops status page's contention gauge."""
+        with self._lock:
+            return {
+                cls: len(q) for cls, q in self._queues.items() if q
+            }
 
     def shutdown(self, wait: bool = True) -> None:
         with self._lock:

@@ -81,6 +81,18 @@ class DeviceLeaser:
             self._ensure_devices()
             return len(self._all)
 
+    def snapshot(self) -> dict:
+        """Lock-consistent view for dashboards: does NOT force device
+        discovery (``initialized`` False until the first lease), since
+        discovery may block on remote hardware."""
+        with self._cv:
+            return {
+                "initialized": self._free is not None,
+                "free": list(self._free or ()),
+                "all": list(self._all),
+                "recent": list(self.history)[-10:],
+            }
+
     @contextlib.contextmanager
     def lease(
         self,

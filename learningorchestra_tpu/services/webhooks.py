@@ -193,6 +193,20 @@ class WebhookNotifier:
         except Exception:  # noqa: BLE001
             pass
 
+    def latest_events(self, n: int = 20) -> list[dict]:
+        """The NEWEST ``n`` events, oldest first — for dashboards.
+        (``events()`` pages forward from a cursor; its cap would pin a
+        long-lived server's view to the first 1000 records.)"""
+        try:
+            total = self.documents.count(EVENTS_COLLECTION)
+            if not total:
+                return []
+            return self.documents.find(
+                EVENTS_COLLECTION, skip=max(0, total - n), limit=n
+            )
+        except NoSuchCollection:
+            return []
+
     def events(self, since_id: int = -1, limit: int = 100) -> list[dict]:
         """Events with ``_id > since_id``, oldest first, at most
         ``limit`` — poll with the last seen ``_id`` as the cursor.
