@@ -102,7 +102,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.lods_csv_parse.restype = buf_t
     lib.lods_csv_numeric_chunk.argtypes = [
         c_char_p, i64, ctypes.c_int, i64,
-        ctypes.POINTER(ctypes.c_double), i64, p_i64, p_i64,
+        ctypes.POINTER(ctypes.c_double), i64, p_i64, p_i64, p_i64,
     ]
     lib.lods_csv_numeric_chunk.restype = i64
     lib.lods_project.argtypes = [i64, c_char_p, c_char_p, c_char_p]
@@ -150,14 +150,19 @@ def _dumps(doc: dict) -> bytes:
 
 
 def csv_numeric_chunk(data: bytes, ncols: int, *, is_final: bool,
-                      bad_counts, max_rows: int | None = None):
+                      bad_counts, float_counts=None,
+                      max_rows: int | None = None):
     """Numeric CSV records → ((rows, ncols) float64 array, consumed).
 
     Only complete newline-terminated records are consumed unless
     ``is_final``; feed ``data[consumed:]`` + the next read back in.
     ``bad_counts`` is a caller-owned int64 array of length ``ncols``
     accumulating non-empty-unparseable cell counts across chunks (the
-    "column is not numeric" contract check happens at close)."""
+    "column is not numeric" contract check happens at close).
+    ``float_counts`` (same shape, optional) accumulates FLOAT-FORMATTED
+    cell counts — "5.0"/"1e3"/int64-overflow — so the sharded writer
+    can type columns by text format exactly like the Python row path's
+    ``_infer`` (a column is int only if every cell is int-formatted)."""
     import numpy as np
 
     lib = load_library()
@@ -175,6 +180,8 @@ def csv_numeric_chunk(data: bytes, ncols: int, *, is_final: bool,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
         max_rows,
         bad_counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        (float_counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+         if float_counts is not None else None),
         ctypes.byref(consumed),
     )
     if rows < 0:

@@ -123,6 +123,12 @@ class ServiceContext:
         # Reserved: these segments are fixed observe sub-routes
         # (GET /observe/events, POST /observe/webhook); an artifact so
         # named would be silently shadowed off the observe long-poll.
+        # MIGRATION CAVEAT (ADVICE r3): a store that predates this
+        # gate may already hold an artifact named "events"/"webhook";
+        # its /observe/<name> long-poll and per-artifact webhook routes
+        # are permanently shadowed by the fixed routes.  Rename such
+        # artifacts before upgrading (the data itself remains readable
+        # via the service GET routes, which are not shadowed).
         if name in ("events", "webhook"):
             raise ValidationError(
                 f"artifact name {name!r} is reserved (observe route)"

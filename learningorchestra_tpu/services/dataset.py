@@ -354,10 +354,11 @@ class DatasetService:
         above is the fallback, same contract).  Parity notes: short
         rows pad NaN, empty cells are NaN, a column with any non-empty
         unparseable cell fails the job exactly like the row path's
-        "column is not numeric"; the one deliberate divergence is that
-        a float-typed column of integral VALUES (e.g. "5.0") stores
-        int32 here (value-based narrowing) where the text path keeps
-        float32.
+        "column is not numeric", and dtype inference is FORMAT-based in
+        both paths — the parser reports per-column float-formatted-cell
+        counts, so "5.0" stays float32 here exactly as ``_infer`` keeps
+        it in the row path (a model's loss selection must not depend on
+        which ingest engine ran — ADVICE r3).
         """
         try:
             from learningorchestra_tpu import native
@@ -416,16 +417,20 @@ class DatasetService:
                         root, fields, rows_per_shard=shard_rows
                     )
                     bad = np.zeros(len(fields), np.int64)
+                    ffmt = np.zeros(len(fields), np.int64)
                     buf = buf[nl + 1:]
                 while len(buf) >= self._NATIVE_CHUNK or (final and buf):
                     block, consumed = native.csv_numeric_chunk(
-                        buf, len(fields), is_final=final, bad_counts=bad
+                        buf, len(fields), is_final=final,
+                        bad_counts=bad, float_counts=ffmt,
                     )
                     if consumed == 0:
                         # One record longer than the buffer: read more.
                         break
                     if len(block):
-                        writer.append_block(block)
+                        writer.append_block(
+                            block, float_format_cols=ffmt > 0
+                        )
                         n_rows += len(block)
                     buf = buf[consumed:]
                 if final and not buf:

@@ -71,6 +71,10 @@ class ShardedDatasetWriter:
         self._block_rows = 0
         self._shard_rows: list[int] = []
         self._dtypes: dict[str, np.dtype] = {}
+        # Per-field "saw float-FORMATTED text" flags for block mode:
+        # the caller's parser (native CSV) reports them so both ingest
+        # paths type columns by text format, not value (ADVICE r3).
+        self._float_format = np.zeros(len(self.fields), bool)
         self._closed = False
 
     def append(self, row: list) -> None:
@@ -87,13 +91,19 @@ class ShardedDatasetWriter:
         if len(self._buf) >= self.rows_per_shard:
             self._flush()
 
-    def append_block(self, block) -> None:
+    def append_block(self, block, float_format_cols=None) -> None:
         """Bulk append a ``(n, n_fields)`` float64 array (the native
-        CSV parser's output) — no per-row Python objects.  Integral
-        columns narrow back to int32 at flush, mirroring what
-        ``append``'s ``np.asarray`` inference does for int rows.  Row
-        and block modes don't mix on one writer (ordering would
-        interleave wrongly)."""
+        CSV parser's output) — no per-row Python objects.  Row and
+        block modes don't mix on one writer (ordering would interleave
+        wrongly).
+
+        ``float_format_cols`` (len-``n_fields`` bool mask) marks
+        columns whose TEXT was float-formatted somewhere in this block
+        ("5.0", "1e3"): they stay float32 even when every value is
+        integral, matching the row path's ``_infer`` semantics exactly
+        — training-loss selection must not depend on which ingest
+        engine ran (ADVICE r3).  Without the mask, integral columns
+        narrow by value (the pre-r4 behavior)."""
         if self._buf:
             raise RuntimeError("append_block after append: pick one")
         block = np.asarray(block, np.float64)
@@ -101,6 +111,8 @@ class ShardedDatasetWriter:
             raise ValueError(
                 f"block shape {block.shape} != (n, {len(self.fields)})"
             )
+        if float_format_cols is not None:
+            self._float_format |= np.asarray(float_format_cols, bool)
         self._blocks.append(block)
         self._block_rows += len(block)
         while self._block_rows >= self.rows_per_shard:
@@ -130,11 +142,14 @@ class ShardedDatasetWriter:
         cols = {}
         for i, field in enumerate(self.fields):
             arr = rows[:, i]
-            # Mirror the row path's dtype inference: a column of
-            # integral finite values stores int32; anything else f32.
-            if np.all(np.isfinite(arr)) and np.all(
-                arr == np.floor(arr)
-            ) and np.all(np.abs(arr) < 2**31):
+            # Mirror the row path's dtype inference: int32 only when
+            # no cell was float-FORMATTED (mask from the parser) and
+            # the values are integral, finite, and int32-safe.
+            if (not self._float_format[i]) and np.all(
+                np.isfinite(arr)
+            ) and np.all(arr == np.floor(arr)) and np.all(
+                arr >= -(2**31)  # INT32_MIN is representable
+            ) and np.all(arr < 2**31):
                 arr = arr.astype(np.int32)
             else:
                 arr = arr.astype(np.float32)
@@ -162,7 +177,15 @@ class ShardedDatasetWriter:
                     f"(dtype {arr.dtype}); cast or project it away "
                     "before sharded ingest"
                 )
-            arr = arr.astype(_narrow(arr.dtype))
+            if np.issubdtype(arr.dtype, np.integer) and arr.size and (
+                arr.max() >= 2**31 or arr.min() < -(2**31)
+            ):
+                # int64 values beyond int32 must not wrap silently on
+                # the narrowing cast; degrade to float32 like the
+                # block path's int32-safety check.
+                arr = arr.astype(np.float32)
+            else:
+                arr = arr.astype(_narrow(arr.dtype))
             cols[field] = arr
             prev = self._dtypes.get(field)
             if prev is None:

@@ -225,6 +225,7 @@ class EarlyStopping:
         self.best_params = None
         self.best_epoch = None
         self.wait = 0
+        self._warned_missing = False
 
     @classmethod
     def from_spec(cls, spec) -> "EarlyStopping":
@@ -258,7 +259,17 @@ class EarlyStopping:
     def __call__(self, epoch: int, metrics: dict, model) -> None:
         name, minimize = self._resolve(metrics)
         if name not in metrics:
-            return  # e.g. val_loss requested but no validation ran
+            # e.g. val_loss requested but no validation ran.  Warn once
+            # (keras parity): a silent no-op reads as a broken callback
+            # when training then runs every epoch (ADVICE r3).
+            if not self._warned_missing:
+                self._warned_missing = True
+                _train_logger().warning(
+                    "EarlyStopping monitor %r not in metrics %s — "
+                    "early stopping is inactive this fit",
+                    name, sorted(metrics),
+                )
+            return
         value = float(metrics[name])
         if self.best is None and self.baseline is not None:
             # keras semantics: with a baseline, the first "best" to beat
@@ -1005,20 +1016,26 @@ class NeuralEstimator(Estimator):
                 for cb in callbacks or []:
                     if callable(cb):
                         cb(epoch_i, metrics, self)
-                if checkpoint_dir and self.opt_state is not None \
-                        and ckpt_mod.should_save(
+                if checkpoint_dir and ckpt_mod.should_save(
                             epoch_i, epochs, checkpoint_every,
                             checkpoint_min_interval_s, last_save,
                             stopped=self.stop_training,
                         ):
-                    # restore-best drops opt_state; those params
-                    # persist via the artifact path instead.
                     from learningorchestra_tpu.train import checkpoint as ckpt
 
+                    opt_state = self.opt_state
+                    if opt_state is None:
+                        # restore-best dropped the moments: checkpoint
+                        # the restored params with FRESH moments, else
+                        # resume=True would replay the last periodic
+                        # save's pre-restore params (ADVICE r3).
+                        opt_state = jax.jit(self.optimizer.init)(
+                            self.params
+                        )
                     ckpt.save(
                         checkpoint_dir, epoch_i + 1,
                         {"params": self.params,
-                         "opt_state": self.opt_state},
+                         "opt_state": opt_state},
                         history=dict(self.history),
                         async_save=checkpoint_async,
                     )
@@ -1206,8 +1223,7 @@ class NeuralEstimator(Estimator):
                     for cb in callbacks or []:
                         if callable(cb):
                             cb(epoch_i, metrics, self)
-                    if checkpoint_dir and self.opt_state is not None \
-                            and ckpt_mod.should_save(
+                    if checkpoint_dir and ckpt_mod.should_save(
                                 epoch_i, epochs, checkpoint_every,
                                 checkpoint_min_interval_s, last_save,
                                 stopped=self.stop_training,
@@ -1216,10 +1232,17 @@ class NeuralEstimator(Estimator):
                             checkpoint as ckpt,
                         )
 
+                        opt_state = self.opt_state
+                        if opt_state is None:
+                            # restore-best: fresh moments for the
+                            # restored params (see in-memory loop).
+                            opt_state = jax.jit(self.optimizer.init)(
+                                self.params
+                            )
                         ckpt.save(
                             checkpoint_dir, epoch_i + 1,
                             {"params": self.params,
-                             "opt_state": self.opt_state},
+                             "opt_state": opt_state},
                             history=dict(self.history),
                             async_save=checkpoint_async,
                         )

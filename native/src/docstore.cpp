@@ -1022,7 +1022,7 @@ int64_t lods_project(int64_t h, const char *src_name, const char *dst_name,
 int64_t lods_csv_numeric_chunk(const char *buf, int64_t len, int is_final,
                                int64_t ncols, double *out,
                                int64_t max_rows, int64_t *bad_counts,
-                               int64_t *consumed) {
+                               int64_t *float_counts, int64_t *consumed) {
   if (ncols <= 0 || max_rows < 0) {
     set_error("bad ncols/max_rows");
     return -1;
@@ -1083,6 +1083,28 @@ int64_t lods_csv_numeric_chunk(const char *buf, int64_t len, int is_final,
         if (bad_counts) bad_counts[c]++;
       } else {
         dst[c] = v;
+        if (float_counts) {
+          // Format-based dtype parity with the Python row path
+          // (services/dataset.py::_infer): a cell is INT-formatted
+          // only as [+-]?digits fitting int64 — "5.0", "1e3", and
+          // int64-overflowing digit runs all type their column
+          // float, even when the VALUE is integral.
+          size_t i = 0, m = trimmed.size();
+          if (trimmed[0] == '+' || trimmed[0] == '-') i = 1;
+          bool int_format = i < m;
+          for (size_t j = i; j < m; j++) {
+            if (trimmed[j] < '0' || trimmed[j] > '9') {
+              int_format = false;
+              break;
+            }
+          }
+          if (int_format) {
+            errno = 0;
+            (void)strtoll(trimmed.c_str(), nullptr, 10);
+            if (errno == ERANGE) int_format = false;
+          }
+          if (!int_format) float_counts[c]++;
+        }
       }
     }
     rows++;

@@ -877,6 +877,62 @@ class TestEarlyStopping:
         est.fit(x, y, epochs=2, batch_size=16)
         assert np.isfinite(est.history["loss"][-1])
 
+    def test_restore_best_checkpoint_survives_resume(self, tmp_path):
+        """restore-best early stop must leave the RESTORED params as
+        the latest checkpoint (fresh moments), so resume=True continues
+        from the best snapshot, not the last periodic save's
+        pre-restore params (ADVICE r3)."""
+        import jax
+
+        from learningorchestra_tpu.models.mlp import MLPClassifier
+        from learningorchestra_tpu.train.neural import EarlyStopping
+
+        x, y = self._data()
+        est = MLPClassifier(hidden_layer_sizes=[8], num_classes=2,
+                            learning_rate=0.0)
+        es = EarlyStopping(monitor="loss", patience=1,
+                           restore_best_weights=True)
+        est.fit(x, y, epochs=50, batch_size=16, callbacks=[es],
+                checkpoint_dir=str(tmp_path / "ck"),
+                checkpoint_every=1, checkpoint_min_interval_s=0.0)
+        assert est.stop_training and est.opt_state is None
+        resumed = MLPClassifier(hidden_layer_sizes=[8], num_classes=2,
+                                learning_rate=0.0)
+        resumed.fit(x, y, epochs=len(est.history["loss"]) + 1,
+                    batch_size=16,
+                    checkpoint_dir=str(tmp_path / "ck"), resume=True)
+        # The resumed params trained one lr-0 epoch from the restored
+        # best — identical to the best snapshot.
+        for a, b in zip(jax.tree_util.tree_leaves(est.params),
+                        jax.tree_util.tree_leaves(resumed.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
+
+    def test_absent_monitor_warns_once(self):
+        import logging
+
+        from learningorchestra_tpu.models.mlp import MLPClassifier
+        from learningorchestra_tpu.train.neural import EarlyStopping
+
+        x, y = self._data()
+        est = MLPClassifier(hidden_layer_sizes=[8], num_classes=2)
+        es = EarlyStopping(monitor="val_loss", patience=1)
+        # The framework root logger doesn't propagate (log.py); hook
+        # the component logger directly.
+        records = []
+        handler = logging.Handler()
+        handler.emit = records.append
+        logger = logging.getLogger("lo.train")
+        logger.addHandler(handler)
+        try:
+            est.fit(x, y, epochs=3, batch_size=16, callbacks=[es])
+        finally:
+            logger.removeHandler(handler)
+        hits = [r for r in records
+                if "EarlyStopping monitor" in r.getMessage()]
+        assert len(hits) == 1  # once, not every epoch
+        assert len(est.history["loss"]) == 3  # ran all epochs
+
     def test_rest_json_spec_and_val_monitor(self):
         from learningorchestra_tpu.models.mlp import MLPClassifier
 
