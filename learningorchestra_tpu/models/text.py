@@ -216,6 +216,7 @@ class BertModel(NeuralEstimator):
         learning_rate: float = 2e-5,
         seed: int = 0,
         remat: bool | str = False,
+        use_flash: bool | None = None,
     ):
         self.vocab_size = vocab_size
         self.hidden_dim = hidden_dim
@@ -233,6 +234,7 @@ class BertModel(NeuralEstimator):
             mlp_dim=self.mlp_dim,
             max_len=max_len,
             remat=remat,
+            use_flash=use_flash,
         )
         super().__init__(
             _BertClassifier(encoder=encoder, num_classes=num_classes),

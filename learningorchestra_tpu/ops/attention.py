@@ -23,6 +23,7 @@ is how the unit tests exercise them on CPU.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -48,6 +49,13 @@ def _tpu_params(n_parallel: int):
 
 
 def _auto_interpret() -> bool:
+    # LO_TPU_FLASH_INTERPRET overrides the backend heuristic: "0"
+    # forces the real Mosaic lowering on a CPU-only host — used by the
+    # cross-platform export test that proves the TRAIN path lowers to
+    # tpu_custom_call without needing live TPU hardware.
+    env = os.environ.get("LO_TPU_FLASH_INTERPRET")
+    if env is not None:
+        return env not in ("0", "false", "False")
     return jax.default_backend() != "tpu"
 
 

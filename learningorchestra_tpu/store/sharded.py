@@ -47,6 +47,16 @@ def _narrow(dtype: np.dtype) -> str:
     return _NARROW.get(name, name)
 
 
+def _int32_safe(arr: np.ndarray) -> bool:
+    """True when every value fits int32 exactly (INT32_MIN included).
+    ONE policy for both ingest paths — the cross-engine dtype parity
+    (ADVICE r3) depends on these bounds never drifting apart."""
+    return bool(
+        arr.size == 0
+        or (np.all(arr >= -(2**31)) and np.all(arr < 2**31))
+    )
+
+
 class ShardedDatasetWriter:
     """Streaming writer: buffer rows, flush one ``.npz`` per shard.
 
@@ -147,9 +157,7 @@ class ShardedDatasetWriter:
             # the values are integral, finite, and int32-safe.
             if (not self._float_format[i]) and np.all(
                 np.isfinite(arr)
-            ) and np.all(arr == np.floor(arr)) and np.all(
-                arr >= -(2**31)  # INT32_MIN is representable
-            ) and np.all(arr < 2**31):
+            ) and np.all(arr == np.floor(arr)) and _int32_safe(arr):
                 arr = arr.astype(np.int32)
             else:
                 arr = arr.astype(np.float32)
@@ -177,8 +185,8 @@ class ShardedDatasetWriter:
                     f"(dtype {arr.dtype}); cast or project it away "
                     "before sharded ingest"
                 )
-            if np.issubdtype(arr.dtype, np.integer) and arr.size and (
-                arr.max() >= 2**31 or arr.min() < -(2**31)
+            if np.issubdtype(arr.dtype, np.integer) and not _int32_safe(
+                arr
             ):
                 # int64 values beyond int32 must not wrap silently on
                 # the narrowing cast; degrade to float32 like the

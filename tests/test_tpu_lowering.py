@@ -1,0 +1,116 @@
+"""Cross-platform proof that the TRAIN path lowers to the Pallas
+kernels (VERDICT r3 item 2: "verify via HLO that the train path lowers
+to the Pallas kernel (tpu_custom_call)").
+
+``jax.export`` lowers for platform "tpu" on this CPU-only host — the
+Mosaic pipeline that turns ``pallas_call`` into ``tpu_custom_call``
+lives in jaxlib, no TPU or tunnel required.  A kernel that stops
+lowering (shape rule change, Mosaic rejection) fails HERE, in CI,
+instead of burning a live tunnel window.
+
+``LO_TPU_FLASH_INTERPRET=0`` (ops/attention.py::_auto_interpret)
+forces the real kernel path during tracing; params are initialized
+first in interpret mode (flax init executes on the CPU backend).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import export
+
+
+@pytest.fixture()
+def mosaic(monkeypatch):
+    """Force real Mosaic lowering for the test body only."""
+    monkeypatch.setenv("LO_TPU_FLASH_INTERPRET", "0")
+
+
+def _count_kernel_calls(fn, *args) -> int:
+    exp = export.export(jax.jit(fn), platforms=["tpu"])(*args)
+    return exp.mlir_module().count("tpu_custom_call")
+
+
+class TestBertTrainPathLowersToFlash:
+    def test_forward_and_grad_use_the_kernel(self, monkeypatch):
+        from learningorchestra_tpu.models.text import BertModel
+
+        est = BertModel(hidden_dim=64, num_layers=2, num_heads=2,
+                        max_len=128, use_flash=True)
+        rng = np.random.default_rng(0)
+        tok = jnp.asarray(
+            rng.integers(1, 100, (2, 128), dtype=np.int32)
+        )
+        est._init_params(tok[:1])  # interpret mode: runs on CPU
+
+        monkeypatch.setenv("LO_TPU_FLASH_INTERPRET", "0")
+        n_fwd = _count_kernel_calls(est.module.apply, est.params, tok)
+        assert n_fwd == 2  # one flash kernel per layer
+
+        loss_fn = est._loss_and_metrics(
+            est._resolve_loss(np.zeros(2, np.int32))
+        )
+        y = jnp.asarray(rng.integers(0, 2, (2,), dtype=np.int32))
+
+        def step(params, x, y):
+            def L(p):
+                logits = est.module.apply(p, x)
+                loss, _ = loss_fn(
+                    logits, y, jnp.ones_like(y, jnp.float32)
+                )
+                return loss
+
+            return jax.grad(L)(params)
+
+        n_train = _count_kernel_calls(step, est.params, tok, y)
+        # Backward routes Pallas too (custom VJP): strictly more
+        # kernel calls than the forward alone.
+        assert n_train > n_fwd, (n_train, n_fwd)
+
+
+class TestKernelVariantsLowerer:
+    """The r3 kernel additions must keep lowering through Mosaic."""
+
+    def _qkv(self, t=256, d=64):
+        rng = np.random.default_rng(1)
+        mk = lambda: jnp.asarray(
+            rng.standard_normal((1, 2, t, d)), jnp.bfloat16
+        )
+        return mk(), mk(), mk()
+
+    def test_plain_flash(self, mosaic):
+        from learningorchestra_tpu.ops.attention import flash_attention
+
+        q, k, v = self._qkv()
+        assert _count_kernel_calls(flash_attention, q, k, v) == 1
+
+    def test_causal_flash(self, mosaic):
+        from learningorchestra_tpu.ops.attention import flash_attention
+
+        q, k, v = self._qkv()
+        fn = lambda q, k, v: flash_attention(q, k, v, causal=True)
+        assert _count_kernel_calls(fn, q, k, v) == 1
+
+    def test_sliding_window_flash(self, mosaic):
+        from learningorchestra_tpu.ops.attention import flash_attention
+
+        q, k, v = self._qkv()
+        fn = lambda q, k, v: flash_attention(
+            q, k, v, causal=True, window=128
+        )
+        assert _count_kernel_calls(fn, q, k, v) == 1
+
+    def test_flash_backward_kernels(self, mosaic):
+        from learningorchestra_tpu.ops.attention import flash_attention
+
+        q, k, v = self._qkv()
+
+        def loss(q, k, v):
+            return flash_attention(q, k, v).astype(jnp.float32).sum()
+
+        n = _count_kernel_calls(
+            lambda q, k, v: jax.grad(loss, argnums=(0, 1, 2))(q, k, v),
+            q, k, v,
+        )
+        assert n >= 2  # fwd (for residuals) + backward kernel(s)
