@@ -79,6 +79,7 @@ class StandbyMonitor:
         max_misses: int = 4,
         probe_timeout: float = 1.0,
         new_primary_addr: str = "",
+        require_first_contact: bool = True,
     ):
         self.primary_addr = primary_addr
         self.primary_store = Path(primary_store)
@@ -87,6 +88,14 @@ class StandbyMonitor:
         self.max_misses = max_misses
         self.probe_timeout = probe_timeout
         self.new_primary_addr = new_primary_addr
+        # Never elect over a primary we have never reached: a standby
+        # that boots alongside a slow-starting primary (cold `compose
+        # up`: jax imports alone exceed interval*misses) must wait, not
+        # fence a healthy node out of existence.  An unreachable-from-
+        # birth primary is indistinguishable from a standby pointed at
+        # the wrong address — takeover there is never safe.
+        self.require_first_contact = require_first_contact
+        self.saw_primary = False
         self.misses = 0
 
     def probe(self) -> bool:
@@ -125,7 +134,21 @@ class StandbyMonitor:
             # keep probing — the health check decides.
             log.warning(f"standby sync error: {exc}")
         if self.probe():
+            if not self.saw_primary:
+                log.info(f"primary {self.primary_addr} reached — "
+                         "takeover arming enabled")
+            self.saw_primary = True
             self.misses = 0
+            return False
+        if self.require_first_contact and not self.saw_primary:
+            # Startup grace: the primary may still be booting.
+            self.misses += 1
+            if self.misses % 30 == 0:
+                log.warning(
+                    f"primary {self.primary_addr} still unreached "
+                    f"after {self.misses} probes; standing by "
+                    "(takeover requires first contact)"
+                )
             return False
         self.misses += 1
         log.warning(

@@ -131,3 +131,34 @@ class TestLocalClusterBringup:
             return rec if rec and rec.get("alive") else None
 
         _wait_for(agent1_alive, what="agent1 alive again")
+
+
+def test_compose_manifest_roles_and_flags():
+    """deploy/docker-compose.yml carries every cluster role (incl. the
+    HA standby and the reference-parity local registry,
+    docker-compose.yml:92-100) and the standby command's flags stay in
+    sync with the CLI."""
+    yaml = pytest.importorskip("yaml")
+    doc = yaml.safe_load(
+        (REPO / "deploy" / "docker-compose.yml").read_text()
+    )
+    services = doc["services"]
+    assert {"api", "coordinator", "agent", "standby",
+            "registry"} <= set(services)
+    # Standby flags must be accepted by the real argparse surface.
+    import argparse
+    import unittest.mock as mock
+
+    from learningorchestra_tpu import __main__ as cli
+
+    cmd = services["standby"]["command"]
+    assert cmd[0] == "standby"
+    with mock.patch.object(cli, "_cmd_standby", return_value=0) as run:
+        assert cli.main(cmd) == 0
+    args = run.call_args[0][0]
+    assert isinstance(args, argparse.Namespace)
+    assert args.primary == "api:80"
+    assert args.port == 8081
+    # Registry persists its layers (air-gapped clusters keep images).
+    assert "lo-registry:/var/lib/registry" in \
+        services["registry"]["volumes"]

@@ -47,6 +47,7 @@ class TestStandbyMonitor:
             probe_timeout=0.2,
             new_primary_addr="127.0.0.1:9",
         )
+        mon.saw_primary = True  # simulate prior healthy contact
         decisions = [mon.step() for _ in range(3)]
         assert decisions == [False, False, True]
 
@@ -58,6 +59,24 @@ class TestStandbyMonitor:
         fence = is_fenced(tmp_path / "p")
         assert fence is not None
         assert fence["promoted_to"] == "127.0.0.1:9"
+
+    def test_never_contacted_primary_is_never_fenced(self, tmp_path):
+        # Cold-boot race (review r4): a standby that starts alongside a
+        # slow-booting primary must wait indefinitely, not elect over a
+        # node it has never reached — jax imports alone can exceed
+        # interval*misses on `compose up`.
+        (tmp_path / "p").mkdir()
+        mon = StandbyMonitor("127.0.0.1:1", tmp_path / "p",
+                             tmp_path / "r", max_misses=2,
+                             probe_timeout=0.2)
+        for _ in range(10):  # far beyond max_misses
+            assert mon.step() is False
+        # Once contact is made and then lost, takeover arms normally.
+        mon.probe = lambda: True
+        assert mon.step() is False and mon.saw_primary
+        mon.probe = lambda: False
+        assert mon.step() is False  # miss 1/2
+        assert mon.step() is True   # miss 2/2 -> takeover
 
     def test_healthy_primary_resets_miss_count(self, tmp_path):
         (tmp_path / "p").mkdir()
@@ -81,6 +100,7 @@ class TestStandbyMonitor:
         primary.insert_one("jobs", {"n": 1}, _id=0)
         mon = StandbyMonitor("127.0.0.1:1", tmp_path / "p",
                              tmp_path / "r", probe_timeout=0.2)
+        mon.saw_primary = True
         mon.step()
         primary.insert_one("jobs", {"n": 2}, _id=1)
         promoted = mon.promote()
@@ -250,6 +270,28 @@ def _spawn(args, env):
     )
 
 
+def _wait_for_line(proc, needle, timeout=60):
+    """Read merged stdout until a line contains ``needle``."""
+    import select
+
+    deadline = time.time() + timeout
+    buf = ""
+    while time.time() < deadline:
+        ready, _, _ = select.select([proc.stdout], [], [], 0.5)
+        if ready:
+            chunk = proc.stdout.readline()
+            if chunk:
+                buf += chunk
+                if needle in chunk:
+                    return buf
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"process exited (rc={proc.returncode}) before "
+                f"{needle!r}:\n{buf[-2000:]}"
+            )
+    raise AssertionError(f"timeout waiting for {needle!r}:\n{buf[-2000:]}")
+
+
 def _wait_health(port, timeout=60):
     deadline = time.time() + timeout
     url = f"http://127.0.0.1:{port}/api/learningOrchestra/v1/health"
@@ -301,8 +343,12 @@ class TestKill9AutoFailover:
                 ctx.request("POST", "/function/python",
                             {"name": name, "function": "response = 1"})
                 acked.append(name)
-            # Give the standby one shipping interval, then murder the
-            # primary mid-storm (no graceful anything).
+            # Takeover arms only after the standby REACHES the primary
+            # (first-contact gate, store/ha.py) — wait for that, then
+            # one shipping interval, then murder the primary mid-storm
+            # (no graceful anything).
+            _wait_for_line(standby, "takeover arming enabled",
+                           timeout=90)
             time.sleep(0.5)
             primary.send_signal(signal.SIGKILL)
 
