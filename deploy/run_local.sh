@@ -72,11 +72,15 @@ supervise api python -m learningorchestra_tpu serve
 # primary's restart exits cleanly, ending its supervision loop.
 if [ "${LO_HA_STANDBY:-0}" = "1" ]; then
   STANDBY_PORT="${LO_HA_STANDBY_PORT:-$((API_PORT + 1))}"
+  # Generous takeover window (2 s x 15 = 30 s dead, matching the
+  # compose manifest): a supervised api restart pays ~10 s of python
+  # imports, which must read as a blip, not a dead primary.
   supervise standby python -m learningorchestra_tpu standby \
     --primary "127.0.0.1:$API_PORT" \
     --primary-store "$LO_TPU_STORE_ROOT" \
     --replica "$DATA_ROOT/store-replica" \
-    --port "$STANDBY_PORT" --host 127.0.0.1
+    --port "$STANDBY_PORT" --host 127.0.0.1 \
+    --interval 2 --misses 15
 fi
 for i in $(seq 1 "$N_AGENTS"); do
   supervise "agent$i" python -m learningorchestra_tpu agent \

@@ -47,7 +47,7 @@ from pathlib import Path
 from learningorchestra_tpu.log import get_logger
 from learningorchestra_tpu.store.replica import WalReplica
 
-log = get_logger("lo.ha")
+log = get_logger("ha")  # get_logger prepends the "lo." namespace
 
 #: Marker file a promotion writes into the OLD primary's store dir.
 FENCE_FILE = ".fenced"
