@@ -99,6 +99,20 @@ class TestLocalClusterBringup:
         alive = _wait_for(agents_alive, what="2 alive agents")
         assert {"agent1", "agent2"} <= set(alive)
 
+        # Ops status page in CLUSTER mode: the agents table must render
+        # from the coordinator fetch (the in-process tests only cover
+        # the no-coordinator branch).
+        def status_shows_agents():
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{api_port}{prefix}/status", timeout=5
+            ) as resp:
+                page = resp.read().decode()
+            return page if ("Agents (" in page and "agent1" in page) \
+                else None
+
+        page = _wait_for(status_shows_agents, what="status agents table")
+        assert "Device leases" in page and "Recent events" in page
+
     def test_failed_role_is_restarted(self, cluster):
         """Kill an agent process; the supervisor must restart it (the
         reference's restart_policy: on-failure)."""
