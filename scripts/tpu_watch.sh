@@ -60,6 +60,16 @@ while true; do
         if timeout "$tmo" python -u "$name" >> "$log" 2>&1; then
             DONE[$name]=1
             echo "$(date -u +%H:%M:%S) DONE $name" >> "$LOGDIR/watch.log"
+            # Bank immediately: distill logs into TPU_EVIDENCE.md and
+            # commit (pathspec-scoped so a concurrent build session's
+            # staged files are never swept in), so a window that
+            # outlives the build session still leaves committed,
+            # readable evidence.
+            python scripts/tpu_writeup.py >> "$LOGDIR/watch.log" 2>&1 || true
+            git add tpu_chain_logs TPU_EVIDENCE.md 2>/dev/null
+            git commit -q \
+                -m "Bank on-chip evidence: $(basename "$name" .py) completed" \
+                -- tpu_chain_logs TPU_EVIDENCE.md 2>/dev/null || true
         else
             rc=$?
             FAILS[$name]=$(( ${FAILS[$name]:-0} + 1 ))
