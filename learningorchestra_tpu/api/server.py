@@ -247,14 +247,15 @@ class APIServer:
                 ) as resp:
                     agents = json.loads(resp.read()).get("agents", {})
                 rows = [
-                    (aid, a.get("capacity", ""), a.get("in_use", ""),
+                    (aid, a.get("capacity", ""),
+                     "yes" if a.get("alive") else "NO",
                      f"{_time.time() - a.get('last_seen', 0):.1f}s ago"
                      if a.get("last_seen") else "never")
                     for aid, a in sorted(agents.items())
                 ]
                 sections.append(
                     f"<h2>Agents ({len(rows)})</h2>"
-                    + table(("agent", "capacity", "in use",
+                    + table(("agent", "capacity", "alive",
                              "heartbeat"), rows)
                 )
             except Exception as exc:  # noqa: BLE001 — page must render

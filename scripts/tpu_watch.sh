@@ -56,6 +56,10 @@ while true; do
         fi
         tmo="${s##*:}"
         log="$LOGDIR/$(basename "$name" .py).log"
+        # Rotate per attempt: the writeup must distill ONLY the final
+        # (successful) attempt's rows, not stale rows from an aborted
+        # run appended above them; the failed attempt stays readable.
+        [ -f "$log" ] && mv "$log" "$log.prev"
         echo "$(date -u +%H:%M:%S) RUN $name" >> "$LOGDIR/watch.log"
         if timeout "$tmo" python -u "$name" >> "$log" 2>&1; then
             DONE[$name]=1
@@ -64,12 +68,28 @@ while true; do
             # commit (pathspec-scoped so a concurrent build session's
             # staged files are never swept in), so a window that
             # outlives the build session still leaves committed,
-            # readable evidence.
+            # readable evidence.  Retries ride out a concurrent
+            # session's index.lock; on final failure the banked paths
+            # are UNSTAGED so a later unrelated commit can't sweep
+            # them in.
             python scripts/tpu_writeup.py >> "$LOGDIR/watch.log" 2>&1 || true
-            git add tpu_chain_logs TPU_EVIDENCE.md 2>/dev/null
-            git commit -q \
-                -m "Bank on-chip evidence: $(basename "$name" .py) completed" \
-                -- tpu_chain_logs TPU_EVIDENCE.md 2>/dev/null || true
+            banked=0
+            for _try in 1 2 3; do
+                if git add tpu_chain_logs TPU_EVIDENCE.md \
+                        >> "$LOGDIR/watch.log" 2>&1 \
+                   && git commit -q \
+                        -m "Bank on-chip evidence: $(basename "$name" .py) completed" \
+                        -- tpu_chain_logs TPU_EVIDENCE.md \
+                        >> "$LOGDIR/watch.log" 2>&1; then
+                    banked=1
+                    break
+                fi
+                sleep 2
+            done
+            if [ "$banked" = 0 ]; then
+                echo "$(date -u +%H:%M:%S) BANK COMMIT FAILED for $name (left unstaged)" >> "$LOGDIR/watch.log"
+                git reset -q -- tpu_chain_logs TPU_EVIDENCE.md 2>/dev/null || true
+            fi
         else
             rc=$?
             FAILS[$name]=$(( ${FAILS[$name]:-0} + 1 ))
