@@ -1,0 +1,74 @@
+"""The evidence-banking tooling (scripts/tpu_writeup.py) — a broken
+writeup would silently lose a live tunnel window's results, so its
+parsing and idempotent-replace behavior are pinned here."""
+
+import importlib
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_writeup(tmp_path, monkeypatch):
+    sys.path.insert(0, str(REPO / "scripts"))
+    try:
+        writeup = importlib.import_module("tpu_writeup")
+        writeup = importlib.reload(writeup)
+    finally:
+        sys.path.pop(0)
+    monkeypatch.setattr(writeup, "LOGDIR", tmp_path / "logs")
+    monkeypatch.setattr(writeup, "EVIDENCE", tmp_path / "EVIDENCE.md")
+    (tmp_path / "logs").mkdir()
+    return writeup
+
+
+def test_extracts_json_rows_and_replaces_idempotently(
+    tmp_path, monkeypatch
+):
+    writeup = _load_writeup(tmp_path, monkeypatch)
+    log = tmp_path / "logs" / "bert_mfu_sweep.log"
+    rows = [
+        {"seq": 128, "bs": 32, "mfu": 0.41},
+        {"seq": 512, "bs": 16, "mfu": 0.44},
+    ]
+    log.write_text(
+        "device: TPU v5 lite0\nnot json {\n"
+        + "\n".join(json.dumps(r) for r in rows)
+        + "\nBEST: " + json.dumps(rows[1]) + "\n"
+    )
+    (tmp_path / "EVIDENCE.md").write_text("# evidence\n\nhand prose\n")
+
+    writeup.main()
+    text = (tmp_path / "EVIDENCE.md").read_text()
+    assert "hand prose" in text  # hand-written content preserved
+    assert '"mfu": 0.41' in text and '"mfu": 0.44' in text
+    assert "BEST:" in text
+    assert "not json {" not in text  # non-JSON noise excluded
+    assert text.count(writeup.BEGIN) == 1
+
+    # Re-run replaces the managed section instead of appending.
+    writeup.main()
+    again = (tmp_path / "EVIDENCE.md").read_text()
+    assert again.count(writeup.BEGIN) == 1
+    assert again.count('"mfu": 0.41') == 1
+
+
+def test_missing_evidence_file_is_created(tmp_path, monkeypatch):
+    writeup = _load_writeup(tmp_path, monkeypatch)
+    writeup.main()
+    text = (tmp_path / "EVIDENCE.md").read_text()
+    assert writeup.BEGIN in text
+    assert "No stage has produced results yet" in text
+
+
+def test_stage_stems_match_watch_chain(tmp_path, monkeypatch):
+    # The watch script's STAGES and the writeup's stem list must not
+    # drift: a renamed stage would silently stop being banked.
+    writeup = _load_writeup(tmp_path, monkeypatch)
+    watch = (REPO / "scripts" / "tpu_watch.sh").read_text()
+    for stem, _title in writeup.STAGES:
+        if stem == "bench":
+            assert "bench.py:" in watch
+        else:
+            assert f"scripts/{stem}.py:" in watch, stem
