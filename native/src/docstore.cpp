@@ -1063,11 +1063,27 @@ int64_t lods_csv_numeric_chunk(const char *buf, int64_t len, int is_final,
       // spellings, and inf/nan RESULTS (incl. overflow) are
       // non-numeric; subnormal underflow is a fine number.
       std::string trimmed = cell.substr(a, b - a);
+      // One fused scan: badcell markers ('_'/hex spellings) AND the
+      // int-format classification the dtype-parity contract needs
+      // (services/dataset.py::_infer — a cell is INT-formatted only
+      // as [+-]?digits fitting int64; "5.0", "1e3", and overflowing
+      // digit runs all type their column float).
       bool badcell = false;
-      for (char ch : trimmed) {
+      size_t digit_start =
+          (trimmed[0] == '+' || trimmed[0] == '-') ? 1 : 0;
+      bool int_format = digit_start < trimmed.size();
+      size_t n_digits = 0;
+      for (size_t j = 0; j < trimmed.size(); j++) {
+        char ch = trimmed[j];
         if (ch == '_' || ch == 'x' || ch == 'X') {
           badcell = true;
           break;
+        }
+        if (j >= digit_start) {
+          if (ch >= '0' && ch <= '9')
+            n_digits++;
+          else
+            int_format = false;
         }
       }
       double v = nan;
@@ -1084,21 +1100,9 @@ int64_t lods_csv_numeric_chunk(const char *buf, int64_t len, int is_final,
       } else {
         dst[c] = v;
         if (float_counts) {
-          // Format-based dtype parity with the Python row path
-          // (services/dataset.py::_infer): a cell is INT-formatted
-          // only as [+-]?digits fitting int64 — "5.0", "1e3", and
-          // int64-overflowing digit runs all type their column
-          // float, even when the VALUE is integral.
-          size_t i = 0, m = trimmed.size();
-          if (trimmed[0] == '+' || trimmed[0] == '-') i = 1;
-          bool int_format = i < m;
-          for (size_t j = i; j < m; j++) {
-            if (trimmed[j] < '0' || trimmed[j] > '9') {
-              int_format = false;
-              break;
-            }
-          }
-          if (int_format) {
+          if (int_format && n_digits >= 19) {
+            // 18 digits always fit int64 (max 9.2e18); only longer
+            // runs need the overflow probe.
             errno = 0;
             (void)strtoll(trimmed.c_str(), nullptr, 10);
             if (errno == ERANGE) int_format = false;
