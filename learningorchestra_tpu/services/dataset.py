@@ -50,9 +50,13 @@ def _infer(value: str):
     values or the backends aren't interchangeable.  Deliberately stricter
     than Python's int()/float(): no '_' separators, no inf/nan spellings,
     no hex; ints beyond int64 degrade to float like strtoll/ERANGE."""
-    if value == "":
-        return None
     v = value.strip()
+    if v == "":
+        # Whitespace-only counts as empty (NaN downstream) in BOTH
+        # engines — the native parser trims the full whitespace set
+        # before classifying, and a cell of spaces is "empty", not a
+        # non-numeric string.
+        return None
     if _INT_RE.fullmatch(v):
         iv = int(v)
         if -(2 ** 63) <= iv < 2 ** 63:

@@ -19,6 +19,7 @@
 
 #include <algorithm>
 #include <cctype>
+#include <charconv>
 #include <cmath>
 #include <cerrno>
 #include <cinttypes>
@@ -425,8 +426,28 @@ void format_double(double v, std::string &out) {
 }
 
 // Append the inferred-JSON form of a CSV cell.
+// ONE whitespace set for every ingest-parity path (Python str.strip's
+// ASCII subset): infer_value's empty/trailing checks and the chunk
+// parser's cell trim must use the same predicate or the engines'
+// semantics drift (the backends-interchangeable contract,
+// services/dataset.py::_infer).
+inline bool is_ascii_ws(char ch) {
+  return ch == ' ' || ch == '\t' || ch == '\n' || ch == '\r' ||
+         ch == '\v' || ch == '\f';
+}
+
 void infer_value(const std::string &cell, std::string &out) {
-  if (cell.empty()) {
+  // Whitespace-only counts as empty → null, matching the Python
+  // path's _infer (services/dataset.py) and the numeric chunk
+  // parser's trim: a cell of spaces is an empty cell, not a string.
+  bool all_ws = true;
+  for (char ch : cell) {
+    if (!is_ascii_ws(ch)) {
+      all_ws = false;
+      break;
+    }
+  }
+  if (all_ws) {
     out += "null";
     return;
   }
@@ -436,7 +457,7 @@ void infer_value(const std::string &cell, std::string &out) {
   long long iv = strtoll(s, &end, 10);
   if (errno == 0 && end != s) {
     const char *p = end;
-    while (*p == ' ' || *p == '\t') p++;
+    while (is_ascii_ws(*p)) p++;
     if (*p == 0) {  // fully consumed (allowing trailing whitespace)
       char buf[32];
       snprintf(buf, sizeof buf, "%lld", iv);
@@ -449,7 +470,7 @@ void infer_value(const std::string &cell, std::string &out) {
   double dv = strtod(s, &end);
   bool consumed = end && (end != s);
   if (consumed) {
-    while (*end == ' ' || *end == '\t') end++;
+    while (is_ascii_ws(*end)) end++;
     consumed = (*end == 0);
   }
   // Reject inf/nan spellings (not valid JSON) and partial parses.
@@ -561,6 +582,85 @@ bool next_record(const char *s, size_t n, size_t *pos,
     return true;
   }
   fields.push_back(cur);
+  return true;
+}
+
+// Parse one TRIMMED numeric cell in [a, b), no allocation —
+// services/dataset.py::_infer semantics exactly: no '_'/hex spellings,
+// inf/nan results (incl. overflow) are non-numeric, a leading '+' is
+// fine, subnormal underflow is a fine number.  On success *v holds the
+// value and *int_format reports the dtype-parity classification (pure
+// [+-]?digits fitting int64).  Shared by the fast (in-place) and slow
+// (quote-aware) record paths so their semantics cannot drift.
+bool parse_numeric_cell(const char *a, const char *b, double *v,
+                        bool *int_format) {
+  size_t m = (size_t)(b - a);
+  size_t digit_start = (a[0] == '+' || a[0] == '-') ? 1 : 0;
+  bool ifmt = digit_start < m;
+  size_t n_digits = 0;
+  for (size_t j = 0; j < m; j++) {
+    char ch = a[j];
+    if (ch == '_' || ch == 'x' || ch == 'X') return false;
+    if (j >= digit_start) {
+      if (ch >= '0' && ch <= '9')
+        n_digits++;
+      else
+        ifmt = false;
+    }
+  }
+  double val = 0.0;
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
+  const char *p = a;
+  if (*p == '+') {
+    // std::from_chars rejects the leading '+' strtod accepts; skip it
+    // only when what follows could start a number, so "+-5" still
+    // fails exactly like strtod's end-pointer check did.
+    if (m < 2 || (!(p[1] >= '0' && p[1] <= '9') && p[1] != '.'))
+      return false;
+    p++;
+  }
+  auto res = std::from_chars(p, b, val);
+  if (res.ec == std::errc::result_out_of_range) {
+    // from_chars can't distinguish overflow (non-numeric by contract)
+    // from underflow-to-subnormal (accepted); rare — resolve with the
+    // old NUL-terminated strtod exactly.
+    std::string copy(a, m);
+    char *end = nullptr;
+    errno = 0;
+    val = strtod(copy.c_str(), &end);
+    if (end != copy.c_str() + copy.size() || val != val ||
+        val > 1.7976931348623157e308 || val < -1.7976931348623157e308)
+      return false;
+  } else if (res.ec != std::errc() || res.ptr != b) {
+    return false;
+  } else if (val != val || val > 1.7976931348623157e308 ||
+             val < -1.7976931348623157e308) {
+    return false;  // "inf"/"nan" spellings parse but are non-numeric
+  }
+#else
+  // Pre-GCC-11 libstdc++ has no floating-point from_chars: same
+  // semantics via a NUL-terminated strtod copy (slower, still correct
+  // — better than the whole native engine silently failing to build).
+  {
+    std::string copy(a, m);
+    char *end = nullptr;
+    val = strtod(copy.c_str(), &end);
+    if (end == copy.c_str() || end != copy.c_str() + copy.size() ||
+        val != val || val > 1.7976931348623157e308 ||
+        val < -1.7976931348623157e308)
+      return false;
+  }
+#endif
+  if (ifmt && n_digits >= 19) {
+    // 18 digits always fit int64 (max ~9.2e18); only longer runs need
+    // the overflow probe.
+    std::string copy(a, m);
+    errno = 0;
+    (void)strtoll(copy.c_str(), nullptr, 10);
+    if (errno == ERANGE) ifmt = false;
+  }
+  *v = val;
+  if (int_format) *int_format = ifmt;
   return true;
 }
 
@@ -1031,86 +1131,95 @@ int64_t lods_csv_numeric_chunk(const char *buf, int64_t len, int is_final,
   std::vector<std::string> row;
   size_t pos = 0, n = (size_t)len;
   int64_t rows = 0;
+
+  // Store one parsed cell with _infer-parity accounting.  The trim
+  // strips the FULL ASCII whitespace set like Python's str.strip()
+  // (_infer trims before parsing) — strtod's own leading-space skip
+  // used to paper over '\v'/'\f', but from_chars does not skip, and
+  // trailing whitespace must trim identically anyway.
+  auto emit_cell = [&](const char *a, const char *b, double *slot,
+                       int64_t c) {
+    while (a < b && is_ascii_ws(*a)) a++;
+    while (b > a && is_ascii_ws(b[-1])) b--;
+    if (a == b) {
+      *slot = nan;  // empty cell
+      return;
+    }
+    double v;
+    bool int_format;
+    if (parse_numeric_cell(a, b, &v, &int_format)) {
+      *slot = v;
+      if (float_counts && !int_format) float_counts[c]++;
+    } else {
+      *slot = nan;
+      if (bad_counts) bad_counts[c]++;
+    }
+  };
+
   while (rows < max_rows) {
-    size_t start = pos;
-    bool clean_end = false;
-    if (!next_record(buf, n, &pos, row, &clean_end)) break;  // EOF
-    if (!clean_end && !is_final) {
-      // Record ran out of buffer without an UNQUOTED newline (maybe
-      // mid-cell, maybe inside a quoted field containing '\n'): roll
-      // back, wait for more bytes.
-      pos = start;
-      break;
+    if (pos >= n) break;  // EOF
+    size_t rec_begin = pos;
+
+    // FAST PATH: records without quotes (the overwhelmingly common
+    // CSV-of-numbers case) parse IN PLACE over the buffer — no
+    // per-record string vector, no per-cell copies.  A '"' anywhere
+    // before the terminator falls back to the quote-aware parser,
+    // which owns every quoting subtlety (escaped quotes, newlines
+    // inside quoted fields).
+    size_t k = rec_begin;
+    while (k < n && buf[k] != '"' && buf[k] != '\n' && buf[k] != '\r')
+      k++;
+
+    if (k < n && buf[k] == '"') {
+      // SLOW PATH (quoted record) — semantics identical to pre-r4.
+      bool clean_end = false;
+      if (!next_record(buf, n, &pos, row, &clean_end)) break;
+      if (!clean_end && !is_final) {
+        // Ran out of buffer without an UNQUOTED newline (maybe inside
+        // a quoted field containing '\n'): roll back, wait for bytes.
+        pos = rec_begin;
+        break;
+      }
+      if (row.empty() || (row.size() == 1 && row[0].empty()))
+        continue;  // blank line
+      double *dst = out + rows * ncols;
+      for (int64_t c = 0; c < ncols; c++) {
+        if ((size_t)c >= row.size()) {
+          dst[c] = nan;  // short row pads NaN (Python parity)
+          continue;
+        }
+        const std::string &cell = row[c];
+        emit_cell(cell.data(), cell.data() + cell.size(), dst + c, c);
+      }
+      rows++;
+      continue;
     }
-    if (row.empty() || (row.size() == 1 && row[0].empty()))
-      continue;  // blank line
+
+    size_t rec_end = k;
+    if (k < n) {  // terminated on '\n' or '\r'
+      pos = (buf[k] == '\r' && k + 1 < n && buf[k + 1] == '\n')
+                ? k + 2
+                : k + 1;
+    } else if (!is_final) {
+      break;  // torn tail: leave pos at rec_begin, wait for bytes
+    } else {
+      pos = n;  // final chunk: the unterminated tail is a record
+    }
+    if (rec_end == rec_begin) continue;  // blank line
+
     double *dst = out + rows * ncols;
-    for (int64_t c = 0; c < ncols; c++) {
-      if ((size_t)c >= row.size()) {
-        dst[c] = nan;  // short row pads NaN (Python parity)
-        continue;
-      }
-      const std::string &cell = row[c];
-      size_t a = 0, b = cell.size();
-      while (a < b && (cell[a] == ' ' || cell[a] == '\t')) a++;
-      while (b > a && (cell[b - 1] == ' ' || cell[b - 1] == '\t')) b--;
-      if (a == b) {
-        dst[c] = nan;  // empty cell
-        continue;
-      }
-      // Mirror services/dataset.py::_infer exactly — the two ingest
-      // paths must agree on what "numeric" means: no '_'/hex
-      // spellings, and inf/nan RESULTS (incl. overflow) are
-      // non-numeric; subnormal underflow is a fine number.
-      std::string trimmed = cell.substr(a, b - a);
-      // One fused scan: badcell markers ('_'/hex spellings) AND the
-      // int-format classification the dtype-parity contract needs
-      // (services/dataset.py::_infer — a cell is INT-formatted only
-      // as [+-]?digits fitting int64; "5.0", "1e3", and overflowing
-      // digit runs all type their column float).
-      bool badcell = false;
-      size_t digit_start =
-          (trimmed[0] == '+' || trimmed[0] == '-') ? 1 : 0;
-      bool int_format = digit_start < trimmed.size();
-      size_t n_digits = 0;
-      for (size_t j = 0; j < trimmed.size(); j++) {
-        char ch = trimmed[j];
-        if (ch == '_' || ch == 'x' || ch == 'X') {
-          badcell = true;
-          break;
-        }
-        if (j >= digit_start) {
-          if (ch >= '0' && ch <= '9')
-            n_digits++;
-          else
-            int_format = false;
-        }
-      }
-      double v = nan;
-      if (!badcell) {
-        char *end = nullptr;
-        v = strtod(trimmed.c_str(), &end);
-        if (end == trimmed.c_str() || *end != '\0' || v != v ||
-            v > 1.7976931348623157e308 || v < -1.7976931348623157e308)
-          badcell = true;
-      }
-      if (badcell) {
-        dst[c] = nan;
-        if (bad_counts) bad_counts[c]++;
-      } else {
-        dst[c] = v;
-        if (float_counts) {
-          if (int_format && n_digits >= 19) {
-            // 18 digits always fit int64 (max 9.2e18); only longer
-            // runs need the overflow probe.
-            errno = 0;
-            (void)strtoll(trimmed.c_str(), nullptr, 10);
-            if (errno == ERANGE) int_format = false;
-          }
-          if (!int_format) float_counts[c]++;
-        }
-      }
+    const char *cell_begin = buf + rec_begin;
+    const char *end = buf + rec_end;
+    int64_t c = 0;
+    while (c < ncols) {
+      const char *cell_end = cell_begin;
+      while (cell_end < end && *cell_end != ',') cell_end++;
+      emit_cell(cell_begin, cell_end, dst + c, c);
+      c++;
+      if (cell_end >= end) break;  // last cell of the record
+      cell_begin = cell_end + 1;
     }
+    for (; c < ncols; c++) dst[c] = nan;  // short row pads NaN
     rows++;
   }
   if (consumed) *consumed = (int64_t)pos;

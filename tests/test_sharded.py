@@ -695,3 +695,74 @@ class TestDtypeFormatParity:
         ds = ShardedDataset(tmp_path / "d")
         got = np.asarray(ds["x"].load_shard(0), np.float64)
         assert float(got[0]) == 10_000_000_000.0  # no int32 wraparound
+
+
+class TestFastSlowParserEquivalence:
+    """The native chunk parser has an in-place fast path (quote-free
+    records) and a quote-aware slow path; quoting a cell must never
+    change parsed values, bad counts, or dtype classification."""
+
+    def _parse(self, body: bytes, cols: int):
+        native = pytest.importorskip("learningorchestra_tpu.native")
+        if not native.native_available():
+            pytest.skip("native library unavailable")
+        bad = np.zeros(cols, np.int64)
+        ffmt = np.zeros(cols, np.int64)
+        block, consumed = native.csv_numeric_chunk(
+            body, cols, is_final=True, bad_counts=bad,
+            float_counts=ffmt,
+        )
+        return block, bad, ffmt, consumed
+
+    def test_quoting_cells_changes_nothing(self):
+        rng = np.random.default_rng(7)
+        cells = ["1", "-2", "+3", "4.5", "-0.25", "1e3", "2E-2",
+                 "", "  7  ", "abc", "1_000", "0x10", "nan", "inf",
+                 "10000000000", "9223372036854775808", "+.5", "5.",
+                 ".5", "+-5", "5e", "-2147483648",
+                 "\v5", "5\f", "\v"]  # full-whitespace trim parity
+        rows = [[cells[i] for i in rng.integers(0, len(cells), 4)]
+                for _ in range(200)]
+        bare = "\n".join(",".join(r) for r in rows) + "\n"
+        quoted = "\n".join(
+            ",".join(f'"{c}"' for c in r) for r in rows
+        ) + "\n"
+        b_block, b_bad, b_ffmt, _ = self._parse(bare.encode(), 4)
+        q_block, q_bad, q_ffmt, _ = self._parse(quoted.encode(), 4)
+        np.testing.assert_array_equal(
+            np.isnan(b_block), np.isnan(q_block)
+        )
+        np.testing.assert_array_equal(
+            np.nan_to_num(b_block), np.nan_to_num(q_block)
+        )
+        np.testing.assert_array_equal(b_bad, q_bad)
+        np.testing.assert_array_equal(b_ffmt, q_ffmt)
+
+    def test_fast_path_edge_records(self):
+        # blank lines, short rows, trailing commas, extra columns,
+        # \r\n endings, torn tail rollback
+        body = (b"1,2,3\r\n"
+                b"\n"
+                b"4,5\n"
+                b"6,7,8,9\n"
+                b",,\n"
+                b"10,11,12")
+        block, bad, ffmt, consumed = self._parse(body, 3)
+        assert block.shape == (5, 3)
+        np.testing.assert_array_equal(block[0], [1, 2, 3])
+        assert block[1][2] != block[1][2]  # 4,5 + NaN pad
+        np.testing.assert_array_equal(block[1][:2], [4, 5])
+        np.testing.assert_array_equal(block[2], [6, 7, 8])  # extra cut
+        assert all(v != v for v in block[3])  # ,, -> all NaN cells
+        np.testing.assert_array_equal(block[4], [10, 11, 12])
+        assert consumed == len(body)
+        assert bad.sum() == 0
+
+        # Torn tail: without is_final the partial record must NOT
+        # consume.
+        native = pytest.importorskip("learningorchestra_tpu.native")
+        bad2 = np.zeros(3, np.int64)
+        block2, consumed2 = native.csv_numeric_chunk(
+            b"1,2,3\n4,5", 3, is_final=False, bad_counts=bad2,
+        )
+        assert len(block2) == 1 and consumed2 == 6
