@@ -56,6 +56,14 @@ class Context:
         # on a connection-level failure the client retries ONCE against
         # the standby and — mirroring mongo driver re-discovery — keeps
         # talking to it for the rest of the session.
+        #
+        # Retry semantics are AT-LEAST-ONCE for mutations (the mongo
+        # retryable-writes caveat): if the dying primary committed a
+        # POST but the response never arrived, the WAL ships it and the
+        # standby answers the retry with 409 duplicate — a 409
+        # immediately after failover usually means the first attempt
+        # landed; GET the artifact to confirm rather than treating it
+        # as a conflict.
         self._failover_base = (
             self._make_base(failover, port) + prefix if failover else None
         )
