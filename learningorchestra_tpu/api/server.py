@@ -210,6 +210,12 @@ class APIServer:
         import time as _time
 
         self._t_start = _time.time()
+        # Shutdown/demotion coordination: the event gates the dispatch
+        # path (kept-alive connections get 503+close) and ends the
+        # fence watch; the lock+flag make shutdown() idempotent.
+        self._shutting_down = threading.Event()
+        self._shutdown_lock = threading.Lock()
+        self._shut_down = False
 
     # -- helpers --------------------------------------------------------------
 
@@ -1270,6 +1276,8 @@ class APIServer:
                 pass
 
             def _run(self, verb: str):
+                if api._drain_if_shutting_down(self):
+                    return
                 parsed = urlparse(self.path)
                 query = {
                     k: v[0] for k, v in parse_qs(parsed.query).items()
@@ -1320,7 +1328,60 @@ class APIServer:
             (host, port), Handler,
             max_connections=self.config.api.max_connections,
         )
+        self._start_fence_watch()
         self._httpd.serve_forever()
+
+    #: Seconds between fence checks (tests shrink it).
+    FENCE_CHECK_INTERVAL_S = 5.0
+
+    def _drain_if_shutting_down(self, handler) -> bool:
+        """503+Connection:close for requests arriving on kept-alive
+        connections after shutdown/demotion — the accept loop is gone,
+        but HTTP/1.1 persistent connections would otherwise keep being
+        served by their handler threads (the split-brain window the
+        fence demotion exists to close)."""
+        if not self._shutting_down.is_set():
+            return False
+        handler.close_connection = True
+        handler._send(503, {"error": "server is shutting down"})
+        return True
+
+    def _start_fence_watch(self) -> None:
+        """Self-demote if a standby fences this store while we serve.
+
+        serve() refuses to START on a fenced store, but a RUNNING
+        primary can be fenced underneath itself: a network partition
+        makes the standby declare us dead and promote; when the
+        partition heals, clients that never lost their connection
+        would keep writing HERE while new ones write to the promoted
+        replica — the split-brain the fence exists to prevent.  On a
+        shared filesystem (where the fence write succeeds) the demoted
+        primary notices within one check interval and stops serving;
+        the supervisor's restart then hits serve()'s startup refusal.
+        """
+        from learningorchestra_tpu.store.ha import is_fenced
+
+        store_root = self.config.store.store_path()
+
+        def watch():
+            # wait() doubles as the sleep AND the exit signal: a
+            # normal shutdown ends the thread promptly instead of
+            # leaking one fence-poller per serve cycle.
+            while not self._shutting_down.wait(
+                self.FENCE_CHECK_INTERVAL_S
+            ):
+                fence = is_fenced(store_root)
+                if fence is not None:
+                    print(
+                        "store fenced while serving (promoted_to="
+                        f"{fence.get('promoted_to')!r}) — demoting: "
+                        "shutting down to prevent split-brain",
+                        flush=True,
+                    )
+                    self.shutdown()
+                    return
+
+        threading.Thread(target=watch, daemon=True).start()
 
     def start_background(self, host: str = "127.0.0.1",
                          port: int | None = None) -> int:
@@ -1352,8 +1413,20 @@ class APIServer:
         return port
 
     def shutdown(self) -> None:
-        if self._httpd is not None:
-            self._httpd.shutdown()
+        """Idempotent stop: accept loop halted, LISTENING SOCKET
+        CLOSED (reconnecting clients get an immediate refusal — what
+        triggers their failover retry — instead of hanging in the
+        kernel backlog), kept-alive connections answered 503+close by
+        the dispatch gate, resources released once."""
+        with self._shutdown_lock:
+            if self._shut_down:
+                return
+            self._shut_down = True
+        self._shutting_down.set()
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
         self.monitoring.close()
         self.ctx.close()
 

@@ -394,3 +394,48 @@ class TestKill9AutoFailover:
                 if proc is not None and proc.poll() is None:
                     proc.kill()
                     proc.wait(timeout=10)
+
+
+class TestRunningPrimarySelfDemotes:
+    def test_fenced_while_serving_shuts_down(self, tmp_path):
+        """A RUNNING primary whose store gets fenced (partition healed
+        after a standby promoted) must stop serving within a check
+        interval — clients that never lost their connection would
+        otherwise keep writing to the dead side of a split brain."""
+        from learningorchestra_tpu.api.server import APIServer
+        from learningorchestra_tpu.config import Config
+
+        cfg = Config()
+        cfg.store.root = str(tmp_path / "store")
+        cfg.store.volume_root = str(tmp_path / "vol")
+        server = APIServer(cfg)
+        server.FENCE_CHECK_INTERVAL_S = 0.2
+        port = server.start_background()
+        url = f"http://127.0.0.1:{port}/api/learningOrchestra/v1/health"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            assert resp.status == 200
+
+        (tmp_path / "store" / FENCE_FILE).write_text(
+            json.dumps({"promoted_to": "10.0.0.2:8081"})
+        )
+        deadline = time.time() + 15
+        demoted = False
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(url, timeout=2):
+                    time.sleep(0.2)
+            except urllib.error.HTTPError as exc:
+                # Kept-alive drain answers 503+close — that IS
+                # demotion; any other status means still serving.
+                if exc.code == 503:
+                    demoted = True
+                    break
+                time.sleep(0.2)
+            except OSError:
+                demoted = True  # listening socket closed: refused
+                break
+        assert demoted, "fenced primary kept serving"
+        # The socket must be RELEASED (immediate refusal), not left
+        # accepting into the kernel backlog where clients would hang.
+        with pytest.raises(OSError):
+            urllib.request.urlopen(url, timeout=2)
