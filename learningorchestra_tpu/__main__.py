@@ -116,8 +116,11 @@ def main(argv: list[str] | None = None) -> int:
     )
     standby.add_argument("--primary", required=True,
                          help="primary API HOST:PORT to health-check")
-    standby.add_argument("--primary-store", required=True,
-                         help="primary's store directory (WAL source)")
+    standby.add_argument("--primary-store", default=None,
+                         help="primary's store directory (WAL source) "
+                              "when a mount is shared; omit to ship "
+                              "WALs over the primary's /replication "
+                              "HTTP routes (no shared storage)")
     standby.add_argument("--replica", required=True,
                          help="local replica directory")
     standby.add_argument("--port", type=int, required=True,

@@ -1124,6 +1124,103 @@ class APIServer:
 
         add("GET", r"/status", status_view)
 
+        # ---- Replication + HA peering (store/ha.py — the reference's
+        # mongo replica set, reference: docker-compose.yml:42-90).
+        # A network standby pulls WAL listings and byte ranges from
+        # here, so the secondary replicates over the wire with no
+        # shared mount (the mongo-secondary topology); the fence POST
+        # lets a promoted standby demote a live-but-partitioned
+        # primary; /replication/status carries the election epoch a
+        # restarted node compares against its own before serving.
+        from learningorchestra_tpu.store.ha import is_fenced
+        from learningorchestra_tpu.store.replica import (
+            FENCE_FILE,
+            read_epoch,
+        )
+
+        def replication_wals(m, body, query):
+            root = self.config.store.store_path()
+            wals = []
+            if root.is_dir():
+                for wal in sorted(root.glob("*.wal")):
+                    try:
+                        wals.append(
+                            {"name": wal.stem, "size": wal.stat().st_size}
+                        )
+                    except OSError:
+                        continue  # dropped between glob and stat
+            return 200, {
+                "wals": wals,
+                "epoch": read_epoch(root),
+                "fenced": is_fenced(root) is not None,
+            }
+
+        add("GET", r"/replication/wals", replication_wals)
+
+        def replication_wal_read(m, body, query):
+            # NAME excludes "/" and "%", so the stem cannot traverse
+            # out of the store root.
+            root = self.config.store.store_path()
+            offset = max(0, _int_param(query, "from", 0))
+            length = _int_param(query, "len", 0)
+            try:
+                with open(root / f"{m.group('name')}.wal", "rb") as fh:
+                    fh.seek(offset)
+                    data = fh.read(length) if length > 0 else fh.read()
+            except FileNotFoundError:
+                return 404, {"error": f"no WAL {m.group('name')!r}"}
+            return 200, ("application/octet-stream", data)
+
+        add("GET", rf"/replication/wal/{NAME}", replication_wal_read)
+
+        def replication_status(m, body, query):
+            root = self.config.store.store_path()
+            fence = is_fenced(root)
+            return 200, {
+                "role": "fenced" if fence is not None else "primary",
+                "epoch": read_epoch(root),
+                "fence": fence,
+            }
+
+        add("GET", r"/replication/status", replication_status)
+
+        def replication_fence(m, body, query):
+            root = self.config.store.store_path()
+            # Same epoch discipline as every other demotion path: only
+            # a STRICTLY HIGHER election epoch may fence this store.  A
+            # stale standby from a prior election (or a replayed /
+            # misdirected POST) must not take down a healthy primary.
+            ours = read_epoch(root)
+            theirs = int((body or {}).get("epoch", 0) or 0)
+            if theirs <= ours:
+                return 409, {
+                    "error": f"fence epoch {theirs} is not newer than "
+                             f"this store's epoch {ours}",
+                    "epoch": ours,
+                }
+            root.mkdir(parents=True, exist_ok=True)
+            (root / FENCE_FILE).write_text(
+                json.dumps(dict(body or {}))
+            )
+            # Demote AFTER this response flushes: the caller (a
+            # promoted standby) needs the acknowledgement, and the
+            # fence watch would take up to an interval to notice.
+            def demote():
+                import time as _time
+
+                _time.sleep(0.2)
+                print(
+                    "store fenced by peer over /replication/fence — "
+                    "demoting: shutting down to prevent split-brain",
+                    flush=True,
+                )
+                self.shutdown()
+
+            threading.Thread(target=demote, daemon=True).start()
+            return 200, {"fenced": True}
+
+        add("POST", r"/replication/fence", replication_fence)
+
     # -- HTTP plumbing --------------------------------------------------------
 
     def _handle_raw(self, handler, m, body, query):
@@ -1358,10 +1455,14 @@ class APIServer:
         shared filesystem (where the fence write succeeds) the demoted
         primary notices within one check interval and stops serving;
         the supervisor's restart then hits serve()'s startup refusal.
+        Without shared storage the same watch polls the HA peer's
+        /replication/status: a peer serving a HIGHER election epoch
+        promoted over us — self-fence and demote (store/ha.py).
         """
         from learningorchestra_tpu.store.ha import is_fenced
 
         store_root = self.config.store.store_path()
+        peer = self.config.ha.peer
 
         def watch():
             # wait() doubles as the sleep AND the exit signal: a
@@ -1371,6 +1472,8 @@ class APIServer:
                 self.FENCE_CHECK_INTERVAL_S
             ):
                 fence = is_fenced(store_root)
+                if fence is None and peer:
+                    fence = _peer_supersedes(store_root, peer)
                 if fence is not None:
                     print(
                         "store fenced while serving (promoted_to="
@@ -1431,11 +1534,53 @@ class APIServer:
         self.ctx.close()
 
 
+def _peer_supersedes(store_root, peer: str) -> dict | None:
+    """Did the HA peer promote over this store?  Returns the fence
+    record (after writing it locally, best-effort) when the peer is a
+    primary serving a STRICTLY HIGHER election epoch, else None.
+
+    This is the no-shared-disk half of fencing: the standby couldn't
+    write our marker and the fence POST hit a dead process, so the
+    epoch comparison is what stops the stale side.  An unreachable
+    peer is the NORMAL case (a monitoring standby serves HTTP only
+    after promotion) and means "not superseded".
+    """
+    from learningorchestra_tpu.store.ha import peer_status
+    from learningorchestra_tpu.store.replica import (
+        FENCE_FILE,
+        read_epoch,
+    )
+
+    status = peer_status(peer)
+    if (
+        status is None
+        or status.get("role") != "primary"
+        or int(status.get("epoch", 0)) <= read_epoch(store_root)
+    ):
+        return None
+    fence = {
+        "promoted_to": peer,
+        "epoch": status.get("epoch"),
+        "reason": "peer holds higher election epoch",
+    }
+    try:
+        # Durable self-fence: the supervisor's restart refuses at
+        # startup without another peer round-trip.
+        store_root.mkdir(parents=True, exist_ok=True)
+        (store_root / FENCE_FILE).write_text(json.dumps(fence))
+    except OSError:
+        pass
+    return fence
+
+
 def serve(config: Config | None = None) -> None:
     from learningorchestra_tpu.store.ha import is_fenced
 
     config = config or get_config()
-    fence = is_fenced(config.store.store_path())
+    store_root = config.store.store_path()
+    fence = is_fenced(store_root)
+    if fence is None and config.ha.peer:
+        fence = _peer_supersedes(store_root, config.ha.peer)
     if fence is not None:
         # A standby promoted itself over this store: serving from it
         # now would split-brain the cluster.  Exit CLEANLY so the

@@ -103,8 +103,27 @@ class Context:
     def _make_base(cluster: str, port: int) -> str:
         if cluster.startswith(("http://", "https://")):
             return cluster.rstrip("/")
-        if ":" in cluster:
+        if "/" in cluster:
+            # Path-bearing cluster string ("gateway:8080/tenant-a"):
+            # pass through — any port is embedded, and bracketing
+            # would corrupt it.
             return f"http://{cluster}"
+        # host:port only when the suffix is numeric AND the host part
+        # is unambiguous: a plain name/IPv4 (no colon) or a bracketed
+        # IPv6 literal.  Anything else with colons is a bare IPv6
+        # address ("::1", "2001:db8:0:0:0:0:0:1") — its last group may
+        # be decimal, so it must never be split on the final colon;
+        # bracket it and append the default port.  (Kept in sync by
+        # hand with store/replica.py make_transport — the client stays
+        # import-free so it can be vendored standalone.)
+        host, _, maybe_port = cluster.rpartition(":")
+        unambiguous = ":" not in host or (
+            host.startswith("[") and host.endswith("]")
+        )
+        if host and maybe_port.isdigit() and unambiguous:
+            return f"http://{host}:{maybe_port}"
+        if ":" in cluster and not cluster.startswith("["):
+            return f"http://[{cluster}]:{port}"
         return f"http://{cluster}:{port}"
 
     def request(self, verb: str, path: str, body: dict | None = None,

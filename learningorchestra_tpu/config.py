@@ -152,6 +152,19 @@ class DistributedConfig:
 
 
 @dataclasses.dataclass
+class HAConfig:
+    """Store failover pairing (store/ha.py — the reference's mongo
+    replica set, reference: docker-compose.yml:42-90)."""
+
+    # "host:port" of the HA partner node: the standby before promotion,
+    # the old primary after.  When set, serve() refuses to start — and
+    # a running primary self-demotes — if the peer answers
+    # /replication/status with a HIGHER election epoch (it promoted
+    # over this store during a partition).  Needs no shared disk.
+    peer: str = ""
+
+
+@dataclasses.dataclass
 class Config:
     store: StoreConfig = dataclasses.field(default_factory=StoreConfig)
     api: APIConfig = dataclasses.field(default_factory=APIConfig)
@@ -160,6 +173,7 @@ class Config:
     dist: DistributedConfig = dataclasses.field(
         default_factory=DistributedConfig
     )
+    ha: HAConfig = dataclasses.field(default_factory=HAConfig)
 
     @staticmethod
     def from_env() -> "Config":
@@ -195,6 +209,8 @@ class Config:
             cfg.dist.jax_coordinator = env["LO_TPU_JAX_COORDINATOR"]
         if "LO_TPU_WORLD_SIZE" in env:
             cfg.dist.num_processes = int(env["LO_TPU_WORLD_SIZE"])
+        if "LO_HA_PEER" in env:
+            cfg.ha.peer = env["LO_HA_PEER"]
         return cfg
 
 

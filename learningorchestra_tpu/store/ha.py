@@ -9,25 +9,38 @@ process-pair story instead of a database protocol:
 - The PRIMARY is an ordinary ``serve`` process over its store directory.
 - A STANDBY process (``python -m learningorchestra_tpu standby``) runs a
   :class:`StandbyMonitor`: it ships the primary's WALs continuously
-  (:class:`~learningorchestra_tpu.store.replica.WalReplica`), probes the
-  primary's ``/health`` route every ``check_interval`` seconds, and
-  after ``max_misses`` consecutive failed probes performs the election
-  a Mongo secondary would win:
+  (:class:`~learningorchestra_tpu.store.replica.WalReplica`) — through
+  the filesystem when it shares a mount with the primary, or over the
+  primary's ``/replication`` HTTP routes when it runs on its own host
+  with its own disk (the mongo-secondary topology; pass the primary's
+  ADDRESS instead of a store path).  It probes the primary's
+  ``/health`` route every ``check_interval`` seconds, and after
+  ``max_misses`` consecutive failed probes performs the election a
+  Mongo secondary would win:
 
-  1. **final sync** — ship every complete WAL record still readable from
-     the primary's directory.  On a shared filesystem (the local
-     deployment) a kill -9'd primary loses NO acknowledged writes: they
-     are all in its WALs, and only the torn tail — which the primary's
-     own restart recovery would also discard — is withheld.  Across
-     hosts the loss window is the replication lag, exactly Mongo's
-     w:1 rollback window.
-  2. **fence** — write a ``.fenced`` marker into the old primary's store
-     directory.  A supervised restart of the old primary sees the marker
-     and refuses to serve (clean exit), preventing the split-brain a
-     revived Mongo primary avoids via election terms.
-  3. **promote** — the replica directory is a valid store directory, so
-     the standby opens it writable and starts the FULL API server on its
-     own port: the new primary.
+  1. **final sync** — ship every complete WAL record still readable
+     from the primary.  On a shared filesystem a kill -9'd primary
+     loses NO acknowledged writes: they are all in its WALs, and only
+     the torn tail — which the primary's own restart recovery would
+     also discard — is withheld.  Over the network the loss window is
+     the replication lag, exactly Mongo's w:1 rollback window.
+  2. **fence** — mark the old primary dead: write a ``.fenced`` marker
+     into its store directory (filesystem transport) or POST it to the
+     primary's ``/replication/fence`` route (network transport, lands
+     only if the "dead" primary is actually alive behind a partition —
+     which is precisely when the fence matters).  A fenced primary
+     refuses to serve; a RUNNING one self-demotes (api/server.py).
+  3. **epoch bump** — the promoted replica's ``.epoch`` becomes the
+     primary's last-known epoch + 1 (mongo's election term).  A
+     restarted old primary configured with ``LO_HA_PEER`` asks its
+     peer's ``/replication/status`` and refuses to serve when the peer
+     holds a HIGHER epoch — split-brain protection that needs no
+     shared disk.
+  4. **promote** — the replica directory is a valid store directory, so
+     the standby opens it writable and starts the FULL API server on
+     its own port: the new primary.  A ``.promoted`` record in the
+     replica root makes standby restarts resume as primary instead of
+     re-syncing from (and being rolled back by) the dead primary.
 
 Clients pass ``failover=`` to :class:`~learningorchestra_tpu.client.Context`
 and retry once against the standby address on connection failure — the
@@ -45,12 +58,31 @@ from datetime import datetime, timezone
 from pathlib import Path
 
 from learningorchestra_tpu.log import get_logger
-from learningorchestra_tpu.store.replica import WalReplica
+from learningorchestra_tpu.store.replica import (
+    FENCE_FILE,
+    WalReplica,
+    make_transport,
+    read_epoch,
+    write_epoch,
+)
+
+__all__ = [
+    "FENCE_FILE",
+    "PROMOTED_FILE",
+    "StandbyMonitor",
+    "is_fenced",
+    "peer_status",
+    "read_epoch",
+    "run_standby",
+    "write_epoch",
+]
 
 log = get_logger("ha")  # get_logger prepends the "lo." namespace
 
-#: Marker file a promotion writes into the OLD primary's store dir.
-FENCE_FILE = ".fenced"
+#: Record a promotion writes into its OWN replica root — the standby's
+#: durable memory that it became primary (the fence marker lives on the
+#: OLD primary's disk, which a network standby cannot read).
+PROMOTED_FILE = ".promoted"
 
 
 def is_fenced(store_root: str | Path) -> dict | None:
@@ -58,21 +90,55 @@ def is_fenced(store_root: str | Path) -> dict | None:
     promotion, else None.  ``serve`` checks this at startup so a
     supervisor-restarted old primary exits instead of split-braining."""
     path = Path(store_root) / FENCE_FILE
-    if not path.exists():
-        return None
     try:
         return json.loads(path.read_text())
-    except ValueError:
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError):
+        # Unreadable ≠ absent: a marker we cannot parse (torn write,
+        # permission change) still means SOMEONE fenced this store —
+        # fail safe and refuse to serve rather than split-brain.
         return {"reason": "unreadable fence marker"}
 
 
+def promotion_record(replica_root: str | Path) -> dict | None:
+    """The ``.promoted`` record if this replica already became primary."""
+    path = Path(replica_root) / PROMOTED_FILE
+    try:
+        return json.loads(path.read_text())
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError):
+        return {"reason": "unreadable promotion record"}
+
+
+def peer_status(peer_addr: str, *, timeout: float = 2.0,
+                prefix: str = "/api/learningOrchestra/v1") -> dict | None:
+    """One ``/replication/status`` round-trip to the HA peer.
+
+    Returns the peer's ``{"role", "epoch", ...}`` record, or None when
+    the peer is unreachable (normal while the partner is a monitoring
+    standby — it serves HTTP only after promotion)."""
+    url = f"http://{peer_addr}{prefix}/replication/status"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read())
+    except (urllib.error.URLError, OSError, ValueError):
+        return None
+
+
 class StandbyMonitor:
-    """Ship WALs from a primary and decide when to take over."""
+    """Ship WALs from a primary and decide when to take over.
+
+    ``primary_store`` may be a path (filesystem shipping over a shared
+    mount) or ``None`` — in which case WALs ship over HTTP from
+    ``primary_addr`` and the node pair needs no shared storage at all.
+    """
 
     def __init__(
         self,
         primary_addr: str,
-        primary_store: str | Path,
+        primary_store: str | Path | None,
         replica_root: str | Path,
         *,
         check_interval: float = 0.5,
@@ -82,8 +148,14 @@ class StandbyMonitor:
         require_first_contact: bool = True,
     ):
         self.primary_addr = primary_addr
-        self.primary_store = Path(primary_store)
-        self.replica = WalReplica(primary_store, replica_root)
+        self.primary_store = (
+            Path(primary_store) if primary_store is not None else None
+        )
+        transport = make_transport(
+            str(primary_store) if primary_store is not None
+            else primary_addr
+        )
+        self.replica = WalReplica(transport, replica_root)
         self.check_interval = check_interval
         self.max_misses = max_misses
         self.probe_timeout = probe_timeout
@@ -97,6 +169,10 @@ class StandbyMonitor:
         self.require_first_contact = require_first_contact
         self.saw_primary = False
         self.misses = 0
+        # The primary's election term, refreshed on every successful
+        # sync — promotion bumps from the LAST KNOWN value because the
+        # primary is usually unreachable by then.
+        self.primary_epoch = 0
 
     def probe(self) -> bool:
         """One /health round-trip: is the primary PROCESS alive?
@@ -129,9 +205,20 @@ class StandbyMonitor:
         """
         try:
             self.replica.sync()
+            # Never let the cached epoch REGRESS: a degraded primary
+            # whose store dir unmounted can answer a listing with
+            # epoch 0 (read_epoch swallows the OSError); promoting
+            # from a regressed value would mint an epoch BELOW the
+            # real history and the split-brain protection would wave
+            # the stale primary back in.
+            self.primary_epoch = max(
+                self.primary_epoch, self.replica.transport.epoch()
+            )
         except OSError as exc:
             # A vanishing primary directory is itself a failure signal;
-            # keep probing — the health check decides.
+            # keep probing — the health check decides.  Nothing is
+            # deleted on this path: sync() raised before touching the
+            # replica's WALs.
             log.warning(f"standby sync error: {exc}")
         if self.probe():
             if not self.saw_primary:
@@ -168,39 +255,54 @@ class StandbyMonitor:
         return self.promote()
 
     def promote(self) -> Path:
-        """Final-sync, fence the old primary, hand over the directory."""
+        """Final-sync, bump the epoch, fence the old primary, hand
+        over the directory.  The final sync never deletes replicated
+        data (``allow_drops=False``) — a dying primary that presents
+        an empty or missing store must not take the replica with it."""
         try:
-            shipped = self.replica.sync()
+            shipped = self.replica.sync(allow_drops=False)
+            self.primary_epoch = max(
+                self.primary_epoch, self.replica.transport.epoch()
+            )
         except OSError:
             shipped = {}
-        self._write_fence()
-        total = sum(shipped.values())
-        log.info(
-            f"promoted replica {self.replica.replica_root} "
-            f"(final sync shipped {total} bytes)"
-        )
-        return self.replica.replica_root
-
-    def _write_fence(self) -> None:
+        new_epoch = self.primary_epoch + 1
+        write_epoch(self.replica.replica_root, new_epoch)
         record = {
             "promoted_to": self.new_primary_addr,
             "replica_root": str(self.replica.replica_root),
+            "old_primary": self.primary_addr,
+            "epoch": new_epoch,
             "at": datetime.now(timezone.utc).isoformat(),
         }
+        # Durable local memory FIRST: if we crash between here and
+        # serving, the supervisor restart must resume as primary, not
+        # re-sync from (and get rolled back by) the dead primary.
+        (self.replica.replica_root / PROMOTED_FILE).write_text(
+            json.dumps(record)
+        )
+        self._write_fence(record)
+        total = sum(shipped.values())
+        log.info(
+            f"promoted replica {self.replica.replica_root} "
+            f"(epoch {new_epoch}, final sync shipped {total} bytes)"
+        )
+        return self.replica.replica_root
+
+    def _write_fence(self, record: dict) -> None:
         try:
-            self.primary_store.mkdir(parents=True, exist_ok=True)
-            fence = self.primary_store / FENCE_FILE
-            fence.write_text(json.dumps(record))
+            self.replica.transport.fence(record)
         except OSError as exc:
-            # The primary's disk may be gone entirely — promotion must
-            # still proceed; the fence is best-effort protection for the
-            # shared-filesystem deployment where a restart CAN race us.
+            # The primary may be gone entirely — promotion must still
+            # proceed.  Over the filesystem this is best-effort
+            # protection; over the network the epoch comparison
+            # (serve()'s peer check) covers the restarted primary.
             log.warning(f"could not fence old primary: {exc}")
 
 
 def run_standby(
     primary_addr: str,
-    primary_store: str | Path,
+    primary_store: str | Path | None,
     replica_root: str | Path,
     port: int,
     *,
@@ -229,32 +331,48 @@ def run_standby(
         config = Config.from_env()
         config.store.root = str(promoted)
         config.api.port = port
+        # The dead primary is now OUR peer: if it resurrects with a
+        # higher epoch (it re-promoted over us during a partition), we
+        # must stand down — the fence watch polls it.
+        config.ha.peer = primary_addr
         set_config(config)  # services resolving get_config() must agree
         APIServer(config).serve_forever(host=host, port=port)
 
-    fence = is_fenced(primary_store)
-    if fence is not None:
-        # The old primary is already fenced.  If WE fenced it (same
-        # replica root), this is a standby RESTART after promotion: the
-        # replica dir is the current system of record — syncing from
-        # the dead primary again would classify our own post-failover
-        # WAL growth as a rewrite and roll it back.  Serve immediately.
-        if Path(fence.get("replica_root", "")).resolve() == (
-            Path(replica_root).resolve()
-        ):
-            log.info(
-                "store already promoted to this replica — resuming as "
-                "primary without re-sync"
-            )
-            become_primary(Path(replica_root))
-            return
-        raise SystemExit(
-            f"{primary_store} is fenced in favor of "
-            f"{fence.get('replica_root')!r} (promoted_to="
-            f"{fence.get('promoted_to')!r}) — refusing to stand by for "
-            "a dead primary; re-point --primary/--primary-store at the "
-            "current one."
+    # Standby RESTART after promotion: the replica dir's own record is
+    # authoritative (a network standby cannot read the old primary's
+    # fence marker).  The replica dir is the current system of record —
+    # syncing from the dead primary again would classify our own
+    # post-failover WAL growth as a rewrite and roll it back.
+    if promotion_record(replica_root) is not None:
+        log.info(
+            "store already promoted to this replica — resuming as "
+            "primary without re-sync"
         )
+        become_primary(Path(replica_root))
+        return
+
+    if primary_store is not None:
+        fence = is_fenced(primary_store)
+        if fence is not None:
+            # If WE fenced it (same replica root), this is a pre-
+            # ``.promoted``-era restart after promotion: resume as
+            # primary.  Otherwise someone ELSE is primary now.
+            if Path(fence.get("replica_root", "")).resolve() == (
+                Path(replica_root).resolve()
+            ):
+                log.info(
+                    "store already promoted to this replica — resuming "
+                    "as primary without re-sync"
+                )
+                become_primary(Path(replica_root))
+                return
+            raise SystemExit(
+                f"{primary_store} is fenced in favor of "
+                f"{fence.get('replica_root')!r} (promoted_to="
+                f"{fence.get('promoted_to')!r}) — refusing to stand by "
+                "for a dead primary; re-point --primary/--primary-store "
+                "at the current one."
+            )
 
     monitor = StandbyMonitor(
         primary_addr,
@@ -265,7 +383,8 @@ def run_standby(
         new_primary_addr=f"{advertised_host}:{port}",
     )
     log.info(
-        f"standby shipping {primary_store} -> {replica_root}, "
+        f"standby shipping {primary_store or primary_addr} -> "
+        f"{replica_root} via {monitor.replica.transport!r}, "
         f"watching http://{primary_addr}/health"
     )
     become_primary(monitor.run_until_takeover())

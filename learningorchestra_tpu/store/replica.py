@@ -11,6 +11,24 @@ read view.  Failover is :meth:`WalReplica.promote`: the replica
 directory IS a valid store directory, so promotion is just opening it
 for writes.
 
+Transports
+----------
+
+The mongo secondaries replicate **over the wire** — independent nodes,
+independent disks.  Shipping is therefore abstracted behind a transport
+with two implementations:
+
+- :class:`FsWalTransport` — reads the primary's store directory through
+  the filesystem (shared mount / same host), the original deployment.
+- :class:`HttpWalTransport` — pulls WAL byte-ranges from the primary's
+  ``/replication`` routes (api/server.py), so a standby on a different
+  host with its own disk replicates exactly like a mongo secondary.
+
+Both raise :class:`ReplicationUnavailable` (an ``OSError``) when the
+primary cannot be reached, and both are **fail-safe about absence**: a
+primary whose store directory is missing, unmounted, or unreadable is a
+sync FAILURE, never an instruction to delete replicated data.
+
 Semantics:
 
 - **Record-aligned shipping.**  Only byte ranges ending in a complete
@@ -20,15 +38,25 @@ Semantics:
   place; the follower detects the file shrinking below its shipped
   offset and resyncs that collection from byte 0 (same for a dropped
   and recreated collection).
+- **Drop propagation is positive-evidence-only.**  A collection
+  disappears from the replica only when a *successful, non-empty*
+  listing of the primary omits it.  An unreachable or empty primary
+  root (unmounted network mount, empty mountpoint at boot) must not be
+  read as "everything was dropped" — that failure mode would otherwise
+  wipe the replica and promote an empty store.
 - **Pull model.**  ``sync()`` is explicit — call it on a timer, or
   from a cron/sidecar.  The primary needs no cooperation beyond its
-  ordinary appends, exactly like shipping WALs off a Postgres primary.
+  ordinary appends over the filesystem transport, and only the
+  stateless ``/replication`` read routes over HTTP.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import urllib.error
+import urllib.parse
+import urllib.request
 from pathlib import Path
 
 from learningorchestra_tpu.store.document_store import (
@@ -36,13 +64,212 @@ from learningorchestra_tpu.store.document_store import (
     _match,
 )
 
+#: Marker a promotion writes into the OLD primary's store dir.
+FENCE_FILE = ".fenced"
+
+#: Election-term file inside a store directory (mongo's replica-set
+#: term).  Promotions bump it; a node whose peer serves a HIGHER epoch
+#: knows it is the stale side of a healed partition.
+EPOCH_FILE = ".epoch"
+
+
+def read_epoch(store_root: str | Path) -> int:
+    """The store's election epoch; 0 for a never-promoted store."""
+    try:
+        return int((Path(store_root) / EPOCH_FILE).read_text())
+    except (OSError, ValueError):
+        return 0
+
+
+def write_epoch(store_root: str | Path, epoch: int) -> None:
+    root = Path(store_root)
+    root.mkdir(parents=True, exist_ok=True)
+    (root / EPOCH_FILE).write_text(str(int(epoch)))
+
+
+class ReplicationUnavailable(OSError):
+    """The primary's WALs cannot be reached right now.
+
+    Subclasses OSError so callers' existing transient-failure handling
+    (StandbyMonitor.step keeps probing; promote ships best-effort)
+    applies unchanged.
+    """
+
+
+class FsWalTransport:
+    """Read the primary's WALs through the filesystem (shared mount)."""
+
+    def __init__(self, primary_root: str | Path):
+        self.primary_root = Path(primary_root)
+
+    def list_wals(self) -> list[tuple[str, int]]:
+        if not self.primary_root.is_dir():
+            raise ReplicationUnavailable(
+                f"primary store directory {self.primary_root} is "
+                "missing or not a directory"
+            )
+        out = []
+        for wal in sorted(self.primary_root.glob("*.wal")):
+            try:
+                out.append((wal.stem, wal.stat().st_size))
+            except OSError:
+                continue  # dropped between glob and stat
+        return out
+
+    def read(self, name: str, offset: int,
+             length: int | None = None) -> bytes:
+        try:
+            with open(self.primary_root / f"{name}.wal", "rb") as fh:
+                fh.seek(offset)
+                return fh.read() if length is None else fh.read(length)
+        except FileNotFoundError:
+            return b""  # dropped between listing and read
+
+    def epoch(self) -> int:
+        return read_epoch(self.primary_root)
+
+    def fence(self, record: dict) -> None:
+        self.primary_root.mkdir(parents=True, exist_ok=True)
+        (self.primary_root / FENCE_FILE).write_text(json.dumps(record))
+
+    def __repr__(self) -> str:
+        return f"FsWalTransport({self.primary_root})"
+
+
+class HttpWalTransport:
+    """Pull WAL byte-ranges from the primary's ``/replication`` routes.
+
+    The network half of the mongo-secondary story (reference:
+    docker-compose.yml:42-90 — replication rides the overlay network,
+    no shared volume).  The primary serves:
+
+    - ``GET  /replication/wals``                  — listing + epoch
+    - ``GET  /replication/wal/<name>?from=&len=`` — raw byte range
+    - ``POST /replication/fence``                 — fence + self-demote
+
+    The epoch piggybacks on every listing so the standby still knows
+    the primary's last term after the primary dies — promotion bumps
+    from the cached value.
+    """
+
+    #: Bytes per range request when draining an unbounded read.
+    CHUNK = 8 << 20
+
+    def __init__(self, primary_addr: str,
+                 prefix: str = "/api/learningOrchestra/v1",
+                 timeout: float = 5.0):
+        addr = primary_addr
+        if not addr.startswith(("http://", "https://")):
+            addr = f"http://{addr}"
+        self.base = addr.rstrip("/") + prefix + "/replication"
+        self.timeout = timeout
+        self._epoch = 0
+
+    def list_wals(self) -> list[tuple[str, int]]:
+        try:
+            with urllib.request.urlopen(
+                self.base + "/wals", timeout=self.timeout
+            ) as resp:
+                payload = json.loads(resp.read())
+        except (urllib.error.URLError, OSError, ValueError) as exc:
+            raise ReplicationUnavailable(
+                f"primary replication endpoint unreachable: {exc}"
+            ) from exc
+        self._epoch = int(payload.get("epoch", 0))
+        return [
+            (w["name"], int(w["size"]))
+            for w in payload.get("wals", [])
+        ]
+
+    def read(self, name: str, offset: int,
+             length: int | None = None) -> bytes:
+        if length is not None:
+            return self._read_range(name, offset, length)
+        out = bytearray()
+        while True:
+            chunk = self._read_range(
+                name, offset + len(out), self.CHUNK
+            )
+            out += chunk
+            if len(chunk) < self.CHUNK:
+                return bytes(out)
+
+    def _read_range(self, name: str, offset: int, length: int) -> bytes:
+        url = (
+            f"{self.base}/wal/{urllib.parse.quote(name)}"
+            f"?from={int(offset)}&len={int(length)}"
+        )
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout) as r:
+                return r.read()
+        except urllib.error.HTTPError as exc:
+            if exc.code == 404:
+                return b""  # dropped between listing and read
+            raise ReplicationUnavailable(
+                f"replication read failed: HTTP {exc.code}"
+            ) from exc
+        except (urllib.error.URLError, OSError) as exc:
+            raise ReplicationUnavailable(
+                f"primary replication endpoint unreachable: {exc}"
+            ) from exc
+
+    def epoch(self) -> int:
+        """Last epoch observed on a listing — survives primary death."""
+        return self._epoch
+
+    def fence(self, record: dict) -> None:
+        req = urllib.request.Request(
+            self.base + "/fence",
+            method="POST",
+            data=json.dumps(record).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout):
+                pass
+        except (urllib.error.URLError, OSError) as exc:
+            raise ReplicationUnavailable(
+                f"could not deliver fence to primary: {exc}"
+            ) from exc
+
+    def __repr__(self) -> str:
+        return f"HttpWalTransport({self.base})"
+
+
+def make_transport(primary) -> FsWalTransport | HttpWalTransport:
+    """Path-like → filesystem shipping; address/URL → network shipping.
+
+    A string counts as an address when it is an ``http(s)://`` URL or a
+    ``host:port`` pair whose suffix is numeric — anything else (including
+    plain relative paths) is a directory.
+    """
+    if hasattr(primary, "list_wals"):
+        return primary
+    if isinstance(primary, str):
+        if primary.startswith(("http://", "https://")):
+            return HttpWalTransport(primary)
+        # host:port only when the host part is unambiguous — a plain
+        # name/IPv4 or a bracketed IPv6 literal.  A bare IPv6 address
+        # whose last group is decimal must not be misread as
+        # host:port (use "[::1]:8080" to address an IPv6 primary).
+        # (Kept in sync by hand with client.Context._make_base — the
+        # client stays import-free so it can be vendored standalone.)
+        host, _, port = primary.rpartition(":")
+        unambiguous = ":" not in host or (
+            host.startswith("[") and host.endswith("]")
+        )
+        if host and port.isdigit() and unambiguous and (
+            "/" not in primary
+        ):
+            return HttpWalTransport(primary)
+    return FsWalTransport(primary)
+
 
 class WalReplica:
-    """Read-only follower of a primary store directory."""
+    """Read-only follower of a primary store, over either transport."""
 
-    def __init__(self, primary_root: str | Path,
-                 replica_root: str | Path):
-        self.primary_root = Path(primary_root)
+    def __init__(self, primary, replica_root: str | Path):
+        self.transport = make_transport(primary)
         self.replica_root = Path(replica_root)
         self.replica_root.mkdir(parents=True, exist_ok=True)
         self._offsets: dict[str, int] = {}
@@ -58,24 +285,37 @@ class WalReplica:
 
     # -- shipping -------------------------------------------------------------
 
-    def sync(self) -> dict:
+    def sync(self, *, allow_drops: bool = True) -> dict:
         """Ship new complete records for every primary collection;
-        returns {collection: bytes_shipped}."""
+        returns {collection: bytes_shipped}.
+
+        Raises :class:`ReplicationUnavailable` when the primary cannot
+        be listed — distinguishing "primary gone" (keep everything,
+        retry later) from "collection dropped" (mirror the drop).
+        ``allow_drops=False`` additionally suppresses drop propagation
+        for the final pre-promotion sync: a promote must never delete
+        replicated data, whatever the dying primary looks like.
+        """
+        listing = self.transport.list_wals()
         shipped: dict[str, int] = {}
         seen = set()
-        for wal in sorted(self.primary_root.glob("*.wal")):
-            name = wal.stem
+        for name, size in listing:
             seen.add(name)
-            shipped[name] = self._sync_one(name, wal)
+            shipped[name] = self._sync_one(name, size)
         # Collections dropped on the primary disappear here too —
-        # otherwise a promote would resurrect deleted data.
-        for name in list(self._offsets):
-            if name not in seen:
-                self._offsets.pop(name, None)
-                self._docs.pop(name, None)
-                dst = self.replica_root / f"{name}.wal"
-                if dst.exists():
-                    dst.unlink()
+        # otherwise a promote would resurrect deleted data.  Only a
+        # successful NON-EMPTY listing is evidence of a drop: an empty
+        # one is indistinguishable from an unpopulated mountpoint, and
+        # acting on it would wipe the replica in exactly the
+        # primary-disk-gone failure mode HA exists to survive.
+        if allow_drops and listing:
+            for name in list(self._offsets):
+                if name not in seen:
+                    self._offsets.pop(name, None)
+                    self._docs.pop(name, None)
+                    dst = self.replica_root / f"{name}.wal"
+                    if dst.exists():
+                        dst.unlink()
         return shipped
 
     # Shipped-tail window compared against the primary on every sync:
@@ -84,21 +324,29 @@ class WalReplica:
     # diverge the replica.
     TAIL_CHECK = 64
 
-    def _sync_one(self, name: str, src: Path) -> int:
+    def _sync_one(self, name: str, size: int) -> int:
         offset = self._offsets.get(name, 0)
-        try:
-            size = src.stat().st_size
-        except FileNotFoundError:
-            return 0
         rewritten = size < offset
         if not rewritten and offset > 0:
             # Same-or-larger size: confirm the primary still holds the
             # bytes we shipped by comparing the tail window.
             dst = self.replica_root / f"{name}.wal"
             check = min(self.TAIL_CHECK, offset)
-            with open(src, "rb") as fh:
-                fh.seek(offset - check)
-                primary_tail = fh.read(check)
+            primary_tail = self.transport.read(
+                name, offset - check, check
+            )
+            if len(primary_tail) < check:
+                # The file shrank or vanished between the listing and
+                # this read (unmounting mid-sync, rmtree, drop race).
+                # That is an INCONSISTENT SNAPSHOT, not a compaction:
+                # misreading it as a rewrite would clear the replica's
+                # copy — the data-loss path the listing guard exists
+                # to block.  Fail the sync; the next listing tells the
+                # truth.
+                raise ReplicationUnavailable(
+                    f"{name}.wal shrank below its listed size "
+                    "mid-sync — primary snapshot inconsistent"
+                )
             with open(dst, "rb") as fh:
                 fh.seek(offset - check)
                 replica_tail = fh.read(check)
@@ -111,9 +359,7 @@ class WalReplica:
             dst = self.replica_root / f"{name}.wal"
             if dst.exists():
                 dst.unlink()
-        with open(src, "rb") as fh:
-            fh.seek(offset)
-            data = fh.read()
+        data = self.transport.read(name, offset)
         # Ship complete records only: hold back everything past the
         # last newline (a mid-append torn tail must not replicate).
         cut = data.rfind(b"\n")
@@ -170,10 +416,8 @@ class WalReplica:
     def lag_bytes(self) -> int:
         """Total unshipped primary bytes — the replication-lag gauge."""
         lag = 0
-        for wal in self.primary_root.glob("*.wal"):
-            size = wal.stat().st_size
-            off = self._offsets.get(wal.stem, 0)
-            lag += max(0, size - off)
+        for name, size in self.transport.list_wals():
+            lag += max(0, size - self._offsets.get(name, 0))
         return lag
 
     # -- failover -------------------------------------------------------------
@@ -181,8 +425,13 @@ class WalReplica:
     def promote(self, durable_writes: bool = True) -> DocumentStore:
         """Open the replica directory as a WRITABLE store — the
         failover step.  The caller must stop syncing from the old
-        primary first (a promoted replica is a new primary)."""
-        self.sync()
+        primary first (a promoted replica is a new primary).  The
+        final sync is best-effort (the primary is usually dead) and
+        never deletes replicated data."""
+        try:
+            self.sync(allow_drops=False)
+        except OSError:
+            pass
         return DocumentStore(
             self.replica_root, durable_writes=durable_writes
         )

@@ -211,3 +211,30 @@ def test_client_events_curves_and_wildcard(ctx):
     assert meta["epochs"] == 3 and "loss" in meta["metrics"]
     png = ctx.explore_curves.image("evfit_curves")
     assert png[:8] == b"\x89PNG\r\n\x1a\n"
+
+
+class TestMakeBase:
+    # ADVICE r4 (low) + review r5: address parsing must handle IPv6
+    # literals — a bare one is never split on its final colon (whose
+    # last group may be decimal), and must be bracketed for a valid
+    # URL.
+    def test_host_port_forms(self):
+        from learningorchestra_tpu.client import Context
+
+        mb = Context._make_base
+        assert mb("10.0.0.1:8080", 80) == "http://10.0.0.1:8080"
+        assert mb("myhost", 8081) == "http://myhost:8081"
+        assert mb("http://x:9/", 80) == "http://x:9"
+
+    def test_ipv6_forms(self):
+        from learningorchestra_tpu.client import Context
+
+        mb = Context._make_base
+        assert mb("::1", 8080) == "http://[::1]:8080"
+        assert mb("2001:db8::5", 80) == "http://[2001:db8::5]:80"
+        # Full form whose last group is decimal: NOT a host:port.
+        assert mb("2001:db8:0:0:0:0:0:1", 80) == (
+            "http://[2001:db8:0:0:0:0:0:1]:80"
+        )
+        # Explicit port on IPv6 requires brackets.
+        assert mb("[::1]:8080", 80) == "http://[::1]:8080"

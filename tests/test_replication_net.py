@@ -1,0 +1,491 @@
+"""Network WAL shipping + epoch-based split-brain protection
+(store/replica.py HttpWalTransport, api/server.py /replication routes,
+store/ha.py epochs — VERDICT r4 item 3).
+
+The reference's mongo secondaries replicate over the wire — independent
+nodes, independent disks (reference: docker-compose.yml:42-90).  These
+tests prove the standby needs NO shared mount: WAL bytes ride the
+primary's /replication HTTP routes, the fence rides a POST, and a
+restarted stale primary is stopped by the election-epoch comparison
+instead of a fence file it cannot see.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from learningorchestra_tpu.api.server import APIServer
+from learningorchestra_tpu.client import ClientError, Context
+from learningorchestra_tpu.config import Config
+from learningorchestra_tpu.store.document_store import DocumentStore
+from learningorchestra_tpu.store.ha import (
+    FENCE_FILE,
+    StandbyMonitor,
+    is_fenced,
+    peer_status,
+)
+from learningorchestra_tpu.store.replica import (
+    HttpWalTransport,
+    ReplicationUnavailable,
+    WalReplica,
+    make_transport,
+    read_epoch,
+    write_epoch,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture()
+def live_api(tmp_path):
+    """A background APIServer over tmp_path/store; yields (port, store,
+    server)."""
+    cfg = Config()
+    cfg.store.root = str(tmp_path / "store")
+    cfg.store.volume_root = str(tmp_path / "vol")
+    server = APIServer(cfg)
+    port = server.start_background()
+    yield port, cfg.store.store_path(), server
+    server.shutdown()
+
+
+class TestMakeTransport:
+    def test_paths_go_filesystem(self, tmp_path):
+        t = make_transport(str(tmp_path / "store"))
+        assert type(t).__name__ == "FsWalTransport"
+        # Relative paths (even dotted) are directories, not addresses.
+        assert type(make_transport("store/dir")).__name__ == (
+            "FsWalTransport"
+        )
+
+    def test_addresses_go_http(self):
+        assert isinstance(
+            make_transport("127.0.0.1:8080"), HttpWalTransport
+        )
+        assert isinstance(
+            make_transport("http://primary"), HttpWalTransport
+        )
+
+
+class TestReplicationRoutes:
+    def test_listing_carries_wals_epoch_and_fence(self, live_api):
+        port, store_root, server = live_api
+        DocumentStore(store_root).insert_one("jobs", {"v": 1}, _id=0)
+        write_epoch(store_root, 3)
+        url = (f"http://127.0.0.1:{port}/api/learningOrchestra/v1"
+               "/replication/wals")
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            payload = json.loads(resp.read())
+        assert payload["epoch"] == 3
+        assert payload["fenced"] is False
+        names = {w["name"]: w["size"] for w in payload["wals"]}
+        assert "jobs" in names and names["jobs"] > 0
+
+    def test_byte_ranges(self, live_api):
+        port, store_root, server = live_api
+        DocumentStore(store_root).insert_one("jobs", {"v": 1}, _id=0)
+        raw = (store_root / "jobs.wal").read_bytes()
+        base = (f"http://127.0.0.1:{port}/api/learningOrchestra/v1"
+                "/replication/wal/jobs")
+        with urllib.request.urlopen(base, timeout=5) as resp:
+            assert resp.read() == raw
+        with urllib.request.urlopen(
+            f"{base}?from=4&len=8", timeout=5
+        ) as resp:
+            assert resp.read() == raw[4:12]
+        # Past-the-end reads return empty, not an error (the replica
+        # polls ahead of a primary that hasn't written yet).
+        with urllib.request.urlopen(
+            f"{base}?from={len(raw) + 100}", timeout=5
+        ) as resp:
+            assert resp.read() == b""
+
+    def test_missing_wal_404s(self, live_api):
+        port, _, server = live_api
+        url = (f"http://127.0.0.1:{port}/api/learningOrchestra/v1"
+               "/replication/wal/nope")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(url, timeout=5)
+        assert exc.value.code == 404
+
+    def test_status_reports_role_and_epoch(self, live_api):
+        port, store_root, server = live_api
+        status = peer_status(f"127.0.0.1:{port}")
+        assert status == {"role": "primary", "epoch": 0, "fence": None}
+
+    def test_fence_post_requires_newer_epoch(self, live_api):
+        # A stale standby from a prior election (equal or lower epoch)
+        # must not take down a healthy primary — same discipline as
+        # every other demotion path.
+        port, store_root, server = live_api
+        write_epoch(store_root, 2)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/learningOrchestra/v1"
+            "/replication/fence",
+            method="POST",
+            data=json.dumps(
+                {"promoted_to": "10.0.0.2:8081", "epoch": 2}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=5)
+        assert exc.value.code == 409
+        assert is_fenced(store_root) is None
+        # Still serving (no demotion scheduled).
+        assert peer_status(f"127.0.0.1:{port}")["role"] == "primary"
+
+    def test_fence_post_fences_and_demotes(self, live_api):
+        port, store_root, server = live_api
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/learningOrchestra/v1"
+            "/replication/fence",
+            method="POST",
+            data=json.dumps(
+                {"promoted_to": "10.0.0.2:8081", "epoch": 1}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert json.loads(resp.read())["fenced"] is True
+        fence = is_fenced(store_root)
+        assert fence is not None
+        assert fence["promoted_to"] == "10.0.0.2:8081"
+        # The primary self-demotes shortly after acknowledging.
+        deadline = time.time() + 10
+        url = (f"http://127.0.0.1:{port}/api/learningOrchestra/v1"
+               "/health")
+        demoted = False
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(url, timeout=2):
+                    time.sleep(0.1)
+            except urllib.error.HTTPError as exc:
+                if exc.code == 503:
+                    demoted = True
+                    break
+                time.sleep(0.1)
+            except OSError:
+                demoted = True
+                break
+        assert demoted, "fenced primary kept serving"
+
+
+class TestHttpShipping:
+    def test_syncs_and_tails_over_the_wire(self, live_api, tmp_path):
+        port, store_root, server = live_api
+        primary = DocumentStore(store_root)
+        for i in range(5):
+            primary.insert_one("jobs", {"v": i}, _id=i)
+        replica = WalReplica(f"127.0.0.1:{port}", tmp_path / "r")
+        replica.sync()
+        assert replica.count("jobs") == 5
+        # Incremental: only the delta ships on the next sync.
+        primary.insert_one("jobs", {"v": 5}, _id=5)
+        shipped = replica.sync()
+        assert replica.count("jobs") == 6
+        assert 0 < shipped["jobs"] < (store_root / "jobs.wal").stat(
+        ).st_size
+        assert replica.lag_bytes() == 0
+
+    def test_detects_compaction_over_the_wire(self, live_api, tmp_path):
+        port, store_root, server = live_api
+        primary = DocumentStore(store_root)
+        for i in range(10):
+            primary.insert_one("jobs", {"v": i}, _id=i)
+        for i in range(9):
+            primary.delete_one("jobs", i)
+        replica = WalReplica(f"127.0.0.1:{port}", tmp_path / "r")
+        replica.sync()
+        assert replica.count("jobs") == 1
+        primary.compact("jobs")
+        replica.sync()
+        assert replica.count("jobs") == 1
+        assert replica.find("jobs")[0]["v"] == 9
+
+    def test_unreachable_primary_raises_not_wipes(self, tmp_path):
+        dead = _free_port()
+        replica = WalReplica(f"127.0.0.1:{dead}", tmp_path / "r")
+        (tmp_path / "r" / "jobs.wal").write_bytes(
+            b'{"op": "i", "d": {"_id": 0, "v": 1}}\n'
+        )
+        replica2 = WalReplica(f"127.0.0.1:{dead}", tmp_path / "r")
+        with pytest.raises(ReplicationUnavailable):
+            replica2.sync()
+        assert replica2.count("jobs") == 1
+
+    def test_standby_monitor_network_mode(self, live_api, tmp_path):
+        # primary_store=None → WALs ship over HTTP; the monitor works
+        # end-to-end against a live primary with no shared directory.
+        port, store_root, server = live_api
+        DocumentStore(store_root).insert_one("jobs", {"v": 7}, _id=0)
+        mon = StandbyMonitor(
+            f"127.0.0.1:{port}", None, tmp_path / "r",
+            check_interval=0.01, max_misses=2, probe_timeout=2,
+            new_primary_addr="127.0.0.1:9",
+        )
+        assert mon.step() is False  # sync + healthy probe
+        assert mon.saw_primary
+        assert mon.replica.count("jobs") == 1
+        # Kill the primary; the monitor elects and promotes from its
+        # OWN copy, and the fence POST fails silently (dead primary).
+        server.shutdown()
+        while not mon.step():
+            pass
+        promoted = mon.promote()
+        store = DocumentStore(promoted)
+        assert store.find_one("jobs", 0)["v"] == 7
+        # Promotion bumped the election epoch in the replica root.
+        assert read_epoch(promoted) == 1
+
+
+class TestEpochCache:
+    def test_primary_epoch_never_regresses(self, live_api, tmp_path):
+        # Review r5: a degraded primary whose store dir unmounted can
+        # answer a listing with epoch 0 (read_epoch swallows the
+        # OSError).  The standby's cached epoch must not regress, or
+        # promotion would mint a term BELOW the real history and the
+        # stale primary would be waved back in.
+        port, store_root, server = live_api
+        DocumentStore(store_root).insert_one("jobs", {"v": 1}, _id=0)
+        write_epoch(store_root, 5)
+        mon = StandbyMonitor(
+            f"127.0.0.1:{port}", None, tmp_path / "r",
+            check_interval=0.01, max_misses=2, probe_timeout=2,
+        )
+        mon.step()
+        assert mon.primary_epoch == 5
+        (store_root / ".epoch").unlink()  # the "unmounted" answer: 0
+        mon.step()
+        assert mon.primary_epoch == 5  # cached, not regressed
+        server.shutdown()
+        promoted = mon.promote()
+        assert read_epoch(promoted) == 6
+
+
+class TestEpochPeering:
+    def test_serve_refuses_when_peer_epoch_higher(
+        self, live_api, tmp_path, capsys
+    ):
+        # The restarted stale primary: no local fence (the standby
+        # couldn't write one — no shared disk, and we were dead for
+        # the fence POST), but the peer serves a higher epoch.
+        from learningorchestra_tpu.api.server import serve
+
+        port, peer_store, server = live_api
+        write_epoch(peer_store, 2)
+
+        cfg = Config()
+        cfg.store.root = str(tmp_path / "old_primary")
+        cfg.store.volume_root = str(tmp_path / "vol2")
+        cfg.ha.peer = f"127.0.0.1:{port}"
+        serve(cfg)  # must RETURN (refuse), not block serving
+        out = capsys.readouterr().out
+        assert "fenced" in out
+        # The refusal is durable: a local fence marker now exists, so
+        # the next supervisor restart refuses without the peer.
+        assert is_fenced(tmp_path / "old_primary") is not None
+
+    def test_serve_proceeds_when_peer_unreachable(self, tmp_path):
+        # An unreachable peer is the NORMAL case (a monitoring standby
+        # serves HTTP only after promotion): startup must proceed.
+        cfg = Config()
+        cfg.store.root = str(tmp_path / "store")
+        cfg.store.volume_root = str(tmp_path / "vol")
+        cfg.ha.peer = f"127.0.0.1:{_free_port()}"
+        server = APIServer(cfg)
+        port = server.start_background()
+        try:
+            assert peer_status(f"127.0.0.1:{port}")["role"] == "primary"
+        finally:
+            server.shutdown()
+
+    def test_running_primary_demotes_on_peer_epoch(
+        self, live_api, tmp_path
+    ):
+        # Healed partition, network transport: the promoted standby
+        # could never write our fence file, but the fence watch polls
+        # the peer and self-demotes on a higher election epoch.
+        port, peer_store, peer_server = live_api
+        write_epoch(peer_store, 5)
+
+        cfg = Config()
+        cfg.store.root = str(tmp_path / "old_primary")
+        cfg.store.volume_root = str(tmp_path / "vol2")
+        cfg.ha.peer = f"127.0.0.1:{port}"
+        stale = APIServer(cfg)
+        stale.FENCE_CHECK_INTERVAL_S = 0.2
+        stale_port = stale.start_background()
+        url = (f"http://127.0.0.1:{stale_port}"
+               "/api/learningOrchestra/v1/health")
+        deadline = time.time() + 15
+        demoted = False
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(url, timeout=2):
+                    time.sleep(0.1)
+            except urllib.error.HTTPError as exc:
+                if exc.code == 503:
+                    demoted = True
+                    break
+                time.sleep(0.1)
+            except OSError:
+                demoted = True
+                break
+        assert demoted, "stale primary kept serving beside higher epoch"
+        # Self-fence is durable for the supervisor's restart.
+        fence = is_fenced(tmp_path / "old_primary")
+        assert fence is not None
+        assert fence["reason"] == "peer holds higher election epoch"
+
+
+def _spawn(args, env):
+    return subprocess.Popen(
+        args, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def _wait_for_line(proc, needle, timeout=60):
+    import select
+
+    deadline = time.time() + timeout
+    buf = ""
+    while time.time() < deadline:
+        ready, _, _ = select.select([proc.stdout], [], [], 0.5)
+        if ready:
+            chunk = proc.stdout.readline()
+            if chunk:
+                buf += chunk
+                if needle in chunk:
+                    return buf
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"process exited (rc={proc.returncode}) before "
+                f"{needle!r}:\n{buf[-2000:]}"
+            )
+    raise AssertionError(f"timeout waiting for {needle!r}:\n{buf[-2000:]}")
+
+
+def _wait_health(port, timeout=60):
+    deadline = time.time() + timeout
+    url = f"http://127.0.0.1:{port}/api/learningOrchestra/v1/health"
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=2) as resp:
+                if resp.status == 200:
+                    return
+        except OSError:
+            time.sleep(0.2)
+    raise AssertionError(f"no health on :{port}")
+
+
+class TestKill9NetworkFailover:
+    def test_kill9_no_shared_mount(self, tmp_path):
+        """The mongo-secondary topology end-to-end: primary and standby
+        are separate processes over SEPARATE directories with no shared
+        mount — WALs ship over /replication HTTP.  kill -9 the primary
+        mid-storm: the standby promotes, every acknowledged-and-shipped
+        write survives, and the revived old primary (configured with
+        LO_HA_PEER, its disk unfenced — nobody could reach it) refuses
+        to serve against the standby's higher election epoch."""
+        pa, pb = _free_port(), _free_port()
+        env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": str(REPO),
+            "LO_TPU_API_PORT": str(pa),
+            "LO_TPU_STORE_ROOT": str(tmp_path / "a" / "store"),
+            "LO_TPU_VOLUME_ROOT": str(tmp_path / "a" / "vol"),
+            "LO_HA_PEER": f"127.0.0.1:{pb}",
+        })
+        primary = _spawn(
+            [sys.executable, "-m", "learningorchestra_tpu", "serve"],
+            env,
+        )
+        standby = None
+        revived = None
+        try:
+            _wait_health(pa)
+            # NO --primary-store: the standby can only reach the
+            # primary over 127.0.0.1, and its replica lives under a
+            # DIFFERENT root.
+            standby = _spawn(
+                [sys.executable, "-m", "learningorchestra_tpu",
+                 "standby", "--primary", f"127.0.0.1:{pa}",
+                 "--replica", str(tmp_path / "b" / "replica"),
+                 "--port", str(pb), "--host", "127.0.0.1",
+                 "--interval", "0.2", "--misses", "3"], env,
+            )
+            ctx = Context("127.0.0.1", port=pa,
+                          failover=f"127.0.0.1:{pb}")
+
+            acked = []
+            for i in range(12):
+                name = f"storm{i}"
+                ctx.request("POST", "/function/python",
+                            {"name": name, "function": "response = 1"})
+                acked.append(name)
+            _wait_for_line(standby, "takeover arming enabled",
+                           timeout=90)
+            # Over the network the loss window is the replication lag
+            # (mongo's w:1 rollback window) — quiesce for a few sync
+            # intervals so the storm's tail ships, then kill -9.
+            time.sleep(1.0)
+            primary.send_signal(signal.SIGKILL)
+
+            deadline = time.time() + 30
+            recovered = None
+            n = len(acked)
+            while time.time() < deadline:
+                try:
+                    ctx.request(
+                        "POST", "/function/python",
+                        {"name": f"storm{n}",
+                         "function": "response = 1"},
+                    )
+                    recovered = time.time()
+                    acked.append(f"storm{n}")
+                    break
+                except (OSError, ClientError):
+                    time.sleep(0.3)
+            assert recovered is not None, "writes never recovered"
+            assert str(pb) in ctx.base
+
+            for name in acked:
+                docs = ctx.request("GET", f"/function/python/{name}")
+                assert docs and docs[0].get("name") == name, name
+
+            # The revived old primary: its own disk is UNFENCED (the
+            # standby had no way to write there and the fence POST hit
+            # a dead process).  The epoch peer check is what stops it.
+            assert is_fenced(tmp_path / "a" / "store") is None
+            revived = _spawn(
+                [sys.executable, "-m", "learningorchestra_tpu",
+                 "serve"], env,
+            )
+            out, _ = revived.communicate(timeout=60)
+            assert revived.returncode == 0
+            assert "fenced" in out.lower()
+            # And the refusal left a durable local fence for next time.
+            assert is_fenced(tmp_path / "a" / "store") is not None
+        finally:
+            for proc in (primary, standby, revived):
+                if proc is not None and proc.poll() is None:
+                    proc.kill()
+                    proc.wait(timeout=10)

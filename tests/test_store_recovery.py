@@ -181,6 +181,12 @@ class TestWalReplica:
     def test_drop_propagates(self, tmp_path):
         primary = DocumentStore(tmp_path / "p")
         primary.insert_one("gone", {"v": 1})
+        # A second collection keeps the primary's listing non-empty
+        # after the drop: drop propagation requires POSITIVE evidence
+        # (a successful non-empty listing omitting the name) — an
+        # empty listing is indistinguishable from an unpopulated
+        # mountpoint and must never delete replicated data.
+        primary.insert_one("keep", {"v": 2})
         ra = WalReplica(tmp_path / "p", tmp_path / "r")
         ra.sync()
         assert ra.count("gone") == 1
@@ -188,7 +194,91 @@ class TestWalReplica:
         ra.sync()
         assert "gone" not in ra.list_collections()
         assert not (tmp_path / "r" / "gone.wal").exists()
+        assert ra.count("keep") == 1
         primary.close()
+
+    def test_missing_primary_root_never_wipes_replica(self, tmp_path):
+        # ADVICE r4 (high): a vanished primary store directory
+        # (unmounted network mount, renamed dir) must read as a sync
+        # FAILURE — not as "every collection was dropped" — or the
+        # standby would promote an empty store in exactly the
+        # primary-disk-gone failure mode HA exists to survive.
+        import shutil
+
+        from learningorchestra_tpu.store.replica import (
+            ReplicationUnavailable,
+        )
+
+        primary = DocumentStore(tmp_path / "p")
+        primary.insert_one("jobs", {"v": 1})
+        ra = WalReplica(tmp_path / "p", tmp_path / "r")
+        ra.sync()
+        primary.close()
+        shutil.rmtree(tmp_path / "p")
+        with pytest.raises(ReplicationUnavailable):
+            ra.sync()
+        assert ra.count("jobs") == 1
+        assert (tmp_path / "r" / "jobs.wal").exists()
+        # Promotion over the dead primary keeps every replicated doc.
+        promoted = ra.promote()
+        assert promoted.find("jobs")[0]["v"] == 1
+
+    def test_empty_primary_root_never_wipes_replica(self, tmp_path):
+        # The empty-mountpoint-at-boot variant: the directory EXISTS
+        # but holds no WALs.  An empty listing is not drop evidence.
+        primary = DocumentStore(tmp_path / "p")
+        primary.insert_one("jobs", {"v": 1})
+        ra = WalReplica(tmp_path / "p", tmp_path / "r")
+        ra.sync()
+        primary.close()
+        (tmp_path / "p" / "jobs.wal").unlink()
+        assert ra.sync() == {}
+        assert ra.count("jobs") == 1
+        assert (tmp_path / "r" / "jobs.wal").exists()
+
+    def test_vanish_between_listing_and_read_raises(self, tmp_path):
+        # Review r5: a WAL vanishing AFTER a successful listing but
+        # BEFORE the tail-window read returns b"" from the transport;
+        # misreading that as a compaction rewrite would clear the
+        # replica's copy.  It must surface as ReplicationUnavailable
+        # with the replica untouched.
+        from learningorchestra_tpu.store.replica import (
+            ReplicationUnavailable,
+        )
+
+        primary = DocumentStore(tmp_path / "p")
+        primary.insert_one("jobs", {"v": 1})
+        ra = WalReplica(tmp_path / "p", tmp_path / "r")
+        ra.sync()
+        primary.close()
+
+        real = ra.transport.list_wals
+
+        def stale_listing():
+            listing = real()
+            (tmp_path / "p" / "jobs.wal").unlink(missing_ok=True)
+            return listing
+
+        ra.transport.list_wals = stale_listing
+        with pytest.raises(ReplicationUnavailable):
+            ra.sync()
+        assert ra.count("jobs") == 1
+        assert (tmp_path / "r" / "jobs.wal").exists()
+
+    def test_promote_final_sync_never_drops(self, tmp_path):
+        # promote()'s final sync must not delete replicated data even
+        # when the dying primary presents a non-empty listing that
+        # omits a collection (allow_drops=False): a promotion is the
+        # last moment to lose data, not the moment to mirror drops.
+        primary = DocumentStore(tmp_path / "p")
+        primary.insert_one("a", {"v": 1})
+        primary.insert_one("b", {"v": 2})
+        ra = WalReplica(tmp_path / "p", tmp_path / "r")
+        ra.sync()
+        primary.drop("a")
+        promoted = ra.promote()
+        assert promoted.find("a")[0]["v"] == 1
+        assert promoted.find("b")[0]["v"] == 2
 
     def test_replica_restart_resumes(self, tmp_path):
         primary = DocumentStore(tmp_path / "p")
