@@ -216,6 +216,146 @@ class APIServer:
         self._shutting_down = threading.Event()
         self._shutdown_lock = threading.Lock()
         self._shut_down = False
+        # Idempotency ledger (mongo's retryable-writes txnNumber,
+        # reference: docker-compose.yml:42-90 replica set + driver
+        # retry).  Lives in the DOCUMENT STORE so records WAL-ship to
+        # the standby: a mutation retried across a failover replays
+        # its recorded response instead of executing twice.
+        self._idem_lock = threading.Lock()
+        self._idem_writes = 0
+
+    # -- idempotency ----------------------------------------------------------
+
+    #: Store collection holding idempotency records.  Underscore
+    #: prefix keeps it out of the artifact namespace; it sorts first
+    #: in WAL shipping, so the "begun" marker tends to reach the
+    #: standby no later than the mutation's own effects.
+    IDEM_COLLECTION = "_idempotency"
+    #: Records older than this are swept (a retry arriving a day later
+    #: is a new request, matching mongo's retryable-write session TTL).
+    IDEM_TTL_S = 86400.0
+    #: Sweep cadence, counted in new records.
+    IDEM_SWEEP_EVERY = 512
+
+    @staticmethod
+    def _idem_id(key: str) -> int:
+        """Record ``_id`` derived from the key: the store's atomic
+        ``insert_unique`` then gives O(1) lock-free claim semantics
+        instead of a scan under a global lock.  63-bit hash space —
+        collision odds are negligible, and the stored key string is
+        verified on every hit anyway."""
+        import hashlib
+
+        digest = hashlib.sha256(key.encode()).digest()
+        return int.from_bytes(digest[:8], "big") >> 1
+
+    @staticmethod
+    def _idem_fingerprint(verb: str, path: str, body: dict,
+                          query: dict | None = None) -> str:
+        """Request identity recorded with the key: a key reused for a
+        DIFFERENT mutation must be rejected, not replayed — replaying
+        operation A's response to operation B would report success
+        for work that never ran.  Query params are part of the
+        identity: handlers receive them, so two requests differing
+        only there are different operations."""
+        import hashlib
+
+        canon = json.dumps(
+            [body or {}, sorted((query or {}).items())],
+            sort_keys=True, default=str,
+        )
+        return hashlib.sha256(
+            f"{verb} {path} {canon}".encode()
+        ).hexdigest()[:32]
+
+    def _idem_begin(self, key: str, fingerprint: str):
+        """Claim ``key`` or report its prior outcome.
+
+        → ``("replay", status, payload)`` — the mutation already
+        completed; hand back the recorded response (exactly-once).
+        → ``("mismatch", rec)`` — the key was already used for a
+        DIFFERENT request (or, vanishingly, a hash collision).
+        → ``("ambiguous", rec)`` — a prior attempt began but never
+        recorded completion (in flight, or the primary died
+        mid-handler): the system cannot know whether side effects
+        happened, so the caller gets an explicit conflict instead of
+        a silent double-execution.
+        → ``("fresh", _id)`` — first time: a ``begun`` marker is
+        durably inserted before the handler runs.
+        """
+        import time as _time
+
+        from learningorchestra_tpu.store.document_store import (
+            DuplicateKey,
+        )
+
+        docs = self.ctx.documents
+        _id = self._idem_id(key)
+        try:
+            docs.insert_unique(
+                self.IDEM_COLLECTION,
+                {"key": key, "fp": fingerprint, "state": "begun",
+                 "at": _time.time()},
+                _id,
+            )
+        except DuplicateKey:
+            rec = docs.find_one(self.IDEM_COLLECTION, _id) or {}
+            if rec.get("key") != key or rec.get("fp") != fingerprint:
+                return ("mismatch", rec)
+            if rec.get("state") == "done":
+                payload = rec.get("payload")
+                return (
+                    "replay",
+                    rec.get("status", 200),
+                    payload if payload is not None else {},
+                )
+            return ("ambiguous", rec)
+        with self._idem_lock:
+            self._idem_writes += 1
+            # First keyed write after startup ALSO sweeps: the counter
+            # is in-memory, so without it a server restarting before
+            # SWEEP_EVERY writes would never honor the TTL and expired
+            # records would accumulate across restarts (and ship to
+            # every replica).
+            sweep = (
+                self._idem_writes == 1
+                or self._idem_writes % self.IDEM_SWEEP_EVERY == 0
+            )
+        if sweep:
+            # Off the request path: a day-sized ledger sweep must cost
+            # some background thread the time, not an unlucky client.
+            threading.Thread(
+                target=self._idem_sweep, daemon=True
+            ).start()
+        return ("fresh", _id)
+
+    def _idem_finish(self, _id: int, status: int, payload) -> None:
+        """Record the terminal response for replay.  Runs in the
+        handler's thread even after a gateway 504 — the REAL outcome
+        is what a retry must see, not the timeout envelope."""
+        if not isinstance(payload, (dict, list)):
+            payload = None  # mutations return JSON; belt-and-braces
+        try:
+            self.ctx.documents.update_one(
+                self.IDEM_COLLECTION, _id,
+                {"state": "done", "status": status, "payload": payload},
+            )
+        except Exception:
+            pass  # a lost record degrades to at-least-once, not 500
+
+    def _idem_sweep(self) -> None:
+        import time as _time
+
+        docs = self.ctx.documents
+        cutoff = _time.time() - self.IDEM_TTL_S
+        if not docs.collection_exists(self.IDEM_COLLECTION):
+            return
+        try:
+            for rec in docs.find(self.IDEM_COLLECTION):
+                if rec.get("at", 0) < cutoff:
+                    docs.delete_one(self.IDEM_COLLECTION, rec["_id"])
+        except Exception:
+            pass
 
     # -- helpers --------------------------------------------------------------
 
@@ -1254,17 +1394,24 @@ class APIServer:
             rec["total_ms"] += dt_ms
             rec["max_ms"] = max(rec["max_ms"], dt_ms)
 
-    def handle(self, verb: str, path: str, body: dict, query: dict):
+    def handle(self, verb: str, path: str, body: dict, query: dict,
+               idem_key: str | None = None):
         """Dispatch with the gateway budget enforced: request deadline
         (reference: krakend 10 s global timeout → 504), TTL response
         cache on opted-in GETs (300 s ``cache_ttl``), and per-route
-        metrics (krakend's :8090 exporter → GET /metrics)."""
+        metrics (krakend's :8090 exporter → GET /metrics).
+
+        ``idem_key`` (the X-Idempotency-Key header) makes a mutation
+        replay-safe across store failover: a completed attempt's
+        response is recorded in the store and handed back to retries
+        instead of executing the handler twice.
+        """
         import time as _time
 
         t0 = _time.perf_counter()
         if self._inflight is None:
             return self._handle_admitted(
-                verb, path, body, query, t0, _Slot(None)
+                verb, path, body, query, t0, _Slot(None), idem_key
             )
         if not self._inflight.acquire(blocking=False):
             # Saturated: shed load NOW rather than queue behind
@@ -1277,13 +1424,14 @@ class APIServer:
                          "in flight); retry with backoff"
             }
         return self._handle_admitted(
-            verb, path, body, query, t0, _Slot(self._inflight)
+            verb, path, body, query, t0, _Slot(self._inflight), idem_key
         )
 
-    def _handle_admitted(self, verb, path, body, query, t0, slot):
+    def _handle_admitted(self, verb, path, body, query, t0, slot,
+                         idem_key=None):
         try:
             return self._handle_slotted(
-                verb, path, body, query, t0, slot
+                verb, path, body, query, t0, slot, idem_key
             )
         finally:
             # The slot frees only when its LAST owner releases: for a
@@ -1292,7 +1440,8 @@ class APIServer:
             # that's what keeps zombie threads BOUNDED by the cap.
             slot.release()
 
-    def _handle_slotted(self, verb, path, body, query, t0, slot):
+    def _handle_slotted(self, verb, path, body, query, t0, slot,
+                        idem_key=None):
         import time as _time
 
         handler, m, route_key, flags = self.router.resolve(verb, path)
@@ -1321,9 +1470,54 @@ class APIServer:
             with self._cache_lock:
                 self._cache.clear()
 
+        idem_id = None
+        if idem_key and verb in ("POST", "PATCH", "DELETE"):
+            kind, *rest = self._idem_begin(
+                idem_key,
+                self._idem_fingerprint(verb, path, body, query),
+            )
+            if kind == "replay":
+                status, payload = rest
+                self._record_metric(
+                    route_key, status,
+                    (_time.perf_counter() - t0) * 1e3,
+                )
+                return status, payload
+            if kind == "mismatch":
+                self._record_metric(
+                    route_key, 422, (_time.perf_counter() - t0) * 1e3
+                )
+                return 422, {
+                    "error": "this idempotency key was already used "
+                             "for a different request — keys identify "
+                             "ONE logical mutation; mint a fresh key "
+                             "per operation",
+                    "idempotency_key": idem_key,
+                }
+            if kind == "ambiguous":
+                self._record_metric(
+                    route_key, 409, (_time.perf_counter() - t0) * 1e3
+                )
+                return 409, {
+                    "error": "a previous attempt with this "
+                             "idempotency key began but has no "
+                             "recorded outcome (still in flight, or "
+                             "the primary died mid-request) — inspect "
+                             "the artifact's state before retrying "
+                             "with a fresh key",
+                    "idempotency_key": idem_key,
+                }
+            idem_id = rest[0]
+
+        def invoke():
+            result = self._handle_raw(handler, m, body, query)
+            if idem_id is not None:
+                self._idem_finish(idem_id, *result)
+            return result
+
         timeout = self.config.api.request_timeout_s
         if flags.get("no_timeout") or timeout <= 0:
-            status, payload = self._handle_raw(handler, m, body, query)
+            status, payload = invoke()
         else:
             # Per-request thread (NOT a shared pool: N stuck handlers
             # must not poison a fixed pool into serving only 504s). The
@@ -1334,9 +1528,7 @@ class APIServer:
 
             def _run():
                 try:
-                    box["result"] = self._handle_raw(
-                        handler, m, body, query
-                    )
+                    box["result"] = invoke()
                 finally:
                     slot.release()  # holds the slot until REALLY done
 
@@ -1388,7 +1580,10 @@ class APIServer:
                     except json.JSONDecodeError:
                         self._send(400, {"error": "request body is not JSON"})
                         return
-                status, payload = api.handle(verb, parsed.path, body, query)
+                status, payload = api.handle(
+                    verb, parsed.path, body, query,
+                    idem_key=self.headers.get("X-Idempotency-Key"),
+                )
                 self._send(status, payload)
 
             def _send(self, status: int, payload):

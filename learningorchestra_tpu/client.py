@@ -32,6 +32,7 @@ import time
 import urllib.error
 import urllib.parse
 import urllib.request
+import uuid
 from typing import Any
 
 
@@ -57,13 +58,15 @@ class Context:
         # the standby and — mirroring mongo driver re-discovery — keeps
         # talking to it for the rest of the session.
         #
-        # Retry semantics are AT-LEAST-ONCE for mutations (the mongo
-        # retryable-writes caveat): if the dying primary committed a
-        # POST but the response never arrived, the WAL ships it and the
-        # standby answers the retry with 409 duplicate — a 409
-        # immediately after failover usually means the first attempt
-        # landed; GET the artifact to confirm rather than treating it
-        # as a conflict.
+        # Retry semantics are EXACTLY-ONCE for completed mutations
+        # (mongo retryable writes): every POST/PATCH/DELETE carries an
+        # X-Idempotency-Key, the server records the response in the
+        # store (which WAL-ships to the standby), and the failover
+        # retry replays the recorded response instead of executing
+        # twice.  The one ambiguous window is a primary dying MID-
+        # handler: the retry then gets an explicit 409 naming the key
+        # ("no recorded outcome") — inspect the artifact's state
+        # before retrying with a fresh key.
         self._failover_base = (
             self._make_base(failover, port) + prefix if failover else None
         )
@@ -134,8 +137,23 @@ class Context:
                 {k: v if isinstance(v, str) else json.dumps(v)
                  for k, v in query.items()}
             )
+        # One key per LOGICAL mutation, minted before the first
+        # attempt: the failover retry below reuses it, which is what
+        # lets the server replay instead of re-execute (mongo's
+        # txnNumber in retryable writes).  Only minted when a failover
+        # target exists — without one there is no retry path, and the
+        # key would cost the server two durable ledger writes per
+        # mutation for nothing.
+        idem_key = (
+            uuid.uuid4().hex
+            if verb in ("POST", "PATCH", "DELETE")
+            and self._failover_base is not None
+            else None
+        )
         try:
-            return self._one_request(self.base, verb, path, qs, body, raw)
+            return self._one_request(
+                self.base, verb, path, qs, body, raw, idem_key
+            )
         except urllib.error.HTTPError as exc:
             raise self._client_error(exc) from None
         except (urllib.error.URLError, ConnectionError, OSError):
@@ -147,7 +165,8 @@ class Context:
                 raise
             try:
                 result = self._one_request(
-                    self._failover_base, verb, path, qs, body, raw
+                    self._failover_base, verb, path, qs, body, raw,
+                    idem_key,
                 )
             except urllib.error.HTTPError as exc:
                 # The standby answered with an HTTP error: it IS alive
@@ -157,12 +176,16 @@ class Context:
             self.base, self._failover_base = self._failover_base, None
             return result
 
-    def _one_request(self, base, verb, path, qs, body, raw):
+    def _one_request(self, base, verb, path, qs, body, raw,
+                     idem_key=None):
+        headers = {"Content-Type": "application/json"}
+        if idem_key:
+            headers["X-Idempotency-Key"] = idem_key
         req = urllib.request.Request(
             base + path + qs,
             method=verb,
             data=json.dumps(body).encode() if body is not None else None,
-            headers={"Content-Type": "application/json"},
+            headers=headers,
         )
         with urllib.request.urlopen(
             req, timeout=self.request_timeout

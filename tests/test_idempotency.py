@@ -1,0 +1,261 @@
+"""Exactly-once failover retries via idempotency keys (VERDICT r4
+item 4 — mongo's retryable writes under the replica set, reference:
+docker-compose.yml:42-90).
+
+Every client mutation carries an ``X-Idempotency-Key``; the server
+records the terminal response in the ``_idempotency`` store collection
+(which WAL-ships to the standby), so a retry — including one landing on
+a promoted standby after failover — replays the recorded response
+instead of executing the handler twice.  A prior attempt with no
+recorded outcome (primary died mid-handler) answers an explicit 409
+rather than silently double-executing.
+"""
+
+import time
+import uuid
+
+import pytest
+import requests
+
+from learningorchestra_tpu.api import APIServer
+from learningorchestra_tpu.config import Config
+
+PREFIX = "/api/learningOrchestra/v1"
+
+
+@pytest.fixture()
+def api(tmp_path):
+    cfg = Config()
+    cfg.store.root = str(tmp_path / "store")
+    cfg.store.volume_root = str(tmp_path / "volumes")
+    server = APIServer(cfg)
+    port = server.start_background()
+    yield f"http://127.0.0.1:{port}{PREFIX}", server, tmp_path
+    server.shutdown()
+
+
+def poll(base, path, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        docs = requests.get(f"{base}{path}", timeout=10).json()
+        meta = docs[0] if isinstance(docs, list) and docs else {}
+        if meta.get("finished"):
+            return meta
+        time.sleep(0.05)
+    raise AssertionError(f"timeout polling {path}")
+
+
+def _idle(server, timeout=30):
+    """Wait until the job engine has nothing running or queued."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if not server.ctx.engine.running_jobs() and not any(
+            server.ctx.engine.queue_depths().values()
+        ):
+            return
+        time.sleep(0.05)
+
+
+class TestReplay:
+    def test_post_retry_replays_not_conflicts(self, api):
+        base, server, _ = api
+        key = uuid.uuid4().hex
+        body = {"name": "once", "function": "response = 1"}
+        r1 = requests.post(f"{base}/function/python", json=body,
+                           headers={"X-Idempotency-Key": key})
+        assert r1.status_code == 201
+        poll(base, "/function/python/once")
+        # The failover-shaped retry: same key, same body.  Without the
+        # key this is a 409 duplicate; WITH it the recorded 201 comes
+        # back verbatim — the client can't tell the response apart
+        # from the first attempt's.
+        r2 = requests.post(f"{base}/function/python", json=body,
+                           headers={"X-Idempotency-Key": key})
+        assert r2.status_code == 201
+        assert r2.json() == r1.json()
+        # A genuinely NEW mutation (fresh key) still gets the 409.
+        r3 = requests.post(f"{base}/function/python", json=body,
+                           headers={"X-Idempotency-Key": uuid.uuid4().hex})
+        assert r3.status_code == 409
+
+    def test_patch_rerun_executes_exactly_once(self, api, tmp_path):
+        base, server, _ = api
+        marker = tmp_path / "runs.txt"
+        code = (f"open({str(marker)!r}, 'a').write('x')\n"
+                "response = 1")
+        requests.post(
+            f"{base}/function/python",
+            json={"name": "fx", "function": code},
+            headers={"X-Idempotency-Key": uuid.uuid4().hex},
+        )
+        poll(base, "/function/python/fx")
+        assert marker.read_text() == "x"
+
+        key = uuid.uuid4().hex
+        p1 = requests.patch(
+            f"{base}/function/python/fx", json={"function": code},
+            headers={"X-Idempotency-Key": key},
+        )
+        assert p1.status_code < 300
+        poll(base, "/function/python/fx")
+        assert marker.read_text() == "xx"
+        # The retried PATCH must NOT run the user code a third time.
+        p2 = requests.patch(
+            f"{base}/function/python/fx", json={"function": code},
+            headers={"X-Idempotency-Key": key},
+        )
+        assert p2.status_code == p1.status_code
+        assert p2.json() == p1.json()
+        _idle(server)
+        assert marker.read_text() == "xx"
+
+    def test_mutations_without_key_unchanged(self, api):
+        base, server, _ = api
+        body = {"name": "plain", "function": "response = 1"}
+        assert requests.post(
+            f"{base}/function/python", json=body
+        ).status_code == 201
+        assert requests.post(
+            f"{base}/function/python", json=body
+        ).status_code == 409
+
+    def test_get_ignores_key(self, api):
+        base, server, _ = api
+        key = uuid.uuid4().hex
+        for _ in range(2):
+            r = requests.get(f"{base}/health",
+                             headers={"X-Idempotency-Key": key})
+            assert r.status_code == 200
+        # No record was even created: GETs never enter the ledger.
+        assert not server.ctx.documents.collection_exists(
+            server.IDEM_COLLECTION
+        )
+
+
+class TestAmbiguous:
+    def test_begun_without_outcome_is_explicit_409(self, api):
+        # The primary died mid-handler: the begun marker shipped but
+        # no outcome was recorded.  The retry must get an explicit
+        # conflict naming the key — never a silent double-execution.
+        base, server, _ = api
+        key = uuid.uuid4().hex
+        prefix = "/api/learningOrchestra/v1"
+        body = {"name": "ghost", "function": "response = 1"}
+        server.ctx.documents.insert_unique(
+            server.IDEM_COLLECTION,
+            {"key": key,
+             "fp": server._idem_fingerprint(
+                 "POST", f"{prefix}/function/python", body),
+             "state": "begun", "at": time.time()},
+            server._idem_id(key),
+        )
+        r = requests.post(
+            f"{base}/function/python", json=body,
+            headers={"X-Idempotency-Key": key},
+        )
+        assert r.status_code == 409
+        assert "no recorded outcome" in r.json()["error"]
+        # Nothing executed: the artifact does not exist.
+        assert requests.get(
+            f"{base}/function/python/ghost"
+        ).status_code == 404
+
+
+class TestKeyMisuse:
+    def test_query_params_are_part_of_request_identity(self, api):
+        # Review r5: two requests differing only in the query string
+        # are different operations — the fingerprint must catch it.
+        base, server, _ = api
+        key = uuid.uuid4().hex
+        body = {"name": "q_op", "function": "response = 1"}
+        r1 = requests.post(f"{base}/function/python", json=body,
+                           headers={"X-Idempotency-Key": key})
+        assert r1.status_code == 201
+        r2 = requests.post(f"{base}/function/python?force=1", json=body,
+                           headers={"X-Idempotency-Key": key})
+        assert r2.status_code == 422
+
+    def test_key_reuse_across_requests_is_422(self, api):
+        # Review r5: a key identifies ONE logical mutation.  Reusing
+        # it for a different request must be rejected — replaying
+        # operation A's response to operation B would report success
+        # for work that never ran.
+        base, server, _ = api
+        key = uuid.uuid4().hex
+        r1 = requests.post(
+            f"{base}/function/python",
+            json={"name": "op_a", "function": "response = 1"},
+            headers={"X-Idempotency-Key": key},
+        )
+        assert r1.status_code == 201
+        r2 = requests.post(
+            f"{base}/function/python",
+            json={"name": "op_b", "function": "response = 2"},
+            headers={"X-Idempotency-Key": key},
+        )
+        assert r2.status_code == 422
+        assert "different request" in r2.json()["error"]
+        # op_b never executed.
+        assert requests.get(
+            f"{base}/function/python/op_b"
+        ).status_code == 404
+
+
+class TestSweep:
+    def test_expired_records_are_swept(self, api):
+        base, server, _ = api
+        docs = server.ctx.documents
+        stale = docs.insert_one(
+            server.IDEM_COLLECTION,
+            {"key": "old", "state": "done", "status": 201,
+             "payload": {}, "at": time.time() - 2 * server.IDEM_TTL_S},
+        )
+        server._idem_sweep()
+        assert docs.find_one(server.IDEM_COLLECTION, stale) is None
+
+
+class TestFailoverReplay:
+    def test_retry_on_promoted_standby_replays(self, api, tmp_path):
+        """The full story in-process: mutation completes on the
+        primary, WAL-ships to a replica, the standby promotes, and the
+        SAME-KEY retry against the new primary replays the recorded
+        response instead of re-executing the user code."""
+        from learningorchestra_tpu.store.replica import WalReplica
+
+        base, server, root = api
+        marker = tmp_path / "exec_count.txt"
+        code = (f"open({str(marker)!r}, 'a').write('x')\n"
+                "response = 7")
+        key = uuid.uuid4().hex
+        r1 = requests.post(
+            f"{base}/function/python",
+            json={"name": "failover_fn", "function": code},
+            headers={"X-Idempotency-Key": key},
+        )
+        assert r1.status_code == 201
+        poll(base, "/function/python/failover_fn")
+        assert marker.read_text() == "x"
+
+        replica = WalReplica(root / "store", tmp_path / "replica")
+        replica.sync()
+        server.shutdown()
+
+        cfg = Config()
+        cfg.store.root = str(tmp_path / "replica")
+        cfg.store.volume_root = str(root / "volumes")
+        standby = APIServer(cfg)
+        port2 = standby.start_background()
+        base2 = f"http://127.0.0.1:{port2}{PREFIX}"
+        try:
+            r2 = requests.post(
+                f"{base2}/function/python",
+                json={"name": "failover_fn", "function": code},
+                headers={"X-Idempotency-Key": key},
+            )
+            assert r2.status_code == 201
+            assert r2.json() == r1.json()
+            _idle(standby)
+            # Replay, not re-execution: the user code never ran again.
+            assert marker.read_text() == "x"
+        finally:
+            standby.shutdown()
