@@ -110,6 +110,13 @@ class Coordinator:
         self._agents: dict[str, dict] = {}
         self._jobs: dict[str, dict] = {}
         self._next_job = 0
+        # Boot-scoped ID namespace: a restarted coordinator must never
+        # recycle a previous boot's job IDs — a client tolerating a
+        # transient outage (wait_job's unreachable grace) could latch
+        # onto a DIFFERENT submitter's recycled "job-0" and record the
+        # wrong job's results as its own.  With the boot token, a lost
+        # job's ID can only ever answer 404.
+        self._boot = os.urandom(4).hex()
         coord = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -229,7 +236,13 @@ class Coordinator:
         with self._lock:
             if agent_id in self._agents:
                 self._agents[agent_id]["last_seen"] = time.time()
-        return {"ok": True}
+                return {"ok": True}
+        # Unknown agent: the registry is in-memory, so this means the
+        # coordinator RESTARTED since the agent registered (the Swarm
+        # restart-policy path, reference: docker-compose.yml:3-6).
+        # Tell the agent so it re-registers — silently answering ok
+        # would leave the cluster looking empty forever.
+        return {"ok": False, "unknown_agent": True}
 
     def agents(self) -> dict:
         now = time.time()
@@ -244,7 +257,7 @@ class Coordinator:
         self, function: str, kwargs: dict, n_agents: int = 1
     ) -> str:
         with self._lock:
-            job_id = f"job-{self._next_job}"
+            job_id = f"job-{self._boot}-{self._next_job}"
             self._next_job += 1
             self._jobs[job_id] = {
                 "job_id": job_id,
@@ -447,13 +460,50 @@ def submit_job(address: str, function: str, kwargs: dict,
 
 
 def wait_job(address: str, job_id: str, timeout: float,
-             poll_interval: float = 1.0) -> dict:
+             poll_interval: float = 1.0,
+             unreachable_grace: float = 30.0) -> dict:
     """Client-side wait: poll until the job reaches a terminal state.
     On timeout the job is CANCELLED server-side before raising, so a
-    late-finishing agent cannot silently flip the recorded outcome."""
+    late-finishing agent cannot silently flip the recorded outcome.
+
+    Coordinator-death semantics (the Swarm restart-policy path): a
+    connection-level failure is tolerated for ``unreachable_grace``
+    seconds — a supervised restart must not kill a healthy fit the
+    instant the socket blips — but a coordinator that answers 404 has
+    RESTARTED AND LOST the in-memory job record: the fit fails
+    immediately with a clean, named error (never a silent hang until
+    the day-long job timeout), which lands it in the engine's
+    failure ledger for a PATCH re-run.
+    """
+    import http.client
+    import urllib.error
+
     deadline = time.time() + timeout
+    last_ok = time.time()
     while time.time() < deadline:
-        _, job = http_json(f"http://{address}/jobs/{job_id}")
+        try:
+            _, job = http_json(f"http://{address}/jobs/{job_id}")
+        except urllib.error.HTTPError as exc:
+            if exc.code == 404:
+                raise RuntimeError(
+                    f"coordinator no longer knows job {job_id} — it "
+                    "likely restarted and lost in-memory job state; "
+                    "the fit is recorded failed (re-run via PATCH)"
+                ) from exc
+            raise
+        except (OSError, http.client.HTTPException, ValueError) as exc:
+            # OSError: refused/reset.  HTTPException/ValueError: the
+            # coordinator died MID-RESPONSE (truncated body, half a
+            # JSON document) — the same restart blip, same grace.
+            if time.time() - last_ok > unreachable_grace:
+                raise RuntimeError(
+                    f"coordinator {address} unreachable for over "
+                    f"{unreachable_grace:.0f}s while waiting on "
+                    f"{job_id}: {exc}"
+                ) from exc
+            time.sleep(poll_interval)
+            continue
+        last_ok = time.time()
         if job.get("state") in ("finished", "failed", "cancelled"):
             return job
         time.sleep(poll_interval)
@@ -535,10 +585,19 @@ class HostAgent:
                 now = time.time()
                 if now - last_beat > HEARTBEAT_INTERVAL_S:
                     try:
-                        _http(
+                        _, beat = _http(
                             f"{self.base}/agents/heartbeat",
                             {"agent_id": self.agent_id},
                         )
+                        if beat.get("unknown_agent"):
+                            # Coordinator restarted with an empty
+                            # registry: rejoin so new jobs can be
+                            # placed on this host again.
+                            logger.info(kv(
+                                event="agent_reregister",
+                                agent=self.agent_id,
+                            ))
+                            self.register()
                     except OSError:
                         pass
                     last_beat = now

@@ -32,10 +32,37 @@ from typing import Any
 
 import numpy as np
 
+from learningorchestra_tpu.log import get_logger, kv
 from learningorchestra_tpu.parallel.coordinator import (
     init_multihost,
     register_function,
 )
+
+logger = get_logger("launch")
+
+
+def _job_orphaned(job_meta: dict | None) -> bool:
+    """True when the coordinator no longer knows this job — it
+    restarted and lost the record (404), so the submitting client's
+    wait has already failed and a re-run may be in flight.  Any other
+    answer (including an unreachable coordinator, which is transient)
+    counts as NOT orphaned: dropping a valid fit's output on a
+    network blip would be worse than the race this guards."""
+    if not job_meta or not job_meta.get("job_id"):
+        return False
+    import urllib.error
+
+    from learningorchestra_tpu.parallel.coordinator import http_json
+
+    try:
+        http_json(
+            f"{job_meta['coordinator']}/jobs/{job_meta['job_id']}"
+        )
+        return False
+    except urllib.error.HTTPError as exc:
+        return exc.code == 404
+    except OSError:
+        return False
 
 # jax.distributed.initialize may only run once per process; remember the
 # address we joined so a second job on the same agent can proceed (same
@@ -175,12 +202,26 @@ def multihost_fit(
     trainer.fit(x, y, **fit_kwargs)
 
     if out and jax.process_index() == 0:
-        from learningorchestra_tpu.store.volumes import VolumeStorage
+        if _job_orphaned(job_meta):
+            # Generation fence: the coordinator restarted and forgot
+            # this job, so the client's wait already failed and may
+            # have started a PATCH re-run targeting the SAME artifact
+            # name.  A zombie write here would race the re-run
+            # last-writer-wins — drop the output instead; the history
+            # still returns for the (already-failed) record.
+            logger.warning(kv(
+                event="orphaned_fit_output_dropped",
+                job=(job_meta or {}).get("job_id"),
+                artifact=out["name"],
+            ))
+        else:
+            from learningorchestra_tpu.store.volumes import VolumeStorage
 
-        storage = VolumeStorage(out["volume_root"])
-        storage.save_object(
-            out.get("artifact_type", "train/tensorflow"), out["name"], est
-        )
+            storage = VolumeStorage(out["volume_root"])
+            storage.save_object(
+                out.get("artifact_type", "train/tensorflow"),
+                out["name"], est,
+            )
 
     return {
         "rank": rank,
