@@ -18,6 +18,7 @@ from __future__ import annotations
 import math
 from typing import Sequence
 
+import jax.numpy as jnp
 from flax import linen as nn
 
 from learningorchestra_tpu.ops.layers import remat_block
@@ -112,6 +113,28 @@ class _BottleneckBlock(nn.Module):
         return nn.relu(y + residual)
 
 
+def space_to_depth(x, block: int = 2):
+    """[B, H, W, C] → [B, H/block, W/block, C·block²] by folding each
+    spatial block into channels (odd tails zero-padded).
+
+    The MXU sees convolutions as [spatial·C_in → C_out] contractions;
+    an RGB stem's C_in=3 pads to 8 of the 128 systolic lanes, wasting
+    >90% of the array on ~12% of ResNet's FLOPs.  Folding 2×2 pixels
+    into channels turns the stem into a ≥128-deep contraction at a
+    quarter of the spatial positions — the standard public TPU ResNet
+    recipe (see ROOFLINE.md).
+    """
+    b, h, w, c = x.shape
+    pad_h = (-h) % block
+    pad_w = (-w) % block
+    if pad_h or pad_w:
+        x = jnp.pad(x, ((0, 0), (0, pad_h), (0, pad_w), (0, 0)))
+        h, w = h + pad_h, w + pad_w
+    x = x.reshape(b, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, h // block, w // block, c * block * block)
+
+
 class _ResNet(nn.Module):
     stage_sizes: Sequence[int]
     block: type
@@ -121,12 +144,24 @@ class _ResNet(nn.Module):
     # the backward pass — the batch-size headroom knob for conv nets,
     # where activation HBM (B x H x W x C per block) dominates params.
     remat: bool | str = False
+    # Opt-in MXU-friendly stem: space-to-depth(2) + 4×4/s1 conv in the
+    # folded space — the same receptive field (8×8 ⊇ 7×7) and the same
+    # output shape as conv7×7/s2, but a 4·4·4C-deep contraction
+    # instead of a 3-channel one.  Default OFF: the parameter shape
+    # differs, and stored artifacts trained with the classic stem must
+    # keep loading.
+    s2d_stem: bool = False
 
     @nn.compact
     def __call__(self, x):
         if x.ndim == 3:
             x = x[..., None]
-        x = nn.Conv(self.width, (7, 7), (2, 2), use_bias=False)(x)
+        if self.s2d_stem:
+            x = space_to_depth(x, 2)
+            x = nn.Conv(self.width, (4, 4), (1, 1), use_bias=False,
+                        name="stem_s2d")(x)
+        else:
+            x = nn.Conv(self.width, (7, 7), (2, 2), use_bias=False)(x)
         x = nn.GroupNorm(num_groups=min(32, self.width))(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
@@ -156,15 +191,18 @@ class ResNet18(NeuralEstimator):
         learning_rate: float = 1e-3,
         seed: int = 0,
         remat: bool | str = False,
+        s2d_stem: bool = False,
     ):
         self.num_classes = num_classes
         self.remat = remat
+        self.s2d_stem = s2d_stem
         super().__init__(
             _ResNet(
                 stage_sizes=(2, 2, 2, 2),
                 block=_ResNetBlock,
                 num_classes=num_classes,
                 remat=remat,
+                s2d_stem=s2d_stem,
             ),
             loss="softmax_ce",
             learning_rate=learning_rate,
@@ -180,15 +218,18 @@ class ResNet50(NeuralEstimator):
         learning_rate: float = 1e-3,
         seed: int = 0,
         remat: bool | str = False,
+        s2d_stem: bool = False,
     ):
         self.num_classes = num_classes
         self.remat = remat
+        self.s2d_stem = s2d_stem
         super().__init__(
             _ResNet(
                 stage_sizes=(3, 4, 6, 3),
                 block=_BottleneckBlock,
                 num_classes=num_classes,
                 remat=remat,
+                s2d_stem=s2d_stem,
             ),
             loss="softmax_ce",
             learning_rate=learning_rate,

@@ -35,14 +35,21 @@ _p = jnp.asarray(rng.standard_normal((256, 256)), jnp.float32)
 assert float(jnp.sum(jax.jit(lambda a: a @ a)(_p))) != 0
 print("probe matmul ok; sweep next", flush=True)
 
-GRID = [(64, False), (128, False), (128, True), (256, True), (512, True)]
+# (bs, remat, s2d_stem): the s2d points measure ROOFLINE.md's stem
+# prediction — the classic conv7×7 stem wastes >90% of the MXU lanes
+# on C_in=3; space-to-depth folds it into a ≥128-deep contraction.
+GRID = [
+    (64, False, False), (128, False, False), (128, False, True),
+    (128, True, False), (256, True, False), (256, True, True),
+    (512, True, False),
+]
 
 results = []
-for bs, remat in GRID:
+for bs, remat, s2d in GRID:
     n = 2 * bs
     x = rng.standard_normal((n, 224, 224, 3)).astype(np.float32)
     y = rng.integers(0, 1000, (n,), dtype=np.int32)
-    est = ResNet50(remat=remat)
+    est = ResNet50(remat=remat, s2d_stem=s2d)
     est._init_params(jnp.asarray(x[:1]))
     per_sample = _model_flops_per_sample(est, jnp.asarray(x[:1]))
     try:
@@ -50,11 +57,12 @@ for bs, remat in GRID:
         thr = _fused_throughput(est, x, y, bs, k=2)
         wall = time.perf_counter() - t0
     except Exception as exc:  # noqa: BLE001 — OOM points just report
-        print(f"bs={bs} remat={remat}: FAILED {exc!r}", flush=True)
+        print(f"bs={bs} remat={remat} s2d={s2d}: FAILED {exc!r}",
+              flush=True)
         continue
     mfu = thr * per_sample / PEAK if per_sample else 0.0
     row = {
-        "bs": bs, "remat": remat,
+        "bs": bs, "remat": remat, "s2d_stem": s2d,
         "samples_per_sec": round(thr, 1), "mfu": round(mfu, 4),
         "wall_s": round(wall, 1),
     }

@@ -220,6 +220,72 @@ def test_resnet_remat_trains_and_matches():
     )
 
 
+def test_space_to_depth_rearrange():
+    """space_to_depth folds each 2×2 pixel block into channels in
+    row-major tap order — the invariant the s2d stem's conv relies on
+    to see the same receptive field as conv7×7/s2 (ROOFLINE.md)."""
+    import jax.numpy as jnp
+
+    from learningorchestra_tpu.models.vision import space_to_depth
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 4, 6, 3)).astype(np.float32)
+    out = np.asarray(space_to_depth(jnp.asarray(x), 2))
+    assert out.shape == (2, 2, 3, 12)
+    for b in range(2):
+        for i in range(2):
+            for j in range(3):
+                block = x[b, 2 * i:2 * i + 2, 2 * j:2 * j + 2]
+                np.testing.assert_array_equal(
+                    out[b, i, j], block.reshape(-1)
+                )
+    # Odd tails zero-pad instead of crashing (28x28 MNIST -> 14x14,
+    # 5x5 -> 3x3).
+    odd = space_to_depth(jnp.ones((1, 5, 5, 1)), 2)
+    assert odd.shape == (1, 3, 3, 4)
+    assert float(odd[0, 2, 2, 3]) == 0.0  # padded corner tap
+
+
+def test_resnet_s2d_stem_trains_and_keeps_classic_params():
+    """The MXU-friendly stem is a pure opt-in: same output shapes and
+    a finite training step, while the DEFAULT model's parameter tree
+    stays byte-identical so stored artifacts keep loading."""
+    from learningorchestra_tpu.models.vision import _ResNet, _ResNetBlock
+    from learningorchestra_tpu.train.neural import NeuralEstimator
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, 16, 16, 3)).astype(np.float32)
+    y = rng.integers(0, 2, (4,), dtype=np.int32)
+
+    def make(s2d):
+        return NeuralEstimator(
+            _ResNet(stage_sizes=(1, 1), block=_ResNetBlock,
+                    num_classes=2, width=8, s2d_stem=s2d),
+            loss="softmax_ce", learning_rate=1e-3, seed=3,
+        )
+
+    classic, s2d = make(False), make(True)
+    classic.fit(x, y, epochs=1, batch_size=4, shuffle=False)
+    s2d.fit(x, y, epochs=1, batch_size=4, shuffle=False)
+    assert np.isfinite(s2d.history["loss"][-1])
+    # Classic param tree untouched by the new knob (artifact compat).
+    params = classic.params["params"]
+    assert "Conv_0" in params and "stem_s2d" not in params
+    assert params["Conv_0"]["kernel"].shape == (7, 7, 3, 8)
+    # The s2d stem contracts over 4·4·(4·C): 192 deep for RGB.
+    s2d_kernel = s2d.params["params"]["stem_s2d"]["kernel"]
+    assert s2d_kernel.shape == (4, 4, 12, 8)
+    # Identical downstream shapes: predictions agree in shape, and the
+    # first residual block's kernels are shaped the same.
+    assert classic.predict(x).shape == s2d.predict(x).shape
+    assert (
+        classic.params["params"]["_ResNetBlock_0"]["Conv_0"][
+            "kernel"].shape
+        == s2d.params["params"]["_ResNetBlock_0"]["Conv_0"][
+            "kernel"].shape
+    )
+
+
 @pytest.mark.parametrize("cls_name", ["VGG16", "MobileNet"])
 def test_new_vision_models_train_step(cls_name):
     from learningorchestra_tpu import models as zoo
