@@ -223,6 +223,17 @@ class APIServer:
         # its recorded response instead of executing twice.
         self._idem_lock = threading.Lock()
         self._idem_writes = 0
+        # Without shared storage, a primary revived DURING a standby's
+        # promotion can serve until its fence watch first polls the
+        # peer — the check interval bounds that dual-writable window
+        # (a 2-node pair has no majority to elect with; the w:1
+        # tradeoff).  Configured like every other knob (HAConfig /
+        # LO_HA_FENCE_INTERVAL); floored so "0" can't hot-spin peer
+        # polls.
+        if self.config.ha.fence_interval_s > 0:
+            self.FENCE_CHECK_INTERVAL_S = max(
+                0.05, self.config.ha.fence_interval_s
+            )
 
     # -- idempotency ----------------------------------------------------------
 

@@ -162,6 +162,11 @@ class HAConfig:
     # /replication/status with a HIGHER election epoch (it promoted
     # over this store during a partition).  Needs no shared disk.
     peer: str = ""
+    # Seconds between fence/peer-epoch checks while serving.  Bounds
+    # the dual-writable window when a primary revives during its
+    # standby's promotion (no shared disk = no fence file to see).
+    # <= 0 keeps the server default (APIServer.FENCE_CHECK_INTERVAL_S).
+    fence_interval_s: float = 0.0
 
 
 @dataclasses.dataclass
@@ -211,6 +216,8 @@ class Config:
             cfg.dist.num_processes = int(env["LO_TPU_WORLD_SIZE"])
         if "LO_HA_PEER" in env:
             cfg.ha.peer = env["LO_HA_PEER"]
+        if "LO_HA_FENCE_INTERVAL" in env:
+            cfg.ha.fence_interval_s = float(env["LO_HA_FENCE_INTERVAL"])
         return cfg
 
 

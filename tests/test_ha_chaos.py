@@ -141,9 +141,15 @@ class _ExclusionMonitor:
 
     def _loop(self):
         while not self._stop.is_set():
+            # Stamp the START of the probe round: sequential probes
+            # (up to 1.5s each) would otherwise date a violation AFTER
+            # the instant both nodes actually answered, spuriously
+            # pushing a legal transition-window overlap past a
+            # convergence cutoff.
+            t = time.time()
             writable = [p for p in self.ports if self._writable(p)]
             if len(writable) > 1:
-                self.violations.append((time.time(), writable))
+                self.violations.append((t, writable))
             self._stop.wait(0.1)
 
     def __enter__(self):
@@ -390,6 +396,126 @@ class TestHAChaos:
                 assert is_fenced(tmp_path / "store") is not None
             else:
                 assert not _health(pb)
+        finally:
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait(timeout=10)
+
+
+class TestNetworkChaos:
+    """The promotion-vs-restart race WITHOUT shared storage: the
+    revived primary cannot see a fence file, so the epoch peer check
+    is all that stands between it and a split brain.  A revival that
+    lands DURING the standby's promotion (peer not yet serving) will
+    briefly serve — the fence watch's peer poll bounds that window —
+    so the invariant here is CONVERGENCE: one writable node within a
+    couple of fence-check intervals, every violation confined to the
+    transition window, and the loser durably fenced by epoch."""
+
+    @pytest.mark.parametrize("seed", [SEED, SEED + 1])
+    def test_promotion_vs_restart_race_no_shared_fs(
+        self, tmp_path, seed
+    ):
+        rng = random.Random(seed)
+        pa, pb = _free_port(), _free_port()
+        env = _base_env(tmp_path / "a", pa)
+        env.update({
+            "LO_HA_PEER": f"127.0.0.1:{pb}",
+            # Tight fence-watch poll: the dual-writable window this
+            # test bounds is one of these intervals.
+            "LO_HA_FENCE_INTERVAL": "0.5",
+        })
+        procs = []
+        try:
+            primary = _spawn(
+                [sys.executable, "-m", "learningorchestra_tpu",
+                 "serve"], env,
+            )
+            procs.append(primary)
+            _wait_health(pa)
+            standby = _spawn(
+                [sys.executable, "-m", "learningorchestra_tpu",
+                 "standby", "--primary", f"127.0.0.1:{pa}",
+                 "--replica", str(tmp_path / "b" / "replica"),
+                 "--port", str(pb), "--host", "127.0.0.1",
+                 "--interval", "0.2", "--misses", "3"], env,
+            )
+            procs.append(standby)
+            _wait_for_line(standby, "takeover arming enabled")
+
+            ctx = Context("127.0.0.1", port=pa,
+                          failover=f"127.0.0.1:{pb}")
+            acked = []
+            for i in range(5):
+                ctx.request("POST", "/function/python",
+                            {"name": f"net{i}",
+                             "function": "response = 1"})
+                acked.append(f"net{i}")
+            time.sleep(1.0)  # drain replication lag (w:1 window)
+
+            with _ExclusionMonitor([pa, pb]) as excl:
+                kill_t = time.time()
+                primary.send_signal(signal.SIGKILL)
+                primary.wait(timeout=10)
+                time.sleep(rng.uniform(0.0, 1.5))
+                revived = _spawn(
+                    [sys.executable, "-m", "learningorchestra_tpu",
+                     "serve"], env,
+                )
+                procs.append(revived)
+
+                deadline = time.time() + 60
+                stable_since = None
+                winner = None
+                converged = False
+                while time.time() < deadline:
+                    serving = [p for p in (pa, pb) if _health(p)]
+                    if len(serving) == 1:
+                        if winner == serving[0] and stable_since and (
+                            time.time() - stable_since > 8
+                        ):
+                            converged = True
+                            break
+                        if winner != serving[0]:
+                            winner = serving[0]
+                            stable_since = time.time()
+                    else:
+                        winner, stable_since = None, None
+                    time.sleep(0.25)
+                assert converged, (
+                    f"no single writable node held for 8s "
+                    f"(last={winner})"
+                )
+
+            # Any dual-writable instants are confined to the
+            # transition: all strictly before the stable window began,
+            # and the whole transition bounded (kill -> stability in
+            # well under the 60s budget).
+            late = [v for v in excl.violations if v[0] >= stable_since]
+            assert late == [], f"split brain AFTER convergence: {late}"
+            assert stable_since - kill_t < 45
+
+            # Shipped writes survive whoever won.
+            win_ctx = Context("127.0.0.1", port=winner)
+            for name in acked:
+                docs = win_ctx.request(
+                    "GET", f"/function/python/{name}"
+                )
+                assert docs and docs[0].get("name") == name, name
+
+            # If the standby won, the loser lost by EPOCH, not by a
+            # fence file it could never see: its next restart refuses
+            # durably (the peer check writes a local fence).
+            if winner == pb:
+                re2 = _spawn(
+                    [sys.executable, "-m", "learningorchestra_tpu",
+                     "serve"], env,
+                )
+                procs.append(re2)
+                out, _ = re2.communicate(timeout=90)
+                assert re2.returncode == 0
+                assert "fenced" in out.lower()
         finally:
             for proc in procs:
                 if proc.poll() is None:
