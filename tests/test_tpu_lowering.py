@@ -138,3 +138,47 @@ class TestKernelVariantsLowerer:
             q, k, v,
         )
         assert n >= 2  # fwd (for residuals) + backward kernel(s)
+
+
+class TestS2dResNetLowersForTpu:
+    def test_train_step_exports_for_tpu(self):
+        # The r5 MXU-friendly stem (ROOFLINE.md): prove the whole s2d
+        # train step compiles for platform "tpu" on this CPU host so
+        # the ResNet sweep's new grid points can't burn a tunnel
+        # window on a lowering failure.
+        from learningorchestra_tpu.models.vision import (
+            _ResNet,
+            _ResNetBlock,
+        )
+        from learningorchestra_tpu.train.neural import NeuralEstimator
+
+        est = NeuralEstimator(
+            _ResNet(stage_sizes=(1, 1), block=_ResNetBlock,
+                    num_classes=2, width=8, s2d_stem=True),
+            loss="softmax_ce", learning_rate=1e-3, seed=0,
+        )
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(
+            rng.standard_normal((2, 16, 16, 3)).astype(np.float32)
+        )
+        y = jnp.asarray(rng.integers(0, 2, (2,), dtype=np.int32))
+        est._init_params(x[:1])
+        loss_fn = est._loss_and_metrics(est._resolve_loss(np.asarray(y)))
+
+        def step(params, x, y):
+            def L(p):
+                logits = est.module.apply(p, x)
+                loss, _ = loss_fn(
+                    logits, y, jnp.ones_like(y, jnp.float32)
+                )
+                return loss
+
+            return jax.grad(L)(params)
+
+        exp = export.export(jax.jit(step), platforms=["tpu"])(
+            est.params, x, y
+        )
+        mlir = exp.mlir_module()
+        # The stem conv is present and the export carried the full
+        # fwd+bwd graph for the TPU platform.
+        assert "convolution" in mlir
