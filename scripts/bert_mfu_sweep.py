@@ -50,13 +50,14 @@ print(json.dumps({
 # the flash kernel pays off in-model.  bs rows chosen to bracket the
 # HBM limit of one v5e chip for BERT-base + adam.
 GRID = [
-    (128, 16), (128, 32), (128, 64), (128, 128),
+    (128, 16), (128, 32), (128, 64), (128, 128), (128, 256),
     (512, 8), (512, 16), (512, 32),
 ]
 # At seq 128 the flash kernel's tiling overhead can lose to XLA's own
 # fused attention — measure the use_flash=False point where it might:
 # picking the faster attention per shape is a legitimate MFU lever.
-FLASH_OFF_POINTS = {(128, 32), (128, 64), (128, 128), (512, 16)}
+FLASH_OFF_POINTS = {(128, 32), (128, 64), (128, 128), (128, 256),
+                    (512, 16)}
 
 
 def _variants(seq, bs):
