@@ -75,9 +75,18 @@ if [ "${LO_HA_STANDBY:-0}" = "1" ]; then
   # Generous takeover window (2 s x 15 = 30 s dead, matching the
   # compose manifest): a supervised api restart pays ~10 s of python
   # imports, which must read as a blip, not a dead primary.
+  #
+  # LO_HA_TRANSPORT=http ships WALs over the primary's /replication
+  # routes instead of reading its store directory — the no-shared-
+  # storage mode compose/k8s use (store/ha.py); the default reads
+  # through the filesystem, which on ONE host is the same disk anyway.
+  STORE_ARGS=()
+  if [ "${LO_HA_TRANSPORT:-fs}" != "http" ]; then
+    STORE_ARGS=(--primary-store "$LO_TPU_STORE_ROOT")
+  fi
   supervise standby python -m learningorchestra_tpu standby \
     --primary "127.0.0.1:$API_PORT" \
-    --primary-store "$LO_TPU_STORE_ROOT" \
+    ${STORE_ARGS[@]+"${STORE_ARGS[@]}"} \
     --replica "$DATA_ROOT/store-replica" \
     --port "$STANDBY_PORT" --host 127.0.0.1 \
     --interval 2 --misses 15

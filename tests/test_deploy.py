@@ -30,33 +30,50 @@ def _get(url, timeout=5):
 
 
 @pytest.fixture
-def cluster(tmp_path):
-    api_port, coord_port = _free_port(), _free_port()
-    env = {
-        k: v for k, v in os.environ.items() if k != "XLA_FLAGS"
-    }
-    env.update({
-        "JAX_PLATFORMS": "cpu",
-        "LO_TPU_API_PORT": str(api_port),
-        "LO_COORD_PORT": str(coord_port),
-        "LO_DATA_ROOT": str(tmp_path / "data"),
-        "PYTHONPATH": str(REPO),
-    })
-    proc = subprocess.Popen(
-        ["bash", str(REPO / "deploy" / "run_local.sh"), "2"],
-        cwd=tmp_path, env=env, stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT, text=True,
-        start_new_session=True,
-    )
+def launch_cluster(tmp_path):
+    """Factory: bring up run_local.sh with n_agents/extra env; every
+    launched supervisor tree is torn down (TERM then KILL) at exit."""
+    procs = []
+
+    def launch(n_agents=2, extra_env=None):
+        api_port, coord_port = _free_port(), _free_port()
+        env = {
+            k: v for k, v in os.environ.items() if k != "XLA_FLAGS"
+        }
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "LO_TPU_API_PORT": str(api_port),
+            "LO_COORD_PORT": str(coord_port),
+            "LO_DATA_ROOT": str(tmp_path / "data"),
+            "PYTHONPATH": str(REPO),
+        })
+        env.update(extra_env or {})
+        proc = subprocess.Popen(
+            ["bash", str(REPO / "deploy" / "run_local.sh"),
+             str(n_agents)],
+            cwd=tmp_path, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+            start_new_session=True,
+        )
+        procs.append(proc)
+        return proc, api_port, coord_port
+
     try:
-        yield proc, api_port, coord_port
+        yield launch
     finally:
-        os.killpg(proc.pid, signal.SIGTERM)
-        try:
-            proc.wait(timeout=15)
-        except subprocess.TimeoutExpired:
-            os.killpg(proc.pid, signal.SIGKILL)
-            proc.wait(timeout=10)
+        for proc in procs:
+            os.killpg(proc.pid, signal.SIGTERM)
+        for proc in procs:
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                os.killpg(proc.pid, signal.SIGKILL)
+                proc.wait(timeout=10)
+
+
+@pytest.fixture
+def cluster(launch_cluster):
+    return launch_cluster()
 
 
 def _wait_for(fn, timeout=90, what=""):
@@ -236,3 +253,42 @@ def test_k8s_manifest_roles_and_ha_pairing():
     mounts = {m["name"]: m["mountPath"]
               for m in container(standby)["volumeMounts"]}
     assert ns.replica.startswith(mounts["standby-data"])
+
+
+class TestLocalHAStandbyBringup:
+    def test_http_transport_standby_ships_wals(
+        self, launch_cluster, tmp_path
+    ):
+        """LO_HA_STANDBY=1 LO_HA_TRANSPORT=http: the supervised local
+        cluster brings up a NETWORK-mode standby (no --primary-store)
+        that pulls WAL bytes over the api's /replication routes — a
+        write on the api must appear in the standby's replica dir."""
+        _, api_port, _ = launch_cluster(
+            n_agents=0,
+            extra_env={"LO_HA_STANDBY": "1", "LO_HA_TRANSPORT": "http"},
+        )
+        base = (f"http://127.0.0.1:{api_port}"
+                "/api/learningOrchestra/v1")
+        _wait_for(lambda: _get(f"{base}/health")[0] == 200,
+                  timeout=120, what="api health")
+
+        req = urllib.request.Request(
+            f"{base}/function/python",
+            data=json.dumps({
+                "name": "ha_probe", "function": "response = 1",
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 201
+
+        replica = tmp_path / "data" / "store-replica"
+
+        def shipped():
+            wal = replica / "ha_probe.wal"
+            return wal.exists() and wal.stat().st_size > 0
+
+        # Standby polls every 2 s once it reaches the primary; a
+        # cold boot pays the jax import first.
+        _wait_for(shipped, timeout=120,
+                  what="WAL shipped over /replication")
