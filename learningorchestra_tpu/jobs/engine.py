@@ -122,6 +122,18 @@ class JobEngine:
         type): queued work is dispatched to freed workers by weighted
         round-robin across classes, not global FIFO.
         """
+        # Persist the request parameters NOW, not only in the terminal
+        # ledger record: a job killed mid-run (process death, store
+        # failover) otherwise leaves no parameters anywhere, and the
+        # recovery story — "bare PATCH re-uses the last recorded
+        # parameters" — would be unfulfillable for a first run.
+        if parameters is not None:
+            try:
+                self.artifacts.metadata.update(
+                    name, {"requestParameters": parameters}
+                )
+            except Exception:  # noqa: BLE001 — recording is best-effort
+                pass
 
         def run() -> Any:
             meta = self.artifacts.metadata

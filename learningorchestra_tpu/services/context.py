@@ -13,6 +13,7 @@ import pandas as pd
 
 from learningorchestra_tpu.config import Config, get_config
 from learningorchestra_tpu.jobs import JobEngine
+from learningorchestra_tpu.log import get_logger
 from learningorchestra_tpu.store import (
     ArtifactStore,
     VolumeStorage,
@@ -68,7 +69,53 @@ class ServiceContext:
         # Per-job accelerator placement (jobs/leases.py): concurrent
         # neural jobs serialize per chip instead of contending for HBM.
         self.leaser = DeviceLeaser()
+        self._reflag_interrupted_jobs()
         self._init_backend()
+
+    def _reflag_interrupted_jobs(self) -> None:
+        """Any pending/running jobState at startup belonged to a DEAD
+        process — this process hasn't run a job yet.  Left alone it
+        wedges the artifact forever: the job will never finish, and
+        ``require_not_running`` would 409 every PATCH re-run.  Matters
+        most after store failover, where the promoted standby inherits
+        the killed primary's in-flight states through the shipped WAL.
+        Mark them failed with a re-run hint — the reference's
+        unfinished-work re-flag at service startup
+        (data_type_handler_image/data_type_update.py:47-59), resolved
+        into the PATCH-re-run path instead of auto-resubmission (the
+        request parameters live in the ledger;
+        ``last_recorded_parameters`` feeds a bare PATCH)."""
+        for name in self.documents.list_collections():
+            if name.startswith("_"):
+                continue  # internal ledgers (idempotency) have no jobs
+            try:
+                meta = self.artifacts.metadata.read(name)
+            except Exception:
+                continue
+            if meta and meta.get("jobState") in ("pending", "running"):
+                self.artifacts.metadata.mark_failed(
+                    name,
+                    "job interrupted by a server restart or store "
+                    "failover before completing; re-run it with a "
+                    "PATCH (bare PATCH re-uses the last recorded "
+                    "parameters)",
+                )
+                get_logger("context").warning(
+                    f"re-flagged interrupted job {name!r} "
+                    "(was mid-run when the previous process died)"
+                )
+                # Subscribers must see the terminal transition: the
+                # observe event feed + any registered webhooks fire
+                # exactly as the engine's own failure path would
+                # (jobs/engine.py _notify) — a watcher of the dead
+                # job would otherwise wait forever.
+                try:
+                    self.webhooks.notify(
+                        name, "failed",
+                        self.artifacts.metadata.read(name) or {},
+                    )
+                except Exception:  # noqa: BLE001 — startup must finish
+                    pass
 
     def _init_backend(self) -> None:
         """Eagerly initialize the JAX backend on the main thread.
@@ -156,10 +203,13 @@ class ServiceContext:
         return meta
 
     def last_recorded_parameters(self, name: str):
-        """The most recent request parameters persisted to the execution
-        ledger for ``name`` — the fallback a bare PATCH re-run (no body
-        parameters, the natural "just resume" call after a preemption)
-        re-submits with, instead of failing on missing x/y."""
+        """The most recent request parameters persisted for ``name`` —
+        the fallback a bare PATCH re-run (no body parameters, the
+        natural "just resume" call after a preemption or failover)
+        re-submits with, instead of failing on missing x/y.  Terminal
+        ledger rows win (they reflect what actually ran); the
+        submit-time metadata copy covers a job whose FIRST run died
+        before writing any ledger record."""
         rows = [
             d
             for d in self.documents.find(
@@ -167,7 +217,10 @@ class ServiceContext:
             )
             if d.get("parameters") is not None
         ]
-        return rows[-1]["parameters"] if rows else None
+        if rows:
+            return rows[-1]["parameters"]
+        meta = self.artifacts.metadata.read(name) or {}
+        return meta.get("requestParameters")
 
     def checkpoint_dir(self, name: str):
         """Managed per-artifact train-checkpoint tree — the ONE place

@@ -439,3 +439,107 @@ class TestRunningPrimarySelfDemotes:
         # accepting into the kernel backlog where clients would hang.
         with pytest.raises(OSError):
             urllib.request.urlopen(url, timeout=2)
+
+
+class TestInterruptedJobsReflag:
+    def test_startup_reflags_dead_process_jobs(self, tmp_path):
+        """A jobState left at running/pending by a DEAD process (kill
+        -9, or the killed primary's WAL shipped to a promoted standby)
+        must be re-flagged at startup — left alone it wedges the
+        artifact forever: the job never finishes and
+        require_not_running 409s every PATCH re-run.  Reference: the
+        dataTypeHandler re-flags unfinished work at service startup
+        (data_type_handler_image/data_type_update.py:47-59)."""
+        import requests
+
+        from learningorchestra_tpu.api.server import APIServer
+        from learningorchestra_tpu.config import Config
+
+        store = DocumentStore(tmp_path / "store")
+        store.insert_one("wedged", {
+            "name": "wedged", "type": "function/python",
+            "jobState": "running", "finished": False,
+            "modulePath": None, "class": None,
+        }, _id=0)
+        store.insert_one("calm", {
+            "name": "calm", "type": "function/python",
+            "jobState": "finished", "finished": True,
+        }, _id=0)
+        store.close()
+
+        cfg = Config()
+        cfg.store.root = str(tmp_path / "store")
+        cfg.store.volume_root = str(tmp_path / "vol")
+        server = APIServer(cfg)
+        try:
+            meta = server.ctx.artifacts.metadata.read("wedged")
+            assert meta["jobState"] == "failed"
+            assert "interrupted" in meta["exception"]
+            # Terminal artifacts are untouched.
+            calm = server.ctx.artifacts.metadata.read("calm")
+            assert calm["jobState"] == "finished"
+            # Subscribers see the terminal transition: the observe
+            # event feed records the failed event (a watcher of the
+            # dead job must not wait forever).
+            events = server.ctx.documents.find(
+                "observe_events", {"artifact": "wedged"}
+            )
+            assert any(e.get("event") == "failed" for e in events)
+
+            # The wedge is gone: a PATCH re-run is accepted and runs.
+            port = server.start_background()
+            base = (f"http://127.0.0.1:{port}"
+                    "/api/learningOrchestra/v1")
+            r = requests.patch(
+                f"{base}/function/python/wedged",
+                json={"function": "response = 2"},
+            )
+            assert r.status_code < 300, r.text
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                docs = requests.get(
+                    f"{base}/function/python/wedged"
+                ).json()
+                if docs and docs[0].get("finished"):
+                    break
+                time.sleep(0.2)
+            assert docs[0]["jobState"] == "finished"
+        finally:
+            server.shutdown()
+
+
+class TestSubmitTimeParameters:
+    def test_bare_patch_recovers_first_run_interruption(self, tmp_path):
+        """Request parameters are persisted at SUBMIT time (metadata
+        requestParameters), so the advertised recovery path — bare
+        PATCH after an interrupted FIRST run — has parameters to
+        re-use even though the terminal ledger record never got
+        written (review r5)."""
+        from learningorchestra_tpu.api.server import APIServer
+        from learningorchestra_tpu.config import Config
+
+        cfg = Config()
+        cfg.store.root = str(tmp_path / "store")
+        cfg.store.volume_root = str(tmp_path / "vol")
+        server = APIServer(cfg)
+        try:
+            ctx = server.ctx
+            ctx.artifacts.metadata.create("p_job", "function/python")
+            params = {"x": "$ds", "epochs": 3}
+            fut = ctx.engine.submit(
+                "p_job", lambda: 1, parameters=params,
+                job_class="function",
+            )
+            fut.result(timeout=30)
+            # The ledger's terminal record wins while it exists...
+            assert ctx.last_recorded_parameters("p_job") == params
+            # ...and the submit-time copy covers a first run that died
+            # BEFORE any ledger write (delete the execution rows to
+            # model it).
+            for doc in ctx.documents.find(
+                "p_job", {"docType": "execution"}
+            ):
+                ctx.documents.delete_one("p_job", doc["_id"])
+            assert ctx.last_recorded_parameters("p_job") == params
+        finally:
+            server.shutdown()
