@@ -1780,23 +1780,102 @@ def _peer_supersedes(store_root, peer: str) -> dict | None:
 
 
 def serve(config: Config | None = None) -> None:
-    from learningorchestra_tpu.store.ha import is_fenced
+    from learningorchestra_tpu.store.ha import (
+        is_fenced,
+        promotion_record,
+        run_standby,
+    )
+
+    from pathlib import Path as _Path
 
     config = config or get_config()
     store_root = config.store.store_path()
+    rejoin_root = _Path(str(store_root) + ".rejoined")
+
+    # A previous auto-rejoin cycle may already have PROMOTED this node
+    # back to primary (partner died after we rejoined): the rejoined
+    # replica — not the long-fenced original store — is then the
+    # system of record, and a supervisor restart must resume serving
+    # it, never re-stand-by for a dead partner.
+    rejoin_rec = (
+        promotion_record(rejoin_root) if config.ha.auto_rejoin else None
+    )
+    if rejoin_rec:
+        from learningorchestra_tpu.store.replica import read_epoch
+
+        # The rejoin replica only shadows the original store while the
+        # original is still FENCED at a lower epoch.  An operator who
+        # restored the original store as system of record (fence
+        # cleared, epoch caught up) must not have it silently
+        # abandoned for a stale .rejoined directory.
+        if is_fenced(store_root) is None and (
+            read_epoch(store_root) >= read_epoch(rejoin_root)
+        ):
+            print(
+                f"ignoring stale rejoin replica {rejoin_root} — the "
+                "original store is unfenced at an equal-or-higher "
+                "epoch (restored as system of record); delete the "
+                "rejoin directory to silence this.",
+                flush=True,
+            )
+        else:
+            print(
+                "resuming as primary from the promoted rejoin replica "
+                f"{rejoin_root}", flush=True,
+            )
+            run_standby(
+                config.ha.peer or rejoin_rec.get("old_primary")
+                or "127.0.0.1:0",
+                None, rejoin_root, config.api.port,
+                host=config.api.host,
+                check_interval=config.ha.rejoin_interval_s,
+                max_misses=config.ha.rejoin_misses,
+            )
+            return
+
     fence = is_fenced(store_root)
     if fence is None and config.ha.peer:
         fence = _peer_supersedes(store_root, config.ha.peer)
     if fence is not None:
         # A standby promoted itself over this store: serving from it
-        # now would split-brain the cluster.  Exit CLEANLY so the
-        # supervisor's restart-on-failure loop ends instead of
-        # resurrecting a fenced primary (store/ha.py).
+        # now would split-brain the cluster.
+        new_primary = fence.get("promoted_to") or config.ha.peer
+        if config.ha.auto_rejoin and new_primary:
+            # Mongo's stepped-down primary rejoins as a SECONDARY on
+            # its own: become the new primary's standby, shipping its
+            # WALs over the network into a fresh replica root — the
+            # pair regains redundancy with no operator action, and if
+            # the new primary later dies, THIS node promotes and
+            # serves on its original address again.  Conservative
+            # takeover window (ha.rejoin_*): an ordinary restart of
+            # the partner must never get fenced out by this node.
+            print(
+                "store is fenced — auto-rejoining as a standby of "
+                f"{new_primary} (replica: {rejoin_root})",
+                flush=True,
+            )
+            run_standby(
+                new_primary, None, rejoin_root, config.api.port,
+                host=config.api.host,
+                check_interval=config.ha.rejoin_interval_s,
+                max_misses=config.ha.rejoin_misses,
+            )
+            return
+        # Exit CLEANLY so the supervisor's restart-on-failure loop
+        # ends instead of resurrecting a fenced primary (store/ha.py).
+        hint = (
+            "auto-rejoin is ON but no rejoin target could be "
+            "determined (unreadable fence marker and no LO_HA_PEER) — "
+            "fix the pairing or re-join manually."
+            if config.ha.auto_rejoin
+            else "Re-join by running this node as a standby of the "
+                 "new primary, or set LO_HA_AUTO_REJOIN=1 to do this "
+                 "automatically."
+        )
         print(
             "store is fenced — a standby promoted itself to "
             f"{fence.get('promoted_to') or 'a new primary'}; refusing "
-            "to serve. Re-join by running this node as a standby of "
-            "the new primary.",
+            f"to serve. {hint}",
             flush=True,
         )
         return

@@ -167,6 +167,19 @@ class HAConfig:
     # standby's promotion (no shared disk = no fence file to see).
     # <= 0 keeps the server default (APIServer.FENCE_CHECK_INTERVAL_S).
     fence_interval_s: float = 0.0
+    # A fenced primary automatically rejoins as the NEW primary's
+    # standby (network WAL shipping into <store>.rejoined) instead of
+    # exiting — mongo's stepped-down-primary-rejoins-as-secondary,
+    # restoring pair redundancy with no operator action.  Off by
+    # default: rejoining re-syncs the full store over the wire.
+    auto_rejoin: bool = False
+    # Takeover tuning for the auto-rejoined standby.  Defaults match
+    # the deployed standby role's deliberately conservative window
+    # (2 s x 15 = 30 s dead): an ordinary restart of the partner —
+    # process boot alone exceeds a naive threshold — must never get
+    # fenced out by the rejoined node.
+    rejoin_interval_s: float = 2.0
+    rejoin_misses: int = 15
 
 
 @dataclasses.dataclass
@@ -218,6 +231,14 @@ class Config:
             cfg.ha.peer = env["LO_HA_PEER"]
         if "LO_HA_FENCE_INTERVAL" in env:
             cfg.ha.fence_interval_s = float(env["LO_HA_FENCE_INTERVAL"])
+        if "LO_HA_AUTO_REJOIN" in env:
+            cfg.ha.auto_rejoin = env["LO_HA_AUTO_REJOIN"] == "1"
+        if "LO_HA_REJOIN_INTERVAL" in env:
+            cfg.ha.rejoin_interval_s = float(
+                env["LO_HA_REJOIN_INTERVAL"]
+            )
+        if "LO_HA_REJOIN_MISSES" in env:
+            cfg.ha.rejoin_misses = int(env["LO_HA_REJOIN_MISSES"])
         return cfg
 
 

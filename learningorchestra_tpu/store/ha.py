@@ -328,6 +328,8 @@ def run_standby(
     )
 
     def become_primary(promoted: Path) -> None:
+        from learningorchestra_tpu.api.server import _peer_supersedes
+
         config = Config.from_env()
         config.store.root = str(promoted)
         config.api.port = port
@@ -336,14 +338,43 @@ def run_standby(
         # must stand down — the fence watch polls it.
         config.ha.peer = primary_addr
         set_config(config)  # services resolving get_config() must agree
+        # Startup epoch check, same as serve(): a RESUMING promoted
+        # replica may itself have been superseded while down (the
+        # partner auto-rejoined our replica and re-promoted over us) —
+        # serving would split-brain until the fence watch's first
+        # peer poll.  A superseded resume writes its fence and exits
+        # cleanly; the supervisor's next restart refuses immediately.
+        fence = _peer_supersedes(promoted, primary_addr)
+        if fence is not None:
+            print(
+                "promoted replica is superseded by "
+                f"{fence.get('promoted_to')!r} (higher election "
+                "epoch) — refusing to resume as primary.",
+                flush=True,
+            )
+            return
         APIServer(config).serve_forever(host=host, port=port)
 
     # Standby RESTART after promotion: the replica dir's own record is
     # authoritative (a network standby cannot read the old primary's
     # fence marker).  The replica dir is the current system of record —
     # syncing from the dead primary again would classify our own
-    # post-failover WAL growth as a rewrite and roll it back.
+    # post-failover WAL growth as a rewrite and roll it back.  A FENCE
+    # in the replica root overrides the promotion record: someone
+    # re-promoted over this store since.
     if promotion_record(replica_root) is not None:
+        fence = is_fenced(replica_root)
+        if fence is not None:
+            # Clean exit (code 0): a supervisor's restart-on-failure
+            # loop must END here, not crash-loop — same contract as
+            # serve()'s fenced refusal.
+            print(
+                f"promoted replica {replica_root} was later fenced in "
+                f"favor of {fence.get('promoted_to')!r} — superseded; "
+                "refusing to resume as primary.",
+                flush=True,
+            )
+            return
         log.info(
             "store already promoted to this replica — resuming as "
             "primary without re-sync"

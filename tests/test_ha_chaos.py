@@ -521,3 +521,156 @@ class TestNetworkChaos:
                 if proc.poll() is None:
                     proc.kill()
                     proc.wait(timeout=10)
+
+
+class TestAutoRejoin:
+    def test_fenced_primary_rejoins_and_can_reclaim(self, tmp_path):
+        """LO_HA_AUTO_REJOIN=1 — the full mongo-like ping-pong with no
+        operator action: A is fenced out by B's promotion, A's restart
+        auto-rejoins as B's network standby (fresh replica, WALs over
+        HTTP), and when B later dies A promotes BACK (epoch 2) holding
+        every write from both generations; B's own restart then
+        refuses cleanly against A's higher epoch."""
+        pa, pb = _free_port(), _free_port()
+        env = _base_env(tmp_path / "a", pa)
+        env.update({
+            "LO_HA_PEER": f"127.0.0.1:{pb}",
+            "LO_HA_AUTO_REJOIN": "1",
+            "LO_HA_FENCE_INTERVAL": "0.5",
+            # Fast takeover for the test; the production default is
+            # the conservative 2 s x 15 window.
+            "LO_HA_REJOIN_INTERVAL": "0.2",
+            "LO_HA_REJOIN_MISSES": "3",
+        })
+        procs = []
+        try:
+            a1 = _spawn([sys.executable, "-m", "learningorchestra_tpu",
+                         "serve"], env)
+            procs.append(a1)
+            _wait_health(pa)
+            b = _spawn(
+                [sys.executable, "-m", "learningorchestra_tpu",
+                 "standby", "--primary", f"127.0.0.1:{pa}",
+                 "--replica", str(tmp_path / "b" / "replica"),
+                 "--port", str(pb), "--host", "127.0.0.1",
+                 "--interval", "0.2", "--misses", "3"], env,
+            )
+            procs.append(b)
+            _wait_for_line(b, "takeover arming enabled")
+
+            ctx = Context("127.0.0.1", port=pa,
+                          failover=f"127.0.0.1:{pb}")
+            for i in range(5):
+                ctx.request("POST", "/function/python",
+                            {"name": f"gen1_{i}",
+                             "function": "response = 1"})
+            time.sleep(1.0)  # drain replication lag
+
+            # Generation 1: A dies, B promotes.
+            a1.send_signal(signal.SIGKILL)
+            a1.wait(timeout=10)
+            _wait_health(pb)
+
+            # A restarts: must REJOIN as standby, not serve and not
+            # exit — its process stays alive, pa stays closed, and
+            # B's WALs land in a/store.rejoined over HTTP.
+            a2 = _spawn([sys.executable, "-m", "learningorchestra_tpu",
+                         "serve"], env)
+            procs.append(a2)
+            _wait_for_line(a2, "auto-rejoining as a standby")
+            _wait_for_line(a2, "takeover arming enabled")
+            assert not _health(pa), "rejoined node must not serve"
+
+            ctx.request("POST", "/function/python",
+                        {"name": "gen2", "function": "response = 2"})
+            rejoined = tmp_path / "a" / "store.rejoined"
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if (rejoined / "gen2.wal").exists():
+                    break
+                time.sleep(0.3)
+            assert (rejoined / "gen2.wal").exists(), \
+                "rejoined standby never shipped gen2"
+            time.sleep(1.0)  # drain the tail
+
+            # Generation 2: B dies, A reclaims on its ORIGINAL port.
+            b.send_signal(signal.SIGKILL)
+            b.wait(timeout=10)
+            _wait_health(pa, timeout=60)
+            for name in [f"gen1_{i}" for i in range(5)] + ["gen2"]:
+                docs = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{pa}/api/learningOrchestra/v1"
+                    f"/function/python/{name}", timeout=5,
+                ).read())
+                assert docs and docs[0]["name"] == name, name
+            status = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{pa}/api/learningOrchestra/v1"
+                "/replication/status", timeout=5,
+            ).read())
+            assert status["epoch"] == 2
+
+            # B's supervisor-style restart: its promoted replica is
+            # now superseded by A's higher epoch — clean refusal.
+            b2 = _spawn(
+                [sys.executable, "-m", "learningorchestra_tpu",
+                 "standby", "--primary", f"127.0.0.1:{pa}",
+                 "--replica", str(tmp_path / "b" / "replica"),
+                 "--port", str(pb), "--host", "127.0.0.1",
+                 "--interval", "0.2", "--misses", "3"], env,
+            )
+            procs.append(b2)
+            out, _ = b2.communicate(timeout=90)
+            assert b2.returncode == 0, out[-1500:]
+            assert "superseded" in out, out[-1500:]
+        finally:
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait(timeout=10)
+
+
+class TestRejoinGuards:
+    def test_restored_original_store_beats_stale_rejoin_replica(
+        self, tmp_path
+    ):
+        """Review r5: an operator who restored the original store as
+        system of record (fence cleared, epoch caught up) must not
+        have it silently abandoned for a leftover .rejoined replica —
+        serve() prefers the original and says so."""
+        from learningorchestra_tpu.store.document_store import (
+            DocumentStore,
+        )
+        from learningorchestra_tpu.store.ha import PROMOTED_FILE
+        from learningorchestra_tpu.store.replica import write_epoch
+
+        store = tmp_path / "store"
+        rejoin = tmp_path / "store.rejoined"
+        DocumentStore(store).insert_one(
+            "restored", {"v": "truth"}, _id=0
+        )
+        write_epoch(store, 3)  # caught up past the rejoin replica
+        DocumentStore(rejoin).insert_one("stale", {"v": "old"}, _id=0)
+        write_epoch(rejoin, 2)
+        (rejoin / PROMOTED_FILE).write_text(json.dumps({
+            "promoted_to": "127.0.0.1:9", "epoch": 2,
+        }))
+
+        port = _free_port()
+        env = _base_env(tmp_path, port)
+        env.update({"LO_HA_AUTO_REJOIN": "1"})
+        proc = _spawn(
+            [sys.executable, "-m", "learningorchestra_tpu", "serve"],
+            env,
+        )
+        try:
+            out = _wait_for_line(proc, "ignoring stale rejoin replica")
+            _wait_health(port)
+            docs = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/learningOrchestra/v1"
+                "/function/python/restored", timeout=5,
+            ).read())
+            assert docs and docs[0]["v"] == "truth", (out, docs)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
