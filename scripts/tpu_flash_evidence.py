@@ -1,0 +1,57 @@
+"""Sub-minute on-chip evidence grab — runs BEFORE tpu_quick_evidence.
+
+The 2026-08-01 tunnel window lasted ~3 minutes: long enough to answer a
+probe and compile ONE small model, not long enough for the two-model
+quick-evidence script (its 51 MB MNIST upload + four fused-epoch
+compiles overran the window and the RPC hung when the tunnel dropped).
+This stage banks the single highest-value number — bf16 MNIST-CNN
+train throughput on silicon, the headline continuity metric every
+BENCH_r0N.json carries — with the smallest possible on-chip footprint:
+one model, 4k samples (12.8 MB upload), two fused-epoch compiles.
+
+    PYTHONPATH=/root/.axon_site:/root/repo python scripts/tpu_flash_evidence.py
+
+Methodology matches bench.py `_fused_throughput` (k vs 3k fused epochs,
+differenced, so tunnel round-trips cancel) so the number is directly
+comparable with TPU_EVIDENCE.md and the full bench suite.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+assert jax.devices()[0].platform == "tpu", jax.devices()
+print("device:", jax.devices()[0], flush=True)
+print("start:", time.strftime("%H:%M:%S"), flush=True)
+
+t0 = time.perf_counter()
+_p = jnp.asarray(np.ones((128, 128), np.float32))
+assert float(jnp.sum(jax.jit(lambda a: a @ a)(_p))) > 0
+print(f"probe ok in {time.perf_counter()-t0:.2f}s", flush=True)
+
+from bench import (  # noqa: E402 — repo root on PYTHONPATH
+    _fused_throughput,
+    _model_flops_per_sample,
+    _peak_flops,
+)
+from learningorchestra_tpu.models.vision import MnistCNN  # noqa: E402
+
+rng = np.random.default_rng(0)
+x = rng.standard_normal((4096, 28, 28, 1)).astype(np.float32)
+y = rng.integers(0, 10, (4096,), dtype=np.int32)
+
+est = MnistCNN()
+est._init_params(jnp.asarray(x[:1]))
+t0 = time.perf_counter()
+thr = _fused_throughput(est, x, y, 1024, k=2)
+per = _model_flops_per_sample(est, jnp.asarray(x[:1]))
+print(json.dumps({
+    "model": "mnist_cnn_bf16_flash", "batch": 1024, "n": 4096,
+    "samples_per_sec": round(thr, 1),
+    "mfu": round(thr * per / _peak_flops("tpu"), 4) if per else None,
+    "measure_s": round(time.perf_counter() - t0, 1),
+}), flush=True)
+print("FLASH EVIDENCE DONE", flush=True)
