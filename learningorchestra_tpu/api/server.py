@@ -1792,6 +1792,48 @@ def serve(config: Config | None = None) -> None:
     store_root = config.store.store_path()
     rejoin_root = _Path(str(store_root) + ".rejoined")
 
+    def standby_of(target: str) -> None:
+        # The ONE run_standby parameterization every rejoin path uses.
+        # With a promotion record in rejoin_root this short-circuits
+        # into resuming as primary; otherwise it monitors `target`
+        # with the conservative rejoin takeover window (ha.rejoin_*:
+        # an ordinary partner restart must never get fenced out).
+        run_standby(
+            target, None, rejoin_root, config.api.port,
+            host=config.api.host,
+            check_interval=config.ha.rejoin_interval_s,
+            max_misses=config.ha.rejoin_misses,
+        )
+
+    def archive_stale_rejoin(reason: str) -> bool:
+        # A stale .rejoined directory must move ASIDE, not merely be
+        # ignored: run_standby treats a leftover .promoted record in
+        # the replica root as "resume as primary", so a later rejoin
+        # flow reusing the root would serve the stale history the
+        # moment the real primary was unreachable.  Never delete —
+        # the bytes stay for the operator.
+        dst = rejoin_root.with_name(rejoin_root.name + ".stale")
+        n = 0
+        while dst.exists():
+            n += 1
+            dst = rejoin_root.with_name(f"{rejoin_root.name}.stale{n}")
+        try:
+            rejoin_root.rename(dst)
+        except OSError as exc:
+            print(
+                f"stale rejoin replica {rejoin_root} ({reason}) could "
+                f"not be archived ({exc}) — refusing to serve rather "
+                "than risk resuming from it; move the directory away "
+                "and restart.",
+                flush=True,
+            )
+            return False
+        print(
+            f"archived stale rejoin replica to {dst} ({reason})",
+            flush=True,
+        )
+        return True
+
     # A previous auto-rejoin cycle may already have PROMOTED this node
     # back to primary (partner died after we rejoined): the rejoined
     # replica — not the long-fenced original store — is then the
@@ -1800,40 +1842,57 @@ def serve(config: Config | None = None) -> None:
     rejoin_rec = (
         promotion_record(rejoin_root) if config.ha.auto_rejoin else None
     )
+    fence = is_fenced(store_root)
     if rejoin_rec:
         from learningorchestra_tpu.store.replica import read_epoch
 
-        # The rejoin replica only shadows the original store while the
-        # original is still FENCED at a lower epoch.  An operator who
-        # restored the original store as system of record (fence
-        # cleared, epoch caught up) must not have it silently
-        # abandoned for a stale .rejoined directory.
-        if is_fenced(store_root) is None and (
-            read_epoch(store_root) >= read_epoch(rejoin_root)
+        rejoin_epoch = read_epoch(rejoin_root)
+        try:
+            fence_epoch = int((fence or {}).get("epoch"))
+        except (TypeError, ValueError):
+            # Unreadable/malformed fence record: SOMEONE fenced the
+            # store at an unknown epoch.  Every other is_fenced
+            # consumer fails safe on this sentinel — so does the
+            # comparison below (unknown ≠ "old").
+            fence_epoch = None
+        # The rejoin replica only shadows the original store while it
+        # holds the HIGHEST election epoch this node knows of.  Two
+        # ways it can be stale: an operator restored the original
+        # store as system of record (fence cleared, epoch caught up),
+        # or a LATER promotion fenced the original at an epoch beyond
+        # the rejoin promotion's — either way resuming from the
+        # replica would serve superseded history.
+        if fence is None and read_epoch(store_root) >= rejoin_epoch:
+            if not archive_stale_rejoin(
+                "original store restored as system of record at an "
+                "equal-or-higher epoch"
+            ):
+                return
+        elif fence is not None and (
+            fence_epoch is None or fence_epoch >= rejoin_epoch
         ):
-            print(
-                f"ignoring stale rejoin replica {rejoin_root} — the "
-                "original store is unfenced at an equal-or-higher "
-                "epoch (restored as system of record); delete the "
-                "rejoin directory to silence this.",
-                flush=True,
-            )
+            if not archive_stale_rejoin(
+                "a later promotion fenced the original store at "
+                + (
+                    f"epoch {fence_epoch}, past"
+                    if fence_epoch is not None
+                    else "an UNKNOWN epoch (unreadable fence record — "
+                         "failing safe), possibly past"
+                )
+                + f" the rejoin epoch {rejoin_epoch}"
+            ):
+                return
         else:
             print(
                 "resuming as primary from the promoted rejoin replica "
                 f"{rejoin_root}", flush=True,
             )
-            run_standby(
+            standby_of(
                 config.ha.peer or rejoin_rec.get("old_primary")
-                or "127.0.0.1:0",
-                None, rejoin_root, config.api.port,
-                host=config.api.host,
-                check_interval=config.ha.rejoin_interval_s,
-                max_misses=config.ha.rejoin_misses,
+                or "127.0.0.1:0"
             )
             return
 
-    fence = is_fenced(store_root)
     if fence is None and config.ha.peer:
         fence = _peer_supersedes(store_root, config.ha.peer)
     if fence is not None:
@@ -1846,20 +1905,13 @@ def serve(config: Config | None = None) -> None:
             # WALs over the network into a fresh replica root — the
             # pair regains redundancy with no operator action, and if
             # the new primary later dies, THIS node promotes and
-            # serves on its original address again.  Conservative
-            # takeover window (ha.rejoin_*): an ordinary restart of
-            # the partner must never get fenced out by this node.
+            # serves on its original address again.
             print(
                 "store is fenced — auto-rejoining as a standby of "
                 f"{new_primary} (replica: {rejoin_root})",
                 flush=True,
             )
-            run_standby(
-                new_primary, None, rejoin_root, config.api.port,
-                host=config.api.host,
-                check_interval=config.ha.rejoin_interval_s,
-                max_misses=config.ha.rejoin_misses,
-            )
+            standby_of(new_primary)
             return
         # Exit CLEANLY so the supervisor's restart-on-failure loop
         # ends instead of resurrecting a fenced primary (store/ha.py).

@@ -232,7 +232,20 @@ class Config:
         if "LO_HA_FENCE_INTERVAL" in env:
             cfg.ha.fence_interval_s = float(env["LO_HA_FENCE_INTERVAL"])
         if "LO_HA_AUTO_REJOIN" in env:
-            cfg.ha.auto_rejoin = env["LO_HA_AUTO_REJOIN"] == "1"
+            # Accept the usual truthy/falsy spellings and reject the
+            # rest LOUDLY: "true" silently parsing as False would leave
+            # a pair without the redundancy the flag was set to provide.
+            raw = env["LO_HA_AUTO_REJOIN"].strip().lower()
+            if raw in ("1", "true", "yes", "on"):
+                cfg.ha.auto_rejoin = True
+            elif raw in ("0", "false", "no", "off", ""):
+                cfg.ha.auto_rejoin = False
+            else:
+                raise ValueError(
+                    f"LO_HA_AUTO_REJOIN={env['LO_HA_AUTO_REJOIN']!r} is "
+                    "not a recognized boolean (use 1/0, true/false, "
+                    "yes/no, on/off)"
+                )
         if "LO_HA_REJOIN_INTERVAL" in env:
             cfg.ha.rejoin_interval_s = float(
                 env["LO_HA_REJOIN_INTERVAL"]

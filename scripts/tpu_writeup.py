@@ -23,6 +23,7 @@ BEGIN = "<!-- AUTO-ONCHIP-BEGIN (scripts/tpu_writeup.py) -->"
 END = "<!-- AUTO-ONCHIP-END -->"
 
 STAGES = [
+    ("tpu_flash_evidence", "Flash evidence (sub-minute headline)"),
     ("tpu_quick_evidence", "Quick evidence (headline numbers)"),
     ("tpu_validate_r2", "Round-2 backlog validation"),
     ("tpu_validate_r3", "Round-3 backlog validation"),

@@ -636,7 +636,9 @@ class TestRejoinGuards:
         """Review r5: an operator who restored the original store as
         system of record (fence cleared, epoch caught up) must not
         have it silently abandoned for a leftover .rejoined replica —
-        serve() prefers the original and says so."""
+        serve() prefers the original and ARCHIVES the stale replica
+        aside (a leftover .promoted record in the rejoin root would
+        otherwise make a later rejoin flow resume from it)."""
         from learningorchestra_tpu.store.document_store import (
             DocumentStore,
         )
@@ -663,13 +665,127 @@ class TestRejoinGuards:
             env,
         )
         try:
-            out = _wait_for_line(proc, "ignoring stale rejoin replica")
+            out = _wait_for_line(proc, "archived stale rejoin replica")
             _wait_health(port)
             docs = json.loads(urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/api/learningOrchestra/v1"
                 "/function/python/restored", timeout=5,
             ).read())
             assert docs and docs[0]["v"] == "truth", (out, docs)
+            # The stale replica moved aside — bytes kept, root clear.
+            assert not rejoin.exists()
+            archived = tmp_path / "store.rejoined.stale"
+            assert (archived / PROMOTED_FILE).exists()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+
+    def test_unreadable_fence_fails_safe_over_rejoin_replica(
+        self, tmp_path
+    ):
+        """Review r5: an unreadable fence record means SOMEONE fenced
+        the original at an UNKNOWN epoch — the one consumer that
+        compares epochs must fail safe like every other is_fenced
+        caller, archiving the rejoin replica instead of resuming as
+        primary from possibly-superseded history."""
+        from learningorchestra_tpu.store.document_store import (
+            DocumentStore,
+        )
+        from learningorchestra_tpu.store.ha import PROMOTED_FILE
+        from learningorchestra_tpu.store.replica import (
+            FENCE_FILE,
+            write_epoch,
+        )
+
+        store = tmp_path / "store"
+        rejoin = tmp_path / "store.rejoined"
+        DocumentStore(store).insert_one("orig", {"v": "fenced"}, _id=0)
+        write_epoch(store, 1)
+        (store / FENCE_FILE).write_text("{torn write garbage")
+        DocumentStore(rejoin).insert_one("stale", {"v": "old"}, _id=0)
+        write_epoch(rejoin, 2)
+        (rejoin / PROMOTED_FILE).write_text(json.dumps({
+            "promoted_to": "127.0.0.1:9", "epoch": 2,
+        }))
+
+        port = _free_port()
+        env = _base_env(tmp_path, port)
+        # No LO_HA_PEER and an unreadable fence → after archiving, the
+        # fence branch has no rejoin target: clean refusal, exit 0.
+        env.update({"LO_HA_AUTO_REJOIN": "1"})
+        proc = _spawn(
+            [sys.executable, "-m", "learningorchestra_tpu", "serve"],
+            env,
+        )
+        try:
+            out, _ = proc.communicate(timeout=60)
+            assert proc.returncode == 0, out[-1500:]
+            assert "archived stale rejoin replica" in out, out[-1500:]
+            assert "refusing to serve" in out, out[-1500:]
+            archived = tmp_path / "store.rejoined.stale"
+            assert (archived / PROMOTED_FILE).exists()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+
+    def test_later_promotion_beats_stale_rejoin_replica(self, tmp_path):
+        """Review r5: a .rejoined replica promoted at epoch 2 must NOT
+        be resumed as primary when the original store was later fenced
+        by a promotion at a HIGHER epoch — even with the new primary
+        momentarily unreachable.  serve() archives the stale replica
+        and rejoins as a standby of the fence's promoted_to instead of
+        serving superseded history."""
+        from learningorchestra_tpu.store.document_store import (
+            DocumentStore,
+        )
+        from learningorchestra_tpu.store.ha import PROMOTED_FILE
+        from learningorchestra_tpu.store.replica import (
+            FENCE_FILE,
+            write_epoch,
+        )
+
+        store = tmp_path / "store"
+        rejoin = tmp_path / "store.rejoined"
+        DocumentStore(store).insert_one("orig", {"v": "fenced"}, _id=0)
+        write_epoch(store, 1)
+        # A promotion at epoch 5 — AFTER the rejoin promotion at 2 —
+        # fenced the original.  Its promoted_to does not answer.
+        dead_primary = f"127.0.0.1:{_free_port()}"
+        (store / FENCE_FILE).write_text(json.dumps({
+            "promoted_to": dead_primary, "epoch": 5,
+        }))
+        DocumentStore(rejoin).insert_one("stale", {"v": "old"}, _id=0)
+        write_epoch(rejoin, 2)
+        (rejoin / PROMOTED_FILE).write_text(json.dumps({
+            "promoted_to": "127.0.0.1:9", "epoch": 2,
+        }))
+
+        port = _free_port()
+        env = _base_env(tmp_path, port)
+        env.update({
+            "LO_HA_AUTO_REJOIN": "1",
+            # Long takeover window: the test must observe the standby
+            # phase, not a give-up-and-promote race.
+            "LO_HA_REJOIN_INTERVAL": "0.5",
+            "LO_HA_REJOIN_MISSES": "1000",
+        })
+        proc = _spawn(
+            [sys.executable, "-m", "learningorchestra_tpu", "serve"],
+            env,
+        )
+        try:
+            _wait_for_line(proc, "archived stale rejoin replica")
+            _wait_for_line(proc, "auto-rejoining as a standby")
+            # Standing by for the epoch-5 primary — never serving the
+            # stale epoch-2 history on the API port.
+            assert not _health(port, timeout=3.0)
+            assert not rejoin.exists() or not (
+                rejoin / PROMOTED_FILE
+            ).exists(), "stale promotion record must not survive"
+            archived = tmp_path / "store.rejoined.stale"
+            assert (archived / PROMOTED_FILE).exists()
         finally:
             if proc.poll() is None:
                 proc.kill()

@@ -229,3 +229,23 @@ def test_builder_modeling_code_supplies_labels(tmp_path):
         assert meta.get("finished")
     finally:
         ctx.close()
+
+
+def test_auto_rejoin_env_accepts_truthy_spellings(monkeypatch):
+    """Review r5: LO_HA_AUTO_REJOIN="true" silently parsing as False
+    would leave an HA pair without the redundancy the operator asked
+    for — accept the usual boolean spellings, reject garbage loudly."""
+    import pytest
+
+    from learningorchestra_tpu.config import Config
+
+    for raw, want in [
+        ("1", True), ("true", True), ("TRUE", True), ("yes", True),
+        ("on", True), ("0", False), ("false", False), ("no", False),
+        ("off", False), ("", False),
+    ]:
+        monkeypatch.setenv("LO_HA_AUTO_REJOIN", raw)
+        assert Config.from_env().ha.auto_rejoin is want, raw
+    monkeypatch.setenv("LO_HA_AUTO_REJOIN", "maybe")
+    with pytest.raises(ValueError, match="LO_HA_AUTO_REJOIN"):
+        Config.from_env()
