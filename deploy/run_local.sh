@@ -58,6 +58,23 @@ cleanup() {
   for pid in "${PIDS[@]}"; do
     kill -- -"$pid" 2>/dev/null || kill "$pid" 2>/dev/null || true
   done
+  # Bounded grace, then KILL the groups: the supervisors live in
+  # their OWN process groups (setsid), unreachable from a caller's
+  # killpg on THIS script — if cleanup stalls on a saturated box and
+  # the caller SIGKILLs us mid-wait, un-KILLed groups would orphan
+  # their services (observed: a coordinator+api+agent trio surviving
+  # a test teardown for an hour, stealing a core's worth of probes).
+  for _ in $(seq 1 20); do
+    alive=0
+    for pid in "${PIDS[@]}"; do
+      kill -0 "$pid" 2>/dev/null && alive=1
+    done
+    [ "$alive" = 0 ] && break
+    sleep 0.5
+  done
+  for pid in "${PIDS[@]}"; do
+    kill -9 -- -"$pid" 2>/dev/null || true
+  done
   wait 2>/dev/null
   exit 0
 }
@@ -65,7 +82,9 @@ trap cleanup INT TERM
 
 supervise coordinator python -m learningorchestra_tpu coordinator \
   --host 127.0.0.1 --port "$COORD_PORT"
-supervise api python -m learningorchestra_tpu serve
+# Port on the command line (redundant with LO_TPU_API_PORT) so the
+# process is identifiable by pgrep/pkill for teardown sweeps.
+supervise api python -m learningorchestra_tpu serve --port "$API_PORT"
 # Store HA (LO_HA_STANDBY=1): a warm standby ships the primary's WALs
 # and promotes itself on sustained health-check failure — the mongo
 # replica set's automatic election (store/ha.py).  A fenced old

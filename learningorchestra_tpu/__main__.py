@@ -30,7 +30,15 @@ import sys
 import time
 
 
-def _cmd_serve(_args) -> int:
+def _cmd_serve(args) -> int:
+    if getattr(args, "port", None):
+        # Before the config tree is first built: from_env reads it.
+        # An argv port also lets supervisors (deploy/run_local.sh)
+        # identify the process for cleanup — env vars are invisible
+        # to pgrep/pkill.
+        import os
+
+        os.environ["LO_TPU_API_PORT"] = str(args.port)
     from learningorchestra_tpu.api.server import serve
 
     serve()
@@ -99,7 +107,11 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="learningorchestra_tpu")
     sub = parser.add_subparsers(dest="command", required=True)
 
-    sub.add_parser("serve", help="run the REST API server")
+    serve_p = sub.add_parser("serve", help="run the REST API server")
+    serve_p.add_argument(
+        "--port", type=int, default=None,
+        help="overrides LO_TPU_API_PORT",
+    )
 
     coord = sub.add_parser("coordinator", help="run the control plane")
     coord.add_argument("--host", default="0.0.0.0")

@@ -34,9 +34,18 @@ def launch_cluster(tmp_path):
     """Factory: bring up run_local.sh with n_agents/extra env; every
     launched supervisor tree is torn down (TERM then KILL) at exit."""
     procs = []
+    ports_used = []
 
     def launch(n_agents=2, extra_env=None):
         api_port, coord_port = _free_port(), _free_port()
+        ports_used.extend([api_port, coord_port])
+        if (extra_env or {}).get("LO_HA_STANDBY") == "1":
+            # run_local.sh defaults the standby to api_port+1.
+            ports_used.append(int(
+                (extra_env or {}).get(
+                    "LO_HA_STANDBY_PORT", api_port + 1
+                )
+            ))
         env = {
             k: v for k, v in os.environ.items() if k != "XLA_FLAGS"
         }
@@ -69,6 +78,25 @@ def launch_cluster(tmp_path):
             except subprocess.TimeoutExpired:
                 os.killpg(proc.pid, signal.SIGKILL)
                 proc.wait(timeout=10)
+        # The supervisors run in their OWN process groups (setsid in
+        # run_local.sh), so the killpg above cannot reach them if the
+        # script died before its cleanup finished.  Sweep any service
+        # this launch's UNIQUE ports identify — serve/coordinator/
+        # standby carry "--port N" in argv, agents "127.0.0.1:N" —
+        # never a blanket name kill that could hit a dev cluster.
+        # (A full-suite run once leaked a coordinator+api+agent trio
+        # for over an hour on a 1-core box.)  Patterns must not start
+        # with "-": pkill would parse them as options and silently
+        # sweep nothing (exit 2, swallowed by check=False).
+        for port in ports_used:
+            subprocess.run(
+                ["pkill", "-9", "-f", f"127.0.0.1:{port}"],
+                check=False,
+            )
+            subprocess.run(
+                ["pkill", "-9", "-f", f"port {port}"],
+                check=False,
+            )
 
 
 @pytest.fixture
