@@ -160,10 +160,13 @@ def test_cpu_fallback_headline_guard():
     # halve it or worse, while a uniformly slower box cancels out.
     #
     # Calibration (r5 dev VM, 1 core): ref 104 GFLOP/s, 20.6
-    # samples/s x 23.7 MFLOP/sample => efficiency 0.0047.  Round 1's
-    # banked 40.7 on a ~2x-faster box implies the same ratio.  Floor
-    # 0.0025 (~53% of observed): red on any >=2x code regression,
-    # quiet on SIMD-width / cache-size box variance.
+    # samples/s x 23.7 MFLOP/sample => efficiency 0.0047.  A/B
+    # evidence that 20.6-vs-banked-40.7 is the BOX, not the code: the
+    # round-4 tree (commit cba44cf), which the driver banked at 39.4,
+    # measures 20.5 on this same VM — identical code rate, half the
+    # absolute number.  Floor 0.0025 (~53% of observed): red on any
+    # >=2x code regression, quiet on SIMD-width / cache-size box
+    # variance.
     import jax.numpy as jnp
     import numpy as np
 
