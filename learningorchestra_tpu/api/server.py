@@ -447,6 +447,55 @@ class APIServer:
                 "(device discovery is lazy)</p>"
             )
 
+        # -- store HA: role, election epoch, peer (store/ha.py).  Same
+        # page-must-render convention as the coordinator fetch above:
+        # a bad peer or unreadable store degrades this section only.
+        try:
+            from learningorchestra_tpu.store.ha import (
+                is_fenced,
+                peer_status,
+            )
+            from learningorchestra_tpu.store.replica import read_epoch
+
+            root = self.config.store.store_path()
+            fence = is_fenced(root)
+            # Same role logic as GET /replication/status: a fenced
+            # store is not a primary, whatever this process thinks.
+            role = "fenced" if fence is not None else "primary"
+            ha_bits = [
+                f"role: <b>{role}</b> — election epoch "
+                f"{read_epoch(root)}"
+            ]
+            if fence is not None:
+                ha_bits.append(
+                    '<span class=err>FENCED by '
+                    f"{esc(str(fence.get('promoted_to') or '?'))}"
+                    "</span>"
+                )
+            peer = self.config.ha.peer
+            if peer:
+                st = peer_status(peer)
+                if not isinstance(st, dict):
+                    ha_bits.append(
+                        f"peer {esc(peer)}: unreachable "
+                        "(normal for a monitoring standby)"
+                    )
+                else:
+                    ha_bits.append(
+                        f"peer {esc(peer)}: "
+                        f"role={esc(str(st.get('role')))} "
+                        f"epoch={esc(str(st.get('epoch')))}"
+                    )
+            else:
+                ha_bits.append("no HA peer configured")
+            sections.append(
+                "<h2>Store HA</h2><p>" + " · ".join(ha_bits) + "</p>"
+            )
+        except Exception as exc:  # noqa: BLE001 — page must render
+            sections.append(
+                f"<h2>Store HA</h2><p class=err>{esc(repr(exc))}</p>"
+            )
+
         # -- jobs: running + queued per fairness class ----------------
         running = self.ctx.engine.running_jobs()
         rows = []

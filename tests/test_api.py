@@ -697,7 +697,10 @@ def test_status_page_renders(api):
     page = resp.text
     for fragment in ("<h1>learningorchestra_tpu</h1>", "Agents",
                      "Device leases", "Jobs", "Recent events",
-                     "status_boom", "failed"):
+                     "status_boom", "failed", "Store HA",
+                     "election epoch", "no HA peer configured"):
         assert fragment in page, fragment
     # In-process mode: no coordinator configured.
     assert "in-process mode" in page
+    # An unfenced primary must not render the FENCED banner.
+    assert "FENCED" not in page
