@@ -704,3 +704,30 @@ def test_status_page_renders(api):
     assert "in-process mode" in page
     # An unfenced primary must not render the FENCED banner.
     assert "FENCED" not in page
+
+
+def test_status_page_shows_fenced_role(tmp_path):
+    """The Store HA section reports role=fenced + the FENCED banner
+    when a standby promoted over this store — same role logic as
+    GET /replication/status, rendered for the operator."""
+    from learningorchestra_tpu.store.replica import FENCE_FILE
+
+    cfg = Config()
+    cfg.store.root = str(tmp_path / "store")
+    cfg.store.volume_root = str(tmp_path / "volumes")
+    server = APIServer(cfg)
+    # Long fence-watch interval: the page read must win the race
+    # against the self-demotion shutdown the fence normally triggers.
+    server.FENCE_CHECK_INTERVAL_S = 3600.0
+    port = server.start_background()
+    base = f"http://127.0.0.1:{port}{PREFIX}"
+    try:
+        (tmp_path / "store").mkdir(parents=True, exist_ok=True)
+        (tmp_path / "store" / FENCE_FILE).write_text(json.dumps(
+            {"promoted_to": "10.0.0.9:8081", "epoch": 3}
+        ))
+        page = requests.get(f"{base}/status", timeout=10).text
+        assert "role: <b>fenced</b>" in page
+        assert "FENCED by 10.0.0.9:8081" in page
+    finally:
+        server.shutdown()
