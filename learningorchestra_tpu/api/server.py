@@ -1797,8 +1797,11 @@ def _peer_supersedes(store_root, peer: str) -> dict | None:
     This is the no-shared-disk half of fencing: the standby couldn't
     write our marker and the fence POST hit a dead process, so the
     epoch comparison is what stops the stale side.  An unreachable
-    peer is the NORMAL case (a monitoring standby serves HTTP only
-    after promotion) and means "not superseded".
+    peer means "not superseded"; so does a peer answering
+    ``role="standby"`` — a MONITORING standby serves its status route
+    pre-promotion (store/ha.py _start_standby_status), which is why
+    the check below requires ``role == "primary"``, not merely a
+    response.
     """
     from learningorchestra_tpu.store.ha import peer_status
     from learningorchestra_tpu.store.replica import (

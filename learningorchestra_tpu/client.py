@@ -155,8 +155,35 @@ class Context:
                 self.base, verb, path, qs, body, raw, idem_key
             )
         except urllib.error.HTTPError as exc:
-            raise self._client_error(exc) from None
-        except (urllib.error.URLError, ConnectionError, OSError):
+            if exc.code != 503 or self._failover_base is None:
+                raise self._client_error(exc) from None
+            # 503 from the base with a failover target armed: either a
+            # load-shedding gateway, or — after a failover ping-pong —
+            # a node that stepped down to MONITORING STANDBY and now
+            # answers everything 503 (store/ha.py).  This is mongo's
+            # NotWritablePrimary re-discovery moment: probe the other
+            # side; only a real answer repoints (sticky), a 503 or
+            # connection failure there surfaces the ORIGINAL error.
+            original = self._client_error(exc)
+            try:
+                result = self._one_request(
+                    self._failover_base, verb, path, qs, body, raw,
+                    idem_key,
+                )
+            except urllib.error.HTTPError as fexc:
+                if fexc.code == 503:
+                    fexc.close()
+                    raise original from None
+                self.base, self._failover_base = self._failover_base, None
+                raise self._client_error(fexc) from None
+            except (urllib.error.URLError, ConnectionError, OSError):
+                raise original from None
+            if not self._is_standby_answer(result):
+                self.base, self._failover_base = (
+                    self._failover_base, None
+                )
+            return result
+        except (urllib.error.URLError, ConnectionError, OSError) as conn_exc:
             # Connection-level failure (refused/reset/timeout) — NOT an
             # HTTP status.  If a standby was configured, the primary may
             # have died and the standby promoted itself: retry once
@@ -169,12 +196,41 @@ class Context:
                     idem_key,
                 )
             except urllib.error.HTTPError as exc:
-                # The standby answered with an HTTP error: it IS alive
-                # and promoted — repoint, then surface the error as-is.
+                if exc.code == 503:
+                    # A MONITORING standby answers everything but its
+                    # status route 503 ("not promoted", store/ha.py):
+                    # the pair is alive but no election has happened —
+                    # surface the PRIMARY's failure and keep the
+                    # failover target armed for the next attempt.
+                    # (A promoted-but-load-shedding standby also
+                    # 503s; not repointing is safe either way — the
+                    # next attempt retries through this same path.)
+                    exc.close()
+                    raise conn_exc from None
+                # The standby answered any other HTTP error: it IS
+                # alive and promoted — repoint, surface the error
+                # as-is.
                 self.base, self._failover_base = self._failover_base, None
                 raise self._client_error(exc) from None
-            self.base, self._failover_base = self._failover_base, None
+            if not self._is_standby_answer(result):
+                self.base, self._failover_base = self._failover_base, None
             return result
+
+    @staticmethod
+    def _is_standby_answer(result) -> bool:
+        """True when a failover-target response proves the node is a
+        MONITORING standby, not a promoted primary.
+
+        The one route an unpromoted standby answers 200 is
+        ``/replication/status`` (role=standby, store/ha.py); every API
+        response is an artifact list or a role-less dict.  Repointing
+        the session to a node that serves nothing else would strand it
+        until election — return the data, keep the bases as they are.
+        """
+        return (
+            isinstance(result, dict)
+            and result.get("role") == "standby"
+        )
 
     def _one_request(self, base, verb, path, qs, body, raw,
                      idem_key=None):

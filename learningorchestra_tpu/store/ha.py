@@ -117,8 +117,9 @@ def peer_status(peer_addr: str, *, timeout: float = 2.0,
     """One ``/replication/status`` round-trip to the HA peer.
 
     Returns the peer's ``{"role", "epoch", ...}`` record, or None when
-    the peer is unreachable (normal while the partner is a monitoring
-    standby — it serves HTTP only after promotion)."""
+    the peer is unreachable.  A MONITORING standby answers this route
+    too (``role="standby"``, _start_standby_status) — a non-None
+    record is NOT proof the peer promoted; check ``role``."""
     url = f"http://{peer_addr}{prefix}/replication/status"
     try:
         with urllib.request.urlopen(url, timeout=timeout) as resp:
@@ -173,6 +174,11 @@ class StandbyMonitor:
         # sync — promotion bumps from the LAST KNOWN value because the
         # primary is usually unreachable by then.
         self.primary_epoch = 0
+        # Last successful sync, for the pre-promotion status endpoint
+        # (mongo's printSecondaryReplicationInfo role): read cross-
+        # thread by _StandbyStatusServer — plain floats/ints only.
+        self.last_sync_at = 0.0
+        self.last_sync_bytes = 0
 
     def probe(self) -> bool:
         """One /health round-trip: is the primary PROCESS alive?
@@ -204,7 +210,9 @@ class StandbyMonitor:
         of a detected death is one interval, not two.
         """
         try:
-            self.replica.sync()
+            shipped = self.replica.sync()
+            self.last_sync_at = time.time()
+            self.last_sync_bytes = sum(shipped.values())
             # Never let the cached epoch REGRESS: a degraded primary
             # whose store dir unmounted can answer a listing with
             # epoch 0 (read_epoch swallows the OSError); promoting
@@ -298,6 +306,73 @@ class StandbyMonitor:
             # protection; over the network the epoch comparison
             # (serve()'s peer check) covers the restarted primary.
             log.warning(f"could not fence old primary: {exc}")
+
+
+def _start_standby_status(host: str, port: int,
+                          monitor: StandbyMonitor):
+    """Observability for a MONITORING standby (mongo's
+    ``rs.printSecondaryReplicationInfo()`` role): before promotion the
+    standby binds its future API port and serves exactly one route —
+    ``GET …/replication/status`` → ``role=standby`` + sync freshness —
+    answering every other request 503 ("not promoted").  The 503 is
+    part of the failover protocol: the client treats it as "pair
+    alive, election hasn't happened" and does NOT repoint
+    (client.py request()), unlike any other HTTP answer.  Binding
+    early also reserves the port, so a colliding service fails at
+    bring-up instead of at election time.
+
+    Returns the server (shut it down before the promoted APIServer
+    binds), or None when the port cannot be bound — status is an
+    extra, never a reason to refuse to stand by.
+    """
+    import http.server
+    import threading
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.rstrip("/").endswith("/replication/status"):
+                body = json.dumps({
+                    "role": "standby",
+                    "primary": monitor.primary_addr,
+                    "epoch": monitor.primary_epoch,
+                    "saw_primary": monitor.saw_primary,
+                    "misses": monitor.misses,
+                    "last_sync_at": monitor.last_sync_at,
+                    "last_sync_bytes": monitor.last_sync_bytes,
+                }).encode()
+                self._send(200, body)
+            else:
+                self._not_promoted()
+
+        def _not_promoted(self):
+            self._send(503, json.dumps(
+                {"error": "standby: monitoring, not promoted"}
+            ).encode())
+
+        do_POST = do_PATCH = do_DELETE = do_PUT = _not_promoted
+
+        def _send(self, code: int, body: bytes) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # noqa: D102 — quiet
+            pass
+
+    try:
+        srv = http.server.ThreadingHTTPServer((host, port), Handler)
+    except OSError as exc:
+        log.warning(
+            f"standby status endpoint could not bind {host}:{port} "
+            f"({exc}) — monitoring without it"
+        )
+        return None
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
 
 
 def run_standby(
@@ -418,4 +493,13 @@ def run_standby(
         f"{replica_root} via {monitor.replica.transport!r}, "
         f"watching http://{primary_addr}/health"
     )
-    become_primary(monitor.run_until_takeover())
+    status_srv = _start_standby_status(host, port, monitor)
+    try:
+        promoted = monitor.run_until_takeover()
+    finally:
+        # Free the port for the promoted APIServer (and on an
+        # exception, for whatever supervises this role).
+        if status_srv is not None:
+            status_srv.shutdown()
+            status_srv.server_close()
+    become_primary(promoted)

@@ -246,6 +246,139 @@ class TestClientFailover:
         with pytest.raises(OSError):
             ctx.request("GET", "/health")
 
+    def test_503_standby_does_not_capture_the_client(self):
+        # A MONITORING (unpromoted) standby answers 503: the client
+        # must surface the primary's connection failure and stay
+        # pointed at the primary with the failover target still armed
+        # — repointing to a node that serves nothing would strand the
+        # session until election.
+        import http.server
+        import threading
+
+        class Always503(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                self.send_error(503, "standby: not promoted")
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.HTTPServer(("127.0.0.1", 0), Always503)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            dead = _free_port()
+            ctx = Context(
+                "127.0.0.1", port=dead,
+                failover=f"127.0.0.1:{srv.server_port}",
+            )
+            with pytest.raises(OSError) as err:
+                ctx.request("GET", "/health")
+            assert not isinstance(err.value, ClientError)
+            assert str(dead) in ctx.base  # still the primary
+            assert ctx._failover_base is not None  # still armed
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_standby_status_answer_never_captures_the_client(
+        self, tmp_path
+    ):
+        # /replication/status is the ONE route a monitoring standby
+        # answers 200 — querying it through the failover path must
+        # return the data WITHOUT repointing the session to a node
+        # that serves nothing else.
+        from learningorchestra_tpu.store.ha import (
+            StandbyMonitor,
+            _start_standby_status,
+        )
+
+        monitor = StandbyMonitor(
+            "127.0.0.1:1", None, tmp_path / "replica",
+            probe_timeout=0.2,
+        )
+        port = _free_port()
+        srv = _start_standby_status("127.0.0.1", port, monitor)
+        assert srv is not None
+        try:
+            dead = _free_port()
+            ctx = Context("127.0.0.1", port=dead,
+                          failover=f"127.0.0.1:{port}")
+            st = ctx.request("GET", "/replication/status")
+            assert st["role"] == "standby"
+            assert str(dead) in ctx.base  # NOT captured
+            assert ctx._failover_base is not None  # still armed
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_base_503_rediscovers_the_promoted_side(self, tmp_path):
+        # After a failover ping-pong the client's base can be a node
+        # that stepped down to MONITORING standby — it answers 503.
+        # Mongo's NotWritablePrimary re-discovery: probe the failover
+        # target and repoint to the real primary.
+        import http.server
+
+        from learningorchestra_tpu.api.server import APIServer
+        from learningorchestra_tpu.config import Config
+
+        class Always503(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                self.send_error(503, "standby: not promoted")
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.HTTPServer(("127.0.0.1", 0), Always503)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        cfg = Config()
+        cfg.store.root = str(tmp_path / "store")
+        cfg.store.volume_root = str(tmp_path / "vol")
+        server = APIServer(cfg)
+        port = server.start_background()
+        try:
+            ctx = Context("127.0.0.1", port=srv.server_port,
+                          failover=f"127.0.0.1:{port}")
+            assert ctx.request("GET", "/health") == {"status": "ok"}
+            assert str(port) in ctx.base  # repointed, sticky
+            assert ctx._failover_base is None
+        finally:
+            srv.shutdown()
+            srv.server_close()
+            server.shutdown()
+
+    def test_base_503_with_unpromoted_standby_surfaces_503(self):
+        # Both sides 503 (election still in progress): surface the
+        # base's 503 and keep the failover target armed.
+        import http.server
+
+        class Always503(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                self.send_error(503, "not ready")
+
+            def log_message(self, *a):
+                pass
+
+        servers = []
+        for _ in range(2):
+            srv = http.server.HTTPServer(("127.0.0.1", 0), Always503)
+            threading.Thread(
+                target=srv.serve_forever, daemon=True
+            ).start()
+            servers.append(srv)
+        try:
+            ctx = Context(
+                "127.0.0.1", port=servers[0].server_port,
+                failover=f"127.0.0.1:{servers[1].server_port}",
+            )
+            with pytest.raises(ClientError) as err:
+                ctx.request("GET", "/health")
+            assert err.value.status == 503
+            assert str(servers[0].server_port) in ctx.base
+            assert ctx._failover_base is not None  # still armed
+        finally:
+            for srv in servers:
+                srv.shutdown()
+                srv.server_close()
+
     def test_http_errors_do_not_trigger_failover(self, tmp_path):
         # A 404 from a healthy primary is NOT a death signal.
         from learningorchestra_tpu.api.server import APIServer
@@ -543,3 +676,71 @@ class TestSubmitTimeParameters:
             assert ctx.last_recorded_parameters("p_job") == params
         finally:
             server.shutdown()
+
+
+class TestStandbyStatusEndpoint:
+    """A MONITORING standby is observable before promotion (mongo's
+    printSecondaryReplicationInfo): role=standby + sync freshness on
+    /replication/status, 503 for everything else (store/ha.py)."""
+
+    def test_reports_standby_role_and_503s_the_rest(self, tmp_path):
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        from learningorchestra_tpu.store.document_store import (
+            DocumentStore,
+        )
+        from learningorchestra_tpu.store.ha import (
+            StandbyMonitor,
+            _start_standby_status,
+        )
+
+        primary_root = tmp_path / "primary"
+        DocumentStore(primary_root).insert_one("c", {"v": 1}, _id=0)
+        monitor = StandbyMonitor(
+            "127.0.0.1:1", primary_root, tmp_path / "replica",
+            probe_timeout=0.2,
+        )
+        monitor.step()  # one sync so freshness fields populate
+        port = _free_port()
+        srv = _start_standby_status("127.0.0.1", port, monitor)
+        assert srv is not None
+        try:
+            base = f"http://127.0.0.1:{port}/api/learningOrchestra/v1"
+            with urllib.request.urlopen(
+                f"{base}/replication/status", timeout=5
+            ) as resp:
+                st = _json.loads(resp.read())
+            assert st["role"] == "standby"
+            assert st["primary"] == "127.0.0.1:1"
+            assert st["last_sync_at"] > 0
+            # Everything else — including /health — answers 503 so a
+            # failing-over client never repoints here pre-promotion.
+            for path in ("/health", "/function/python/x"):
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    urllib.request.urlopen(f"{base}{path}", timeout=5)
+                assert err.value.code == 503
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_port_conflict_degrades_to_none(self, tmp_path):
+        import socket
+
+        from learningorchestra_tpu.store.ha import (
+            StandbyMonitor,
+            _start_standby_status,
+        )
+
+        monitor = StandbyMonitor(
+            "127.0.0.1:1", None, tmp_path / "replica",
+            probe_timeout=0.2,
+        )
+        with socket.socket() as taken:
+            taken.bind(("127.0.0.1", 0))
+            taken.listen(1)
+            port = taken.getsockname()[1]
+            assert _start_standby_status(
+                "127.0.0.1", port, monitor
+            ) is None
