@@ -476,9 +476,11 @@ class APIServer:
             if peer:
                 st = peer_status(peer)
                 if not isinstance(st, dict):
+                    # A monitoring standby answers its status route
+                    # (store/ha.py) — unreachable means DOWN.
                     ha_bits.append(
-                        f"peer {esc(peer)}: unreachable "
-                        "(normal for a monitoring standby)"
+                        f'<span class=err>peer {esc(peer)}: '
+                        "unreachable</span>"
                     )
                 else:
                     ha_bits.append(
