@@ -233,7 +233,7 @@ class Context:
         )
 
     def _one_request(self, base, verb, path, qs, body, raw,
-                     idem_key=None):
+                     idem_key=None, timeout=None):
         headers = {"Content-Type": "application/json"}
         if idem_key:
             headers["X-Idempotency-Key"] = idem_key
@@ -244,7 +244,7 @@ class Context:
             headers=headers,
         )
         with urllib.request.urlopen(
-            req, timeout=self.request_timeout
+            req, timeout=timeout or self.request_timeout
         ) as resp:
             data = resp.read()
             if raw:
@@ -261,6 +261,34 @@ class Context:
         return ClientError(exc.code, payload)
 
     # -- conveniences over the universal GET/poll path ----------------------
+
+    def replication_status(self, timeout: float = 5.0) -> dict:
+        """Both sides of the HA pair in one call — mongo's
+        ``rs.status()`` role.  Each entry is the node's
+        ``/replication/status`` record (primaries AND monitoring
+        standbys answer it, store/ha.py) or ``{"error": ...}``;
+        neither query repoints the session.  ``timeout`` is per probe
+        and deliberately SHORT — this is the call an operator makes
+        while a node is sick, and the session's 330 s long-poll
+        budget would turn diagnosis into an 11-minute hang.
+        """
+        out: dict = {}
+        for key, base in (("base", self.base),
+                          ("failover", self._failover_base)):
+            if base is None:
+                continue
+            try:
+                out[key] = self._one_request(
+                    base, "GET", "/replication/status", "", None,
+                    False, timeout=timeout,
+                )
+            except urllib.error.HTTPError as exc:
+                exc.close()
+                out[key] = {"error": f"HTTP {exc.code}"}
+            except (urllib.error.URLError, ConnectionError,
+                    OSError) as exc:
+                out[key] = {"error": f"unreachable: {exc}"}
+        return out
 
     def metrics(self) -> dict:
         """Gateway metrics: per-route request counts/latencies + the

@@ -310,6 +310,42 @@ class TestClientFailover:
             srv.shutdown()
             srv.server_close()
 
+    def test_replication_status_surveys_both_sides(self, tmp_path):
+        # Context.replication_status() — mongo's rs.status(): the
+        # primary's record plus the monitoring standby's, without
+        # repointing the session.
+        from learningorchestra_tpu.api.server import APIServer
+        from learningorchestra_tpu.config import Config
+        from learningorchestra_tpu.store.ha import (
+            StandbyMonitor,
+            _start_standby_status,
+        )
+
+        cfg = Config()
+        cfg.store.root = str(tmp_path / "store")
+        cfg.store.volume_root = str(tmp_path / "vol")
+        server = APIServer(cfg)
+        port = server.start_background()
+        monitor = StandbyMonitor(
+            f"127.0.0.1:{port}", None, tmp_path / "replica",
+            probe_timeout=0.2,
+        )
+        sport = _free_port()
+        srv = _start_standby_status("127.0.0.1", sport, monitor)
+        assert srv is not None
+        try:
+            ctx = Context("127.0.0.1", port=port,
+                          failover=f"127.0.0.1:{sport}")
+            st = ctx.replication_status()
+            assert st["base"]["role"] == "primary"
+            assert st["failover"]["role"] == "standby"
+            assert str(port) in ctx.base  # session untouched
+            assert ctx._failover_base is not None
+        finally:
+            srv.shutdown()
+            srv.server_close()
+            server.shutdown()
+
     def test_base_503_rediscovers_the_promoted_side(self, tmp_path):
         # After a failover ping-pong the client's base can be a node
         # that stepped down to MONITORING standby — it answers 503.
