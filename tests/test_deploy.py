@@ -10,6 +10,7 @@ import signal
 import socket
 import subprocess
 import time
+import urllib.error
 import urllib.request
 from pathlib import Path
 
@@ -291,9 +292,14 @@ class TestLocalHAStandbyBringup:
         cluster brings up a NETWORK-mode standby (no --primary-store)
         that pulls WAL bytes over the api's /replication routes — a
         write on the api must appear in the standby's replica dir."""
+        standby_port = _free_port()  # reserved, not api_port+1 luck
         _, api_port, _ = launch_cluster(
             n_agents=0,
-            extra_env={"LO_HA_STANDBY": "1", "LO_HA_TRANSPORT": "http"},
+            extra_env={
+                "LO_HA_STANDBY": "1",
+                "LO_HA_TRANSPORT": "http",
+                "LO_HA_STANDBY_PORT": str(standby_port),
+            },
         )
         base = (f"http://127.0.0.1:{api_port}"
                 "/api/learningOrchestra/v1")
@@ -320,3 +326,27 @@ class TestLocalHAStandbyBringup:
         # cold boot pays the jax import first.
         _wait_for(shipped, timeout=120,
                   what="WAL shipped over /replication")
+
+        # The MONITORING standby is observable on its own port:
+        # role=standby + sync freshness on /replication/status, 503
+        # for the API proper.  Polled: the WAL file lands on disk
+        # mid-sync, BEFORE the monitor stamps last_sync_at.
+        sb = (f"http://127.0.0.1:{standby_port}"
+              "/api/learningOrchestra/v1")
+
+        def status_fresh():
+            code, st = _get(f"{sb}/replication/status")
+            return st if (
+                code == 200 and st.get("role") == "standby"
+                and st.get("last_sync_at", 0) > 0
+            ) else None
+
+        _wait_for(status_fresh, timeout=60,
+                  what="standby status freshness")
+        try:
+            code = urllib.request.urlopen(
+                f"{sb}/health", timeout=5
+            ).status
+        except urllib.error.HTTPError as exc:
+            code = exc.code
+        assert code == 503, "unpromoted standby must 503 the API"
