@@ -1038,6 +1038,11 @@ class APIServer:
                 MonitoringError,
             )
 
+            # Reserved nickname: the compiled-program cache's counter
+            # endpoint (train/compile_cache.py) — hit/miss/eviction/
+            # trace-time, process-wide.
+            if m.group("name") in ("compileCache", "compile_cache"):
+                return 200, self.monitoring.compile_cache_stats()
             try:
                 return 200, self.monitoring.lookup(m.group("name"))
             except MonitoringError as exc:

@@ -56,7 +56,11 @@ class Context:
         # Standby address for automatic store failover (store/ha.py):
         # on a connection-level failure the client retries ONCE against
         # the standby and — mirroring mongo driver re-discovery — keeps
-        # talking to it for the rest of the session.
+        # talking to it for the rest of the session.  On every repoint
+        # the OLD base becomes the new failover target (mongo's
+        # retained seed list, ADVICE r5): after a failover ping-pong
+        # the session still has a re-discovery path when the node it
+        # repointed to later steps down.
         #
         # Retry semantics are EXACTLY-ONCE for completed mutations
         # (mongo retryable writes): every POST/PATCH/DELETE carries an
@@ -174,13 +178,13 @@ class Context:
                 if fexc.code == 503:
                     fexc.close()
                     raise original from None
-                self.base, self._failover_base = self._failover_base, None
+                self.base, self._failover_base = self._failover_base, self.base
                 raise self._client_error(fexc) from None
             except (urllib.error.URLError, ConnectionError, OSError):
                 raise original from None
             if not self._is_standby_answer(result):
                 self.base, self._failover_base = (
-                    self._failover_base, None
+                    self._failover_base, self.base
                 )
             return result
         except (urllib.error.URLError, ConnectionError, OSError) as conn_exc:
@@ -210,10 +214,10 @@ class Context:
                 # The standby answered any other HTTP error: it IS
                 # alive and promoted — repoint, surface the error
                 # as-is.
-                self.base, self._failover_base = self._failover_base, None
+                self.base, self._failover_base = self._failover_base, self.base
                 raise self._client_error(exc) from None
             if not self._is_standby_answer(result):
-                self.base, self._failover_base = self._failover_base, None
+                self.base, self._failover_base = self._failover_base, self.base
             return result
 
     @staticmethod

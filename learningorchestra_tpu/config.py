@@ -89,6 +89,25 @@ class JobConfig:
 
 
 @dataclasses.dataclass
+class CompileCacheConfig:
+    """Process-wide compiled-program cache (train/compile_cache.py):
+    jitted epoch/eval callables survive across jobs so a repeated train
+    spec or a same-architecture tune sweep traces once.  Complements
+    ``StoreConfig.xla_cache_dir`` (which dedups only the XLA compile,
+    not Python tracing or closure rebuilds)."""
+
+    # Entry cap; <= 0 disables the cache (every job re-traces).
+    # Env: LO_TPU_COMPILE_CACHE_ENTRIES.
+    max_entries: int = 64
+    # Estimated-resident-bytes cap (jax exposes no exact executable
+    # size; each entry charges ``entry_bytes``).
+    # Env: LO_TPU_COMPILE_CACHE_BYTES.
+    max_bytes: int = 2 << 30
+    # Per-entry byte estimate. Env: LO_TPU_COMPILE_CACHE_ENTRY_BYTES.
+    entry_bytes: int = 32 << 20
+
+
+@dataclasses.dataclass
 class MeshConfig:
     """Logical device-mesh shape for distributed execution.
 
@@ -187,6 +206,9 @@ class Config:
     store: StoreConfig = dataclasses.field(default_factory=StoreConfig)
     api: APIConfig = dataclasses.field(default_factory=APIConfig)
     jobs: JobConfig = dataclasses.field(default_factory=JobConfig)
+    compile_cache: CompileCacheConfig = dataclasses.field(
+        default_factory=CompileCacheConfig
+    )
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     dist: DistributedConfig = dataclasses.field(
         default_factory=DistributedConfig
@@ -221,6 +243,18 @@ class Config:
                 str(k): int(v)
                 for k, v in _json.loads(env["LO_TPU_JOB_WEIGHTS"]).items()
             }
+        if "LO_TPU_COMPILE_CACHE_ENTRIES" in env:
+            cfg.compile_cache.max_entries = int(
+                env["LO_TPU_COMPILE_CACHE_ENTRIES"]
+            )
+        if "LO_TPU_COMPILE_CACHE_BYTES" in env:
+            cfg.compile_cache.max_bytes = int(
+                env["LO_TPU_COMPILE_CACHE_BYTES"]
+            )
+        if "LO_TPU_COMPILE_CACHE_ENTRY_BYTES" in env:
+            cfg.compile_cache.entry_bytes = int(
+                env["LO_TPU_COMPILE_CACHE_ENTRY_BYTES"]
+            )
         if "LO_TPU_TASK_COORDINATOR" in env:
             cfg.dist.task_coordinator = env["LO_TPU_TASK_COORDINATOR"]
         if "LO_TPU_JAX_COORDINATOR" in env:

@@ -30,7 +30,7 @@ import io
 import threading
 import time
 import traceback
-from collections import deque
+from collections import OrderedDict, deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable
 
@@ -80,6 +80,20 @@ class JobEngine:
         self._credits: dict[str, int] = {}
         self._inflight = 0
         self._shutdown = False
+        # Warm-start hints (train/compile_cache.py): services tag a
+        # submission with a program key and report it warm once the
+        # job's compiled programs are cached; within a class's WRR
+        # turn the dispatcher prefers queued jobs whose programs are
+        # already compiled, so a freed worker starts stepping instead
+        # of tracing.  Bounded FIFO — a hint registry, not a ledger.
+        self._warm_keys: "OrderedDict[str, None]" = OrderedDict()
+        self._max_warm_keys = 512
+        # Starvation bound: after this many CONSECUTIVE warm bypasses
+        # of a class's FIFO head, the head dispatches regardless — a
+        # sustained stream of warm submissions cannot pin a cold job
+        # in the queue forever.
+        self._warm_bypass: dict[str, int] = {}
+        self._max_warm_bypass = 4
         # Optional push-notification sink (services/webhooks.py): set
         # by the service context; completion paths call _notify.
         self.notifier = None
@@ -108,6 +122,7 @@ class JobEngine:
         capture_stdout: bool = False,
         on_success: Callable[[Any], dict | None] | None = None,
         job_class: str = "default",
+        warm_key: str | None = None,
     ) -> Future:
         """Run ``fn`` asynchronously as the job for artifact ``name``.
 
@@ -121,6 +136,13 @@ class JobEngine:
         ``job_class`` is the fairness pool (services pass their service
         type): queued work is dispatched to freed workers by weighted
         round-robin across classes, not global FIFO.
+
+        ``warm_key``, when given, is the job's compiled-program tag:
+        once any job reports it warm (:meth:`note_warm`, fed from
+        train/compile_cache.py), queued jobs carrying the same tag are
+        preferred WITHIN their class's round-robin turn — cross-class
+        fairness is untouched; the hint only reorders one class's
+        queue so freed workers favor zero-trace starts.
         """
         # Persist the request parameters NOW, not only in the terminal
         # ledger record: a job killed mid-run (process death, store
@@ -230,7 +252,7 @@ class JobEngine:
                 queue = self._queues[job_class] = deque()
                 self._rr_order.append(job_class)
                 self._credits[job_class] = self._weight(job_class)
-            queue.append((run, future))
+            queue.append((run, future, warm_key))
             self._futures[name] = future
             self._prune_locked()
             self._dispatch_locked()
@@ -240,6 +262,54 @@ class JobEngine:
 
     def _weight(self, job_class: str) -> int:
         return max(1, int(self.class_weights.get(job_class, 1)))
+
+    def note_warm(self, warm_key: str | None) -> None:
+        """Record that programs for ``warm_key`` are compiled and
+        cached — future queued jobs with this tag dispatch first
+        within their class.  Bounded FIFO; never raises."""
+        if not warm_key:
+            return
+        with self._lock:
+            self._warm_keys.pop(warm_key, None)
+            self._warm_keys[warm_key] = None
+            while len(self._warm_keys) > self._max_warm_keys:
+                self._warm_keys.popitem(last=False)
+
+    def clear_warm_keys(self) -> None:
+        """Drop every warm hint — wired to the compile cache's
+        device-set invalidation (services/context.py): once the cache
+        cleared, 'warm' jobs would trace like any other, so the
+        preference is pure queue distortion."""
+        with self._lock:
+            self._warm_keys.clear()
+
+    def _pop_queued_locked(self, queue: deque, job_class: str):
+        """Pop the next job from one class's queue: the first queued
+        job whose ``warm_key`` is known-warm if any (its compiled
+        programs are cached — it starts stepping, not tracing), else
+        strict FIFO.  Cancelled entries are skipped, never charged.
+        At most ``_max_warm_bypass`` consecutive dispatches may jump
+        the FIFO head; then the head runs (cold jobs are delayed, not
+        starved)."""
+        if (
+            self._warm_keys
+            and self._warm_bypass.get(job_class, 0) < self._max_warm_bypass
+        ):
+            for i, (runner, future, wk) in enumerate(queue):
+                if future.cancelled():
+                    continue
+                if wk is not None and wk in self._warm_keys:
+                    if i > 0:
+                        self._warm_bypass[job_class] = (
+                            self._warm_bypass.get(job_class, 0) + 1
+                        )
+                    else:
+                        self._warm_bypass[job_class] = 0
+                    del queue[i]
+                    return runner, future
+        self._warm_bypass[job_class] = 0
+        runner, future, _wk = queue.popleft()
+        return runner, future
 
     def _dispatch_locked(self) -> None:
         """Hand freed workers to queued jobs, class by class (WRR)."""
@@ -281,7 +351,7 @@ class JobEngine:
                 queue.popleft()
             if queue and self._credits.get(cls, 0) > 0:
                 self._credits[cls] -= 1
-                return queue.popleft()
+                return self._pop_queued_locked(queue, cls)
             self._credits[cls] = self._weight(cls)
             self._rr_idx += 1
         return None

@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from learningorchestra_tpu.parallel.mesh import MeshSpec, build_mesh
 from learningorchestra_tpu.parallel.sharding import param_shardings
 from learningorchestra_tpu.toolkit.base import as_array
+from learningorchestra_tpu.train import compile_cache
 from learningorchestra_tpu.train.neural import (
     NeuralEstimator,
     TrainHistory,
@@ -195,13 +196,32 @@ class DistributedTrainer:
         # upload happens once per fit, each epoch permutes batch order on
         # device from a PRNG key (host traffic per epoch = key + metric
         # scalars, VERDICT r1 weak item 3).
-        return build_resident_epoch_fns(
-            est.module,
-            est.optimizer,
-            est._loss_and_metrics(loss_kind),
-            dtype,
-            shuffle=shuffle,
+        #
+        # Resolved through the process-wide compiled-program cache,
+        # keyed by mesh axis names + device assignment on top of the
+        # architecture spec: a re-submitted distributed job on the SAME
+        # mesh re-binds the traced program; a different mesh (or a
+        # changed device set) can never serve a stale executable.
+        # Mesh-aware modules (bind_mesh) carry their bound mesh as a
+        # module field, so their fingerprint shifts with the binding.
+        from learningorchestra_tpu.train.neural import _cached_program
+
+        return _cached_program(
+            "resident_epoch_fns", est, loss_kind,
+            shapes=(bool(shuffle),),
+            mesh=(
+                compile_cache.mesh_fingerprint(self.mesh),
+                bool(self.shard_sequence),
+            ),
             donate=True,
+            builder=lambda: build_resident_epoch_fns(
+                est.module,
+                est.optimizer,
+                est._loss_and_metrics(loss_kind),
+                dtype,
+                shuffle=shuffle,
+                donate=True,
+            ),
         )
 
     def _ensure_fns(self, loss_kind: str, shuffle: bool) -> None:

@@ -69,6 +69,29 @@ class ServiceContext:
         # Per-job accelerator placement (jobs/leases.py): concurrent
         # neural jobs serialize per chip instead of contending for HBM.
         self.leaser = DeviceLeaser()
+        # When the compiled-program cache clears on a device-set change
+        # (TPU restart / tunnel reattach), the engine's warm-start
+        # hints are stale — 'warm' jobs would trace like any other.
+        # Weakly bound: short-lived contexts (tests) must not pin dead
+        # engines through the process-global cache.
+        import weakref
+
+        from learningorchestra_tpu.train import compile_cache
+
+        engine_ref = weakref.ref(self.engine)
+
+        def _drop_warm_hints():
+            engine = engine_ref()
+            if engine is not None:
+                engine.clear_warm_keys()
+
+        # Keep the handle so close() can deregister — the cache is
+        # process-global and must not accumulate dead listeners across
+        # short-lived contexts.
+        self._warm_hint_listener = _drop_warm_hints
+        compile_cache.get_cache().add_invalidation_listener(
+            _drop_warm_hints
+        )
         self._reflag_interrupted_jobs()
         self._init_backend()
 
@@ -144,6 +167,11 @@ class ServiceContext:
         jax.devices()
 
     def close(self) -> None:
+        from learningorchestra_tpu.train import compile_cache
+
+        compile_cache.get_cache().remove_invalidation_listener(
+            getattr(self, "_warm_hint_listener", None)
+        )
         self.engine.shutdown(wait=False)
         self.documents.close()
 

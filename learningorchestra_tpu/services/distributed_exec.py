@@ -208,7 +208,9 @@ class DistributedExecutorService:
                 DistributedTrainer,
             )
             from learningorchestra_tpu.parallel.mesh import MeshSpec
+            from learningorchestra_tpu.train import compile_cache
 
+            cache_before = compile_cache.counters_snapshot()
             instance = self.ctx.volumes.read_object(parent_type, parent_name)
             if not hasattr(instance, "module"):
                 raise ValidationError(
@@ -266,13 +268,22 @@ class DistributedExecutorService:
             store_history_rows(
                 self.ctx.documents, name, dict(trainer.history)
             )
+            cache_delta = compile_cache.delta_since(cache_before)
             if session_logdir is not None:
-                write_scalar_logs(
-                    session_logdir, dict(trainer.history), prefix=name
-                )
+                # Cache counters ride into the tfevents file as
+                # single-step scalars next to the training curves, so
+                # TensorBoard shows whether this job traced (miss) or
+                # warm-started (hit).
+                logged = dict(trainer.history)
+                logged.update({
+                    f"compile_cache_{key}": [float(val)]
+                    for key, val in cache_delta.items()
+                })
+                write_scalar_logs(session_logdir, logged, prefix=name)
             return {
                 "fitTime": fit_time,
                 "meshDevices": trainer.mesh.size,
+                "compileCache": cache_delta,
             }
 
         self.ctx.engine.submit(

@@ -115,6 +115,7 @@ class ExecutorService:
         self._submit(
             name, parent_meta, method, method_parameters, artifact_type,
             description, resume_checkpoint=False,
+            warm_key=_warm_key(model_meta, method),
         )
         return meta
 
@@ -150,16 +151,21 @@ class ExecutorService:
         self._submit(
             name, parent_meta, meta.get("method"), method_parameters,
             meta.get("type"), description, resume_checkpoint=resume,
+            warm_key=_warm_key(meta, meta.get("method")),
         )
         return self.ctx.artifacts.metadata.read(name)
 
     def _submit(self, name, parent_meta, method, method_parameters,
-                artifact_type, description, *, resume_checkpoint=False):
+                artifact_type, description, *, resume_checkpoint=False,
+                warm_key=None):
         parent_name = parent_meta["name"]
         parent_type = parent_meta.get("type", "")
         kind = artifact_type.split("/", 1)[0]
 
         def run():
+            from learningorchestra_tpu.train import compile_cache
+
+            cache_before = compile_cache.counters_snapshot()
             instance = self.ctx.volumes.read_object(parent_type, parent_name)
             params = dsl.resolve_params(method_parameters, self.ctx.loader)
             if (
@@ -192,11 +198,24 @@ class ExecutorService:
             else:
                 result = getattr(instance, method)(**params)
             fit_time = time.perf_counter() - t0
+            if isinstance(instance, NeuralEstimator) and \
+                    compile_cache.enabled():
+                # The job's compiled programs are now cached: publish
+                # the warm hint (the dispatcher prefers queued
+                # same-program jobs) and the per-job counter delta —
+                # cache effectiveness observable from the ordinary
+                # GET/poll path.  Counters are process-wide, so under
+                # concurrent jobs the delta is an upper bound.  With
+                # the cache disabled nothing is ever warm — a hint
+                # would reorder the queue for zero benefit.
+                self.ctx.engine.note_warm(warm_key)
+            cache_delta = compile_cache.delta_since(cache_before)
             if kind in TRAIN_KINDS or result is instance:
                 # Train semantics: persist the mutated instance
                 # (binary_execution.py:195-200).
                 self.ctx.volumes.save_object(artifact_type, name, instance)
-                extra = {"fitTime": fit_time}
+                extra = {"fitTime": fit_time,
+                         "compileCache": cache_delta}
                 hist = getattr(instance, "history", None)
                 if hist:
                     # Re-runs re-store the full history; drop the old
@@ -220,6 +239,7 @@ class ExecutorService:
             parameters=_json_safe(method_parameters),
             on_success=lambda extra: extra,
             job_class="executor",
+            warm_key=warm_key,
         )
 
     def _store_result_rows(self, name: str, result: Any) -> None:
@@ -290,7 +310,12 @@ class ExecutorService:
             method=method,
         )
 
+        warm_key = _warm_key(model_meta, method)
+
         def run():
+            from learningorchestra_tpu.train import compile_cache
+
+            cache_before = compile_cache.counters_snapshot()
             fit_params = dsl.resolve_params(
                 method_parameters, self.ctx.loader
             )
@@ -389,9 +414,17 @@ class ExecutorService:
                         pending.cancel()
                     raise
             self.ctx.volumes.save_object(artifact_type, name, best_instance)
+            if trials_lease and compile_cache.enabled():
+                self.ctx.engine.note_warm(warm_key)
+            # Grid-level compile-cache accounting: candidates sharing
+            # an architecture coalesce onto ONE trace (the rest hit),
+            # so for an N-candidate same-arch sweep expect hits ≈ N-1
+            # per program kind.  Concurrent unrelated jobs can inflate
+            # the delta (process-wide counters).
             return {
                 "bestScore": best_score,
                 "bestParams": _json_safe(best_combo),
+                "compileCache": compile_cache.delta_since(cache_before),
             }
 
         self.ctx.engine.submit(
@@ -399,11 +432,25 @@ class ExecutorService:
             method=method, parameters=_json_safe(param_grid),
             on_success=lambda extra: extra,
             job_class="executor",
+            warm_key=warm_key,
         )
         return meta
 
     def delete(self, name: str) -> None:
         self.ctx.delete_artifact(name)
+
+
+def _warm_key(meta: dict, method) -> str | None:
+    """Coarse compiled-program tag for the engine's warm-start dispatch
+    preference: jobs instantiating the same registry class with the
+    same method very likely share traced programs.  A HINT, not a
+    guarantee — exact matching happens inside compile_cache; a wrong
+    hint merely reorders one class's queue."""
+    module_path = meta.get("modulePath")
+    class_name = meta.get("class")
+    if not module_path or not class_name:
+        return None
+    return f"{module_path}:{class_name}:{method}"
 
 
 def _json_safe(obj):

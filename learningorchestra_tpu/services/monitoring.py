@@ -33,6 +33,10 @@ from typing import Any
 _PORT_RE = re.compile(r"http://[^\s:]+:(\d+)")
 # First char alphanumeric/underscore: forbids '.', '..' and path escapes.
 _NICK_RE = re.compile(r"[A-Za-z0-9_][A-Za-z0-9_.\-]*")
+# Fixed API sub-routes under /monitoring/<tool>/ (compiled-program
+# cache counters): a session so named could be created but never read
+# back — its GET is shadowed.
+_RESERVED_NICKNAMES = frozenset({"compileCache", "compile_cache"})
 
 
 class MonitoringError(Exception):
@@ -87,7 +91,8 @@ class MonitoringService:
 
     @staticmethod
     def valid_nickname(nickname: str) -> bool:
-        return bool(_NICK_RE.fullmatch(nickname or ""))
+        return bool(_NICK_RE.fullmatch(nickname or "")) \
+            and nickname not in _RESERVED_NICKNAMES
 
     def start(self, nickname: str, *, spawn_tensorboard: bool = True) -> dict:
         """Create (or return) the session for ``nickname``.
@@ -96,9 +101,11 @@ class MonitoringService:
         session instead of racing two TensorBoard processes onto one
         logdir (the reference's ProcessController collision path raised —
         utils.py:366)."""
-        if not _NICK_RE.fullmatch(nickname or ""):
-            # Nicknames become directory names under root; '..' or
-            # separators would escape the monitoring tree.
+        if not self.valid_nickname(nickname):
+            # Nicknames become directory names under root ('..' or
+            # separators would escape the monitoring tree), and the
+            # reserved names are fixed API sub-routes a session could
+            # never be read back from.
             raise MonitoringError(f"invalid monitoring nickname {nickname!r}")
         with self._lock:
             existing = self._sessions.get(nickname)
@@ -192,6 +199,18 @@ class MonitoringService:
     def list_sessions(self) -> list[dict]:
         with self._lock:
             return [s.to_dict() for s in self._sessions.values()]
+
+    @staticmethod
+    def compile_cache_stats() -> dict:
+        """Process-wide compiled-program cache counters
+        (train/compile_cache.py) — served at
+        GET /monitoring/<tool>/compileCache so cache effectiveness
+        (hit/miss/eviction/trace-time) is observable without shell
+        access, alongside the per-job deltas the executor stamps into
+        finished-job metadata."""
+        from learningorchestra_tpu.train import compile_cache
+
+        return compile_cache.get_cache().stats()
 
     def stop(self, nickname: str) -> bool:
         with self._lock:

@@ -1,0 +1,490 @@
+"""Process-wide compiled-program cache — trace once, run many.
+
+Every submitted train/tune job used to rebuild its jitted epoch/eval
+closures from scratch (``train/neural.py`` ``build_*_epoch_fns``), so an
+identical second job — or every candidate of a tune sweep sharing one
+architecture — re-paid full Python tracing and XLA compilation even
+though jax's per-function jit cache would have served it instantly *had
+the function object survived*.  The persistent XLA cache
+(services/context.py) only dedups the XLA compile step; Python tracing
+and closure construction were still repeated per job, and on TPU a
+trace alone is seconds for the zoo's larger models.
+
+This module keeps the jitted callables themselves alive across jobs,
+keyed by a canonical fingerprint of the *program*:
+
+  (builder kind, model architecture spec, optimizer config, loss kind,
+   compute dtype, batch/dataset shape, donation flags, mesh layout)
+
+On a hit the caller gets the exact wrapper a previous job compiled —
+jax's C++ fastpath then dispatches with zero tracing.  On a miss the
+builder runs once; concurrent callers for the same key (tune candidates
+submit together) coalesce onto the single build instead of racing N
+identical traces.
+
+Correctness notes:
+
+- optax transforms and flax modules are pure: a cached callable closing
+  over job A's optimizer/module objects is behaviorally identical for
+  job B *iff the fingerprints match*, which is exactly what the key
+  guarantees.  Opaque optimizer objects (no declarative spec) cannot be
+  fingerprinted and fall back to identity keys — correct, merely
+  uncached across jobs.
+- mesh-aware modules (models/longcontext.py) carry their bound ``Mesh``
+  as a dataclass field, so the module fingerprint distinguishes
+  ring-attention-for-mesh-X from vanilla automatically; distributed
+  entries additionally key on mesh axis names + device assignment.
+- the cache clears itself whenever the visible device set changes
+  (TPU restart, tunnel reattach): compiled executables pin device
+  handles that are dead afterwards.
+
+Observability: hit/miss/eviction/trace-time counters (``stats()``)
+surface through the monitoring service endpoint
+(GET /monitoring/<tool>/compileCache), per-job metadata deltas
+(services/executor.py) and the tfevents writer on monitored distributed
+jobs.  Sizing knobs live in config.py (LO_TPU_COMPILE_CACHE_*).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable
+
+__all__ = [
+    "CompiledProgramCache",
+    "canonical",
+    "fingerprint",
+    "get_cache",
+    "module_fingerprint",
+    "optimizer_fingerprint",
+    "program_key",
+    "reset_cache",
+    "counters_snapshot",
+    "delta_since",
+]
+
+
+# -- canonical fingerprinting -------------------------------------------------
+
+
+def canonical(obj: Any) -> Any:
+    """Reduce ``obj`` to a deterministic, repr-stable structure.
+
+    Handles the vocabulary a training-program spec is made of: flax
+    modules (class identity + dataclass fields, recursively), meshes
+    (axis names + shape + device assignment), dicts/sequences, dtypes
+    and numpy scalars.  Anything unrecognized degrades to an
+    identity-keyed token — correct (never a false hit), merely
+    uncacheable across distinct objects.
+    """
+    # Late imports keep this module importable without initializing jax.
+    import numpy as np
+
+    if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
+        return obj
+    if isinstance(obj, (np.integer, np.floating, np.bool_)):
+        return obj.item()
+    if isinstance(obj, dict):
+        return (
+            "dict",
+            tuple(sorted((str(k), canonical(v)) for k, v in obj.items())),
+        )
+    if isinstance(obj, (list, tuple)):
+        return ("seq", tuple(canonical(v) for v in obj))
+    if isinstance(obj, (set, frozenset)):
+        return ("set", tuple(sorted(repr(canonical(v)) for v in obj)))
+    # numpy/jax dtypes stringify deterministically.
+    if isinstance(obj, np.dtype) or (
+        isinstance(obj, type) and issubclass(obj, np.generic)
+    ):
+        return ("dtype", np.dtype(obj).name)
+    try:
+        from flax import linen as nn
+
+        if isinstance(obj, nn.Module):
+            return module_fingerprint(obj)
+    except Exception:  # pragma: no cover — flax always present here
+        pass
+    try:
+        from jax.sharding import Mesh
+
+        if isinstance(obj, Mesh):
+            return mesh_fingerprint(obj)
+    except Exception:  # pragma: no cover
+        pass
+    if callable(obj):
+        # Named functions (e.g. an activation passed as a module field)
+        # key on their qualified name; lambdas/closures can't be proven
+        # equal, so they key on identity (never a false hit).
+        name = getattr(obj, "__qualname__", "")
+        mod = getattr(obj, "__module__", "")
+        if name and "<lambda>" not in name and "<locals>" not in name:
+            return ("fn", mod, name)
+        return ("opaque", id(obj))
+    return ("opaque", id(obj))
+
+
+def module_fingerprint(module: Any) -> Any:
+    """Canonical spec of a flax module: class identity plus every
+    dataclass field (``parent``/``name`` are flax bookkeeping, not
+    architecture), recursing into nested modules and meshes."""
+    fields = tuple(
+        (f.name, canonical(getattr(module, f.name, None)))
+        for f in dataclasses.fields(module)
+        if f.name not in ("parent", "name")
+    )
+    return (
+        "module",
+        type(module).__module__,
+        type(module).__qualname__,
+        fields,
+    )
+
+
+def mesh_fingerprint(mesh: Any) -> Any:
+    """Axis names + per-axis sizes + flat device assignment — two jobs
+    share a sharded program only on the SAME devices in the SAME order
+    (executables pin device handles)."""
+    return (
+        "mesh",
+        tuple(str(a) for a in mesh.axis_names),
+        tuple(sorted((str(k), int(v)) for k, v in mesh.shape.items())),
+        tuple(
+            (int(d.id), str(getattr(d, "platform", "")))
+            for d in mesh.devices.flat
+        ),
+    )
+
+
+def optimizer_fingerprint(estimator: Any) -> Any:
+    """Optimizer identity as the REST surface expresses it: the
+    declarative spec (name/dict/None) + learning rate (float or
+    schedule spec) + accumulation wrapping.  An opaque optax object
+    passed programmatically has no spec — key on identity, which keeps
+    per-instance reuse but (correctly) never matches across jobs."""
+    spec = getattr(estimator, "_optimizer_spec", None)
+    if spec is None and estimator.optimizer is not None:
+        # id() reuse after GC cannot produce a false hit: the cached
+        # callable closes over this very optimizer object, so while an
+        # entry keyed on this id lives, the object lives and the id
+        # stays taken; once evicted there is no entry left to hit.
+        return ("opaque", id(estimator.optimizer))
+    return (
+        "opt",
+        canonical(spec),
+        canonical(getattr(estimator, "learning_rate", None)),
+        int(getattr(estimator, "_accumulate_steps", 1)),
+    )
+
+
+def fingerprint(*parts: Any) -> str:
+    """Stable digest of canonicalized parts — the cache key."""
+    payload = repr(tuple(canonical(p) for p in parts))
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def program_key(
+    kind: str,
+    *,
+    module: Any,
+    optimizer: Any,
+    loss: Any,
+    dtype: Any,
+    shapes: Any = None,
+    mesh: Any = None,
+    donate: Any = None,
+) -> str:
+    """Fingerprint one compiled training program.
+
+    ``optimizer`` should already be a canonical token (see
+    :func:`optimizer_fingerprint`); ``shapes`` carries whatever the
+    builder bakes into the trace (dataset length, batch size, shuffle,
+    epoch count); ``mesh`` the trainer-level mesh fingerprint for
+    sharded variants.
+    """
+    return fingerprint(
+        kind, module, optimizer, str(loss), str(dtype), shapes, mesh,
+        donate,
+    )
+
+
+def _device_signature() -> tuple:
+    """Identity of the visible device set; compiled executables are
+    invalid the moment this changes (restarted TPU runtime, reattached
+    tunnel, resized slice)."""
+    import jax
+
+    try:
+        return tuple(
+            (int(d.id), str(getattr(d, "platform", "")))
+            for d in jax.devices()
+        )
+    except Exception:  # backend not initialized yet / unavailable
+        return ()
+
+
+# -- the cache ---------------------------------------------------------------
+
+
+class _Entry:
+    __slots__ = ("value", "nbytes", "label", "built_s")
+
+    def __init__(self, value, nbytes, label, built_s):
+        self.value = value
+        self.nbytes = nbytes
+        self.label = label
+        self.built_s = built_s
+
+
+class CompiledProgramCache:
+    """LRU cache of compiled-program callables with build coalescing.
+
+    ``max_entries <= 0`` disables caching entirely (every lookup
+    builds).  ``max_bytes`` bounds the *estimated* resident size: jax
+    exposes no portable executable-size API, so each entry charges
+    ``entry_bytes`` (config-tunable) unless the caller provides a
+    better estimate — the cap is a safety valve against unbounded
+    program diversity, not an exact accountant.
+    """
+
+    def __init__(
+        self,
+        max_entries: int = 64,
+        max_bytes: int = 2 << 30,
+        entry_bytes: int = 32 << 20,
+    ):
+        self.max_entries = int(max_entries)
+        self.max_bytes = int(max_bytes)
+        self.entry_bytes = int(entry_bytes)
+        self._entries: OrderedDict[str, _Entry] = OrderedDict()
+        self._building: dict[str, threading.Event] = {}
+        self._lock = threading.Lock()
+        self._devices: tuple | None = None
+        # Bumped on every device-set clear: a build that STARTED
+        # before an invalidation must not be inserted after it (its
+        # trace may pin handles into the dead device set).
+        self._generation = 0
+        # Fired (under the cache lock — keep them fast, never call
+        # back into the cache) when the device-set check clears the
+        # cache, so dependent state (the engine's warm-start hints)
+        # doesn't keep claiming programs are compiled.
+        self._invalidation_listeners: list[Callable[[], None]] = []
+        # Counters (process lifetime; ``stats()`` snapshots them).
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.coalesced = 0
+        self.invalidations = 0
+        self.trace_time_s = 0.0
+
+    # -- internals ----------------------------------------------------------
+
+    def _bytes_locked(self) -> int:
+        return sum(e.nbytes for e in self._entries.values())
+
+    def _check_devices_locked(self) -> None:
+        sig = _device_signature()
+        if self._devices is None:
+            self._devices = sig
+            return
+        if sig != self._devices:
+            # Every cached executable pins handles into the OLD device
+            # set — running one would crash or silently target dead
+            # devices.  Drop them all; the next jobs re-trace.
+            if self._entries:
+                self.invalidations += 1
+            self._entries.clear()
+            self._devices = sig
+            self._generation += 1
+            for listener in self._invalidation_listeners:
+                try:
+                    listener()
+                except Exception:  # noqa: BLE001 — never break a lookup
+                    pass
+
+    def _evict_locked(self) -> None:
+        while self._entries and (
+            len(self._entries) > self.max_entries
+            or self._bytes_locked() > self.max_bytes
+        ):
+            if len(self._entries) == 1:
+                break  # never evict the entry just inserted
+            self._entries.popitem(last=False)
+            self.evictions += 1
+
+    # -- public surface -----------------------------------------------------
+
+    def get_or_build(
+        self,
+        key: str,
+        builder: Callable[[], Any],
+        *,
+        label: str | None = None,
+        nbytes: int | None = None,
+    ) -> Any:
+        """Return the cached program for ``key``, building it (once,
+        even under concurrent callers) on a miss."""
+        if self.max_entries <= 0:
+            with self._lock:
+                self.misses += 1
+            return builder()
+        while True:
+            with self._lock:
+                self._check_devices_locked()
+                entry = self._entries.get(key)
+                if entry is not None:
+                    self._entries.move_to_end(key)
+                    self.hits += 1
+                    return entry.value
+                pending = self._building.get(key)
+                if pending is None:
+                    pending = self._building[key] = threading.Event()
+                    build_generation = self._generation
+                    break
+            # Another thread is tracing this exact program right now
+            # (tune candidates submit together): wait for it rather
+            # than racing a duplicate trace, then re-check — a hit if
+            # it succeeded, our turn to build if it raised.
+            pending.wait()
+            with self._lock:
+                if key in self._entries:
+                    self._entries.move_to_end(key)
+                    self.hits += 1
+                    self.coalesced += 1
+                    return self._entries[key].value
+        t0 = time.perf_counter()
+        try:
+            value = builder()
+        except BaseException:
+            with self._lock:
+                ev = self._building.pop(key, None)
+            if ev is not None:
+                ev.set()
+            raise
+        built_s = time.perf_counter() - t0
+        with self._lock:
+            ev = self._building.pop(key, None)
+            self.misses += 1
+            self.trace_time_s += built_s
+            if build_generation == self._generation:
+                self._entries[key] = _Entry(
+                    value,
+                    self.entry_bytes if nbytes is None else int(nbytes),
+                    label,
+                    built_s,
+                )
+                self._entries.move_to_end(key)
+                self._evict_locked()
+            # else: the device set changed while this build was in
+            # flight — the program may pin handles into the dead set;
+            # hand it to THIS caller only (it fails fast if devices
+            # really died) and never cache it.
+        if ev is not None:
+            ev.set()
+        return value
+
+    def contains(self, key: str) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def add_invalidation_listener(self, listener: Callable[[], None]):
+        """Register a callback fired when a device-set change clears
+        the cache.  Runs under the cache lock: must be fast and must
+        not call back into the cache.  Pair with
+        :meth:`remove_invalidation_listener` on owner teardown."""
+        with self._lock:
+            self._invalidation_listeners.append(listener)
+
+    def remove_invalidation_listener(self, listener) -> None:
+        with self._lock:
+            try:
+                self._invalidation_listeners.remove(listener)
+            except ValueError:
+                pass
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def stats(self) -> dict:
+        """Counter snapshot for the monitoring endpoint / tfevents."""
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "maxEntries": self.max_entries,
+                "bytesEstimate": self._bytes_locked(),
+                "maxBytes": self.max_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "coalesced": self.coalesced,
+                "deviceInvalidations": self.invalidations,
+                "traceTimeS": round(self.trace_time_s, 4),
+                "programs": [
+                    e.label for e in self._entries.values() if e.label
+                ],
+            }
+
+
+# -- process-wide singleton ---------------------------------------------------
+
+_cache: CompiledProgramCache | None = None
+_cache_lock = threading.Lock()
+
+
+def get_cache() -> CompiledProgramCache:
+    """The process-wide cache, sized from config (LO_TPU_COMPILE_CACHE_*)."""
+    global _cache
+    with _cache_lock:
+        if _cache is None:
+            from learningorchestra_tpu.config import get_config
+
+            cc = get_config().compile_cache
+            _cache = CompiledProgramCache(
+                max_entries=cc.max_entries,
+                max_bytes=cc.max_bytes,
+                entry_bytes=cc.entry_bytes,
+            )
+        return _cache
+
+
+def reset_cache(**overrides) -> CompiledProgramCache:
+    """Replace the singleton (tests; or re-size after a config change)."""
+    global _cache
+    with _cache_lock:
+        if overrides:
+            _cache = CompiledProgramCache(**overrides)
+            return _cache
+        _cache = None
+    return get_cache()  # rebuild from config OUTSIDE the lock
+
+
+# -- per-job accounting helpers ----------------------------------------------
+
+_COUNTER_KEYS = ("hits", "misses", "evictions", "coalesced", "traceTimeS")
+
+
+def enabled() -> bool:
+    """False when the operator disabled caching
+    (LO_TPU_COMPILE_CACHE_ENTRIES=0) — callers publishing warm-start
+    hints must not claim programs are cached when nothing ever is."""
+    return get_cache().max_entries > 0
+
+
+def counters_snapshot() -> dict:
+    stats = get_cache().stats()
+    return {k: stats[k] for k in _COUNTER_KEYS}
+
+
+def delta_since(before: dict) -> dict:
+    """Counter delta for one job.  Counters are process-wide, so under
+    concurrent jobs a delta attributes overlapping activity — exact for
+    serial submissions, an upper bound otherwise."""
+    now = counters_snapshot()
+    out = {k: now[k] - before.get(k, 0) for k in _COUNTER_KEYS}
+    out["traceTimeS"] = round(out["traceTimeS"], 4)
+    return out

@@ -47,7 +47,19 @@ STAGES=(
 )
 declare -A DONE
 declare -A FAILS
+declare -A DROPFAILS
 MAX_FAILS=4   # a deterministic script bug must not loop forever
+# Drop-coincident failures are normally free retries (the dominant
+# failure mode is a mid-run tunnel drop), but a stage that fails
+# deterministically right as the tunnel flaps would otherwise retry
+# forever and block every later stage: after this many CONSECUTIVE
+# uncounted failures, charge one real attempt.  Deliberate trade-off:
+# a healthy stage whose runtime exceeds EVERY tunnel window is
+# indistinguishable from a deterministic failure and will eventually
+# be charged too — yielding to the later (shorter) stages is the
+# lesser loss; 12 consecutive mid-run drops with zero completions is
+# already a written-off window.
+MAX_DROPFAILS=3
 
 while true; do
     all_done=1
@@ -106,6 +118,7 @@ while true; do
             # mode — ~3-minute windows), and burning one of 4 attempts
             # on it would eventually abandon a perfectly good script.
             if probe; then
+                DROPFAILS[$name]=0
                 FAILS[$name]=$(( ${FAILS[$name]:-0} + 1 ))
                 echo "$(date -u +%H:%M:%S) FAIL $name (rc=$rc, attempt ${FAILS[$name]}/$MAX_FAILS)" >> "$LOGDIR/watch.log"
                 if [ "${FAILS[$name]}" -ge "$MAX_FAILS" ]; then
@@ -113,7 +126,22 @@ while true; do
                     echo "$(date -u +%H:%M:%S) GIVE UP $name" >> "$LOGDIR/watch.log"
                 fi
             else
-                echo "$(date -u +%H:%M:%S) FAIL $name (rc=$rc) during tunnel drop — not counted" >> "$LOGDIR/watch.log"
+                DROPFAILS[$name]=$(( ${DROPFAILS[$name]:-0} + 1 ))
+                if [ "${DROPFAILS[$name]}" -ge "$MAX_DROPFAILS" ]; then
+                    # N consecutive drop-coincident failures: stop
+                    # assuming the tunnel, charge a real attempt so a
+                    # deterministically failing stage eventually
+                    # yields to the stages behind it.
+                    DROPFAILS[$name]=0
+                    FAILS[$name]=$(( ${FAILS[$name]:-0} + 1 ))
+                    echo "$(date -u +%H:%M:%S) FAIL $name (rc=$rc) during tunnel drop — $MAX_DROPFAILS consecutive, counted (attempt ${FAILS[$name]}/$MAX_FAILS)" >> "$LOGDIR/watch.log"
+                    if [ "${FAILS[$name]}" -ge "$MAX_FAILS" ]; then
+                        DONE[$name]=1
+                        echo "$(date -u +%H:%M:%S) GIVE UP $name" >> "$LOGDIR/watch.log"
+                    fi
+                else
+                    echo "$(date -u +%H:%M:%S) FAIL $name (rc=$rc) during tunnel drop — not counted (${DROPFAILS[$name]}/$MAX_DROPFAILS)" >> "$LOGDIR/watch.log"
+                fi
             fi
             sleep 30
             continue 2

@@ -254,10 +254,19 @@ def test_k8s_manifest_roles_and_ha_pairing():
         return doc["spec"]["template"]["spec"]["containers"][0]
 
     # api -> standby peer pairing for the epoch check.
-    api_env = {e["name"]: e.get("value")
-               for e in container(by_name[("Deployment", "lo-tpu-api")])
-               ["env"]}
+    api = container(by_name[("Deployment", "lo-tpu-api")])
+    api_env = {e["name"]: e.get("value") for e in api["env"]}
     assert api_env["LO_HA_PEER"] == "lo-tpu-standby:8081"
+
+    # Liveness must probe /replication/status (200 from BOTH a serving
+    # primary and an auto-rejoined monitoring standby); /health 503s on
+    # the standby and had kubelet restart-looping it every ~105 s
+    # (ADVICE r5).  Readiness stays on /health so a standby takes no
+    # traffic.
+    assert api["livenessProbe"]["httpGet"]["path"].endswith(
+        "/replication/status"
+    )
+    assert api["readinessProbe"]["httpGet"]["path"].endswith("/health")
 
     # The standby's args must parse through the real CLI and select
     # network shipping (no --primary-store).

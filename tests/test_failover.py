@@ -237,9 +237,12 @@ class TestClientFailover:
         ctx = Context("127.0.0.1", port=dead,
                       failover=f"127.0.0.1:{port}")
         assert ctx.request("GET", "/health") == {"status": "ok"}
-        # Re-discovery is sticky: the context now points at the standby.
+        # Re-discovery is sticky: the context now points at the standby,
+        # and the OLD base is retained as the failover target (mongo's
+        # seed list) so a later step-down still has a re-discovery path.
         assert str(port) in ctx.base
-        assert ctx._failover_base is None
+        assert ctx._failover_base is not None
+        assert str(dead) in ctx._failover_base
 
     def test_no_failover_configured_raises(self):
         ctx = Context("127.0.0.1", port=_free_port())
@@ -375,7 +378,9 @@ class TestClientFailover:
                           failover=f"127.0.0.1:{port}")
             assert ctx.request("GET", "/health") == {"status": "ok"}
             assert str(port) in ctx.base  # repointed, sticky
-            assert ctx._failover_base is None
+            # Old base retained as the failover target (seed list).
+            assert ctx._failover_base is not None
+            assert str(srv.server_port) in ctx._failover_base
         finally:
             srv.shutdown()
             srv.server_close()
