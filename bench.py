@@ -402,6 +402,156 @@ def _compile_cache_probe() -> dict:
     }
 
 
+def _serving_probe(
+    n_features: int = 64,
+    hidden: tuple = (32,),
+    n_sequential: int = 64,
+    n_concurrent: int = 512,
+    concurrency: int = 16,
+    max_batch: int = 16,
+    flush_ms: float = 2.0,
+) -> dict:
+    """Online-serving probe: sequential single-request predict vs
+    request-coalescing concurrent throughput through the serving
+    MicroBatcher (serve/), plus p50/p99 request latency under
+    concurrency.
+
+    The sequential baseline runs through the SAME batcher machinery
+    (same thread handoff, same bucket padding) with a ZERO flush
+    deadline — the best an unbatched per-request server can do.  The
+    concurrent window runs the deployment's actual coalescing policy
+    (``flush_ms`` deadline), so the speedup measures what shipping the
+    micro-batcher buys: one padded dispatch amortized over every
+    request in flight.  Every shape bucket is compiled in a warm-up
+    pass first, so compile misses are bounded by the bucket set and
+    the timed windows measure steady state.
+
+    Defaults are sized for the CPU bench box: a TINY model (batching
+    amortizes per-dispatch overhead, which is the serving win on both
+    CPU and a remote-TPU link; a compute-bound model on 2 cores just
+    measures matmul scaling), ``concurrency == max_batch`` (so a full
+    backlog short-circuits the flush wait), and best-of-N windows on
+    both sides (a shared box's scheduler stalls must not bank a fake
+    ratio — same discipline as _fused_throughput).
+    """
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from learningorchestra_tpu.models.mlp import MLPClassifier
+    from learningorchestra_tpu.serve.batcher import MicroBatcher
+    from learningorchestra_tpu.serve.bucketing import bucket_sizes
+    from learningorchestra_tpu.train import compile_cache as cc
+
+    rng = np.random.default_rng(0)
+    est = MLPClassifier(
+        hidden_layer_sizes=list(hidden), num_classes=8
+    )
+    est.compute_dtype = "float32"
+    est._init_params(
+        jnp.asarray(rng.standard_normal((1, n_features)).astype(np.float32))
+    )
+    params = jax.device_put(est.params)
+    module = est.module
+
+    def dispatch(padded):
+        apply = cc.get_cache().get_or_build(
+            cc.apply_program_key(module, rows=padded.shape[0]),
+            lambda: jax.jit(module.apply),
+            label=f"bench-serve:b{padded.shape[0]}",
+        )
+        return apply(params, jnp.asarray(padded))
+
+    before = cc.counters_snapshot()
+    row = rng.standard_normal((1, n_features)).astype(np.float32)
+
+    # Best-of-N windows for BOTH sides: the bench can share a noisy
+    # box, and one descheduled window must not bank a fake ratio
+    # (same discipline as _fused_throughput's retry loop).
+    seq = MicroBatcher(
+        dispatch, max_batch=max_batch, max_queue=1 << 14, flush_ms=0.0,
+        name="bench-seq",
+    )
+    try:
+        # Warm every bucket (sequential submits never coalesce, so
+        # each lands exactly its own bucket) — compiles happen HERE,
+        # not inside a timed window.
+        for b in bucket_sizes(max_batch):
+            seq.submit(np.repeat(row, b, axis=0))
+        seq_rps = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(n_sequential):
+                seq.submit(row)
+            seq_rps = max(
+                seq_rps,
+                n_sequential / (time.perf_counter() - t0),
+            )
+    finally:
+        seq.close()
+
+    conc = MicroBatcher(
+        dispatch, max_batch=max_batch, max_queue=1 << 14,
+        flush_ms=flush_ms, name="bench-conc",
+    )
+    try:
+        latencies: list = []
+        lock = threading.Lock()
+        per_thread = max(1, n_concurrent // concurrency)
+
+        def worker():
+            for _ in range(per_thread):
+                t1 = time.perf_counter()
+                conc.submit(row)
+                dt = time.perf_counter() - t1
+                with lock:
+                    latencies.append(dt)
+
+        conc_rps = 0.0
+        for _ in range(4):
+            threads = [
+                threading.Thread(target=worker)
+                for _ in range(concurrency)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            conc_rps = max(
+                conc_rps,
+                per_thread * concurrency
+                / (time.perf_counter() - t0),
+            )
+        stats = conc.stats()
+    finally:
+        conc.close()
+    delta = cc.delta_since(before)
+    latencies.sort()
+
+    def pct(q):
+        return round(
+            latencies[min(len(latencies) - 1, int(q * len(latencies)))]
+            * 1e3, 3,
+        )
+
+    return {
+        "sequential_rps": round(seq_rps, 1),
+        "concurrent_rps": round(conc_rps, 1),
+        "coalescing_speedup": round(conc_rps / seq_rps, 2),
+        "p50_ms": pct(0.50),
+        "p99_ms": pct(0.99),
+        "batch_occupancy": stats["batchOccupancy"],
+        "bucket_histogram": stats["bucketHistogram"],
+        # Misses bounded by the bucket set, never by request count —
+        # the shape-bucketing contract the serving path guarantees.
+        "compile_misses": delta["misses"],
+        "buckets_possible": len(bucket_sizes(max_batch)),
+    }
+
+
 def _cpu_reference_flops(duration_s: float = 2.0) -> float:
     """Dense f32 matmul FLOP/s this host sustains through the same
     jit pipeline — the box-speed denominator for the live fallback
@@ -545,6 +695,10 @@ def _tpu_suite_child_main() -> None:
         suite["_compile_cache"] = _compile_cache_probe()
     except Exception as exc:  # noqa: BLE001 — record, don't hide
         suite["_compile_cache"] = f"FAILED: {exc!r}"
+    try:
+        suite["_serving"] = _serving_probe()
+    except Exception as exc:  # noqa: BLE001 — record, don't hide
+        suite["_serving"] = f"FAILED: {exc!r}"
     print(json.dumps(suite))
 
 
@@ -557,10 +711,13 @@ def main() -> None:
         platform = "tpu"
         flash = suite.pop("_flash", {})
         cache_probe = suite.pop("_compile_cache", None)
+        serving_probe = suite.pop("_serving", None)
         throughput, extra = _assemble_tpu(suite)
         extra.update(flash)
         if cache_probe is not None:
             extra["compile_cache"] = cache_probe
+        if serving_probe is not None:
+            extra["serving"] = serving_probe
     else:
         _force_cpu()  # record a CPU number rather than hang the driver
         import jax
@@ -580,6 +737,10 @@ def main() -> None:
             extra["compile_cache"] = _compile_cache_probe()
         except Exception as exc:  # noqa: BLE001 — record, don't hide
             extra["compile_cache"] = f"FAILED: {exc!r}"
+        try:
+            extra["serving"] = _serving_probe()
+        except Exception as exc:  # noqa: BLE001 — record, don't hide
+            extra["serving"] = f"FAILED: {exc!r}"
 
     metric = f"mnist_cnn_train_samples_per_sec_per_chip_{platform}"
     prior = _prior_best(metric, allow_cross_backend=platform == "tpu")

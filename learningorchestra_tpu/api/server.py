@@ -38,6 +38,7 @@ from learningorchestra_tpu.services.context import (
     NotFoundError,
     ValidationError,
 )
+from learningorchestra_tpu.serve.registry import ServeError
 from learningorchestra_tpu.store.artifacts import DuplicateArtifact
 from learningorchestra_tpu.toolkit import registry
 from learningorchestra_tpu.toolkit.registry import RegistryError
@@ -186,13 +187,22 @@ class APIServer:
             MonitoringService,
         )
 
+        monitoring_root = _os.path.join(
+            self.config.store.volume_path(), "_monitoring"
+        )
         self.monitoring = MonitoringService(
-            _os.path.join(self.config.store.volume_path(), "_monitoring"),
+            monitoring_root,
             external_host=self.config.api.monitoring_external_host,
         )
         self.distributed = DistributedExecutorService(
             self.ctx, self.monitoring
         )
+        from learningorchestra_tpu.serve import ServingService
+
+        # Resident model serving (serve/): synchronous low-latency
+        # predict over device-pinned params, request-coalescing
+        # micro-batches, shape-bucketed compiles.
+        self.serving = ServingService(self.ctx, monitoring_root)
         self.router = Router(self.config.api.api_prefix)
         self._register_routes()
         self._httpd: ThreadingHTTPServer | None = None
@@ -1043,6 +1053,14 @@ class APIServer:
             # trace-time, process-wide.
             if m.group("name") in ("compileCache", "compile_cache"):
                 return 200, self.monitoring.compile_cache_stats()
+            # Reserved nickname: serving observability (serve/) —
+            # latency percentiles, queue depth, batch occupancy,
+            # bucket histogram; each poll also appends one step of
+            # serving_* tfevents scalars to the serving logdir.
+            if m.group("name") == "serving":
+                stats = self.serving.stats()
+                scalars = self.serving.snapshot_scalars(stats)
+                return 200, {**stats, "scalars": scalars}
             try:
                 return 200, self.monitoring.lookup(m.group("name"))
             except MonitoringError as exc:
@@ -1057,6 +1075,58 @@ class APIServer:
             "DELETE", rf"/monitoring/{TOOL}/{NAME}",
             lambda m, b, q: (
                 200, {"stopped": self.monitoring.stop(m.group("name"))},
+            ),
+        )
+
+        # ---- Serve (resident model serving, serve/) ----
+        # The ONE synchronous data-plane surface: unlike every
+        # executor route (async job + poll), predict answers in the
+        # request — coalesced with concurrent requests into a padded
+        # shape bucket, run against device-resident params.
+        def serve_predict(m, body, query):
+            from learningorchestra_tpu.serve import QueueFull
+
+            instances = body.get("instances")
+            if instances is None:
+                instances = body.get("x")
+            if instances is None:
+                raise ValidationError("missing 'instances'")
+            try:
+                return 200, self.serving.predict(
+                    m.group("name"), instances
+                )
+            except QueueFull as exc:
+                # Backpressure: bounded queue full — shed load with an
+                # explicit retry budget (the Retry-After header is
+                # attached by the HTTP layer from 'retryAfter').
+                return 429, {
+                    "error": str(exc),
+                    "retryAfter": self.config.serve.retry_after_s,
+                }
+
+        add("POST", rf"/serve/{NAME}/predict", serve_predict)
+        add(
+            "POST", rf"/serve/{NAME}/load",
+            lambda m, b, q: (
+                200, {"result": self.serving.load(m.group("name"))},
+            ),
+        )
+
+        def serve_unload(m, body, query):
+            if not self.serving.unload(m.group("name")):
+                return 404, {
+                    "error": f"model {m.group('name')!r} is not loaded"
+                }
+            return 200, {"result": "unloaded"}
+
+        add("POST", rf"/serve/{NAME}/unload", serve_unload)
+        add("DELETE", rf"/serve/{NAME}", serve_unload)
+        add(
+            "GET", r"/serve",
+            lambda m, b, q: (
+                200,
+                {"models": self.serving.list_loaded(),
+                 "stats": self.serving.stats()},
             ),
         )
 
@@ -1437,7 +1507,7 @@ class APIServer:
             return 409, {"error": str(exc)}
         except NotFoundError as exc:
             return 404, {"error": str(exc)}
-        except (ValidationError, RegistryError) as exc:
+        except (ValidationError, RegistryError, ServeError) as exc:
             return 406, {"error": str(exc)}
         except (json.JSONDecodeError, BadRequest) as exc:
             return 400, {"error": f"bad JSON: {exc}"
@@ -1666,6 +1736,14 @@ class APIServer:
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                if status == 429 and isinstance(payload, dict) and \
+                        payload.get("retryAfter") is not None:
+                    # Backpressure contract (serving queue overflow):
+                    # clients honor the standard header, the JSON field
+                    # carries the same value for non-HTTP consumers.
+                    self.send_header(
+                        "Retry-After", str(payload["retryAfter"])
+                    )
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -1792,6 +1870,7 @@ class APIServer:
         if httpd is not None:
             httpd.shutdown()
             httpd.server_close()
+        self.serving.close()
         self.monitoring.close()
         self.ctx.close()
 

@@ -103,6 +103,7 @@ class Context:
         self.builder = _Builder(self)
         self.monitoring = _Monitoring(self)
         self.observe = _Observe(self)
+        self.serve = _Serve(self)
 
     # -- transport ----------------------------------------------------------
 
@@ -739,6 +740,43 @@ class _Monitoring:
     def stop(self, nickname: str) -> dict:
         return self.ctx.request(
             "DELETE", f"/monitoring/tensorflow/{nickname}"
+        )
+
+
+class _Serve:
+    """Resident model serving — the synchronous low-latency surface
+    (POST /serve/<model>/predict + load/unload/list).  Rides the
+    Context transport, so failover retry/repoint applies unchanged;
+    a 429 (queue overflow) surfaces as ``ClientError(429, ...)`` whose
+    payload carries ``retryAfter`` seconds."""
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+
+    def predict(self, model: str, instances) -> dict:
+        """Synchronous predict: ``instances`` is one feature vector or
+        a list of them; returns ``{"predictions": [...], ...}`` in the
+        response — no job, no polling."""
+        return self.ctx.request(
+            "POST", f"/serve/{model}/predict", {"instances": instances}
+        )
+
+    def load(self, model: str) -> dict:
+        """Pin a trained artifact's params resident on device."""
+        return self.ctx.request("POST", f"/serve/{model}/load", {})
+
+    def unload(self, model: str) -> dict:
+        return self.ctx.request("POST", f"/serve/{model}/unload", {})
+
+    def list_loaded(self) -> dict:
+        return self.ctx.request("GET", "/serve")
+
+    def stats(self) -> dict:
+        """Serving observability: p50/p95/p99 latency, queue depth,
+        batch occupancy, bucket histogram (also appended as
+        ``serving_*`` tfevents scalars server-side)."""
+        return self.ctx.request(
+            "GET", "/monitoring/tensorflow/serving"
         )
 
 

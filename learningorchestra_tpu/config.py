@@ -108,6 +108,34 @@ class CompileCacheConfig:
 
 
 @dataclasses.dataclass
+class ServeConfig:
+    """Resident model serving (serve/): request-coalescing batched
+    inference over device-pinned params (POST /serve/<model>/predict).
+    Env knobs: LO_TPU_SERVE_*."""
+
+    # Largest coalesced dispatch (rows); also the largest shape bucket,
+    # so the deployment compiles <= log2(max_batch)+1 executables per
+    # model.  Env: LO_TPU_SERVE_MAX_BATCH.
+    max_batch: int = 64
+    # Bounded request queue (rows) per served model — beyond it,
+    # submit sheds load (HTTP 429 + Retry-After).
+    # Env: LO_TPU_SERVE_MAX_QUEUE.
+    max_queue: int = 256
+    # Flush deadline: a dispatch fires at most this many ms after the
+    # OLDEST waiting request arrived — the latency bound a lone request
+    # pays for coalescing.  Env: LO_TPU_SERVE_FLUSH_MS.
+    flush_ms: float = 5.0
+    # Registry caps: resident model count and total parameter bytes
+    # (real bytes, summed over param leaves).
+    # Env: LO_TPU_SERVE_MAX_MODELS / LO_TPU_SERVE_MAX_BYTES.
+    max_models: int = 4
+    max_bytes: int = 1 << 30
+    # Retry-After seconds advertised with a 429.
+    # Env: LO_TPU_SERVE_RETRY_AFTER.
+    retry_after_s: float = 1.0
+
+
+@dataclasses.dataclass
 class MeshConfig:
     """Logical device-mesh shape for distributed execution.
 
@@ -209,6 +237,7 @@ class Config:
     compile_cache: CompileCacheConfig = dataclasses.field(
         default_factory=CompileCacheConfig
     )
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     dist: DistributedConfig = dataclasses.field(
         default_factory=DistributedConfig
@@ -254,6 +283,20 @@ class Config:
         if "LO_TPU_COMPILE_CACHE_ENTRY_BYTES" in env:
             cfg.compile_cache.entry_bytes = int(
                 env["LO_TPU_COMPILE_CACHE_ENTRY_BYTES"]
+            )
+        if "LO_TPU_SERVE_MAX_BATCH" in env:
+            cfg.serve.max_batch = int(env["LO_TPU_SERVE_MAX_BATCH"])
+        if "LO_TPU_SERVE_MAX_QUEUE" in env:
+            cfg.serve.max_queue = int(env["LO_TPU_SERVE_MAX_QUEUE"])
+        if "LO_TPU_SERVE_FLUSH_MS" in env:
+            cfg.serve.flush_ms = float(env["LO_TPU_SERVE_FLUSH_MS"])
+        if "LO_TPU_SERVE_MAX_MODELS" in env:
+            cfg.serve.max_models = int(env["LO_TPU_SERVE_MAX_MODELS"])
+        if "LO_TPU_SERVE_MAX_BYTES" in env:
+            cfg.serve.max_bytes = int(env["LO_TPU_SERVE_MAX_BYTES"])
+        if "LO_TPU_SERVE_RETRY_AFTER" in env:
+            cfg.serve.retry_after_s = float(
+                env["LO_TPU_SERVE_RETRY_AFTER"]
             )
         if "LO_TPU_TASK_COORDINATOR" in env:
             cfg.dist.task_coordinator = env["LO_TPU_TASK_COORDINATOR"]

@@ -159,6 +159,9 @@ class LongContextTransformer(NeuralEstimator):
         self._step_fn = None
         self._eval_fn = None
         self._apply_fn = None
+        # Per-bucket applies are memoized by row count ONLY — a module
+        # swap must drop them or a stale ring/vanilla apply would serve.
+        self._apply_fns = {}
         self._device_epoch = None
         self._device_epoch_key = None
 

@@ -75,6 +75,11 @@ class DistributedTrainer:
             shard_sequence = self.mesh.shape.get("sp", 1) > 1
         self.shard_sequence = shard_sequence
         self._bind_depth = 0
+        # Mesh-sharded live state, re-anchored every epoch so callbacks
+        # (EarlyStopping restore-best) can snapshot/replace it exactly
+        # as they do on the single-device estimator.
+        self.params = None
+        self.opt_state = None
         self.history = TrainHistory()
         self._epoch_fn = None
         self._eval_fn = None
@@ -148,7 +153,7 @@ class DistributedTrainer:
         psh = param_shardings(est.params, self.mesh)
         params = self._put_tree(jax.device_get(est.params), psh)
         # Optimizer state inherits param shardings through propagation.
-        fresh = jax.jit(est.optimizer.init)(params)
+        fresh = self._fresh_moments(params)
         if est.opt_state is not None and jax.tree_util.tree_structure(
             est.opt_state
         ) == jax.tree_util.tree_structure(fresh):
@@ -236,6 +241,45 @@ class DistributedTrainer:
             self._fn_key = key
             self._loss_kind = loss_kind
 
+    def _fresh_moments(self, params):
+        """Optimizer state initialized for ``params`` under jit, so
+        each leaf's state inherits the param's mesh sharding through
+        propagation — the ONE re-init used by state placement and the
+        restore-best moments-dropped paths."""
+        return jax.jit(self.estimator.optimizer.init)(params)
+
+    def _hand_back(self, params, opt_state) -> None:
+        """Trained sharded state → host pytrees on the estimator, so
+        the artifact contract (any step re-executable from the stored
+        binary, SURVEY §5.4) holds regardless of which path trained it.
+        Multi-process: fsdp/tp shards live on other hosts — all-gather
+        across processes (the rank-0-persists analogue of the reference
+        returning rank-0 weights, binary_execution.py:270-272, except
+        every host gets a consistent copy).  ``opt_state=None``
+        (restore-best dropped the moments) passes through: the next
+        fit re-inits them, matching the single-device contract."""
+        est = self.estimator
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            est.params = jax.tree_util.tree_map(
+                np.asarray,
+                multihost_utils.process_allgather(params, tiled=True),
+            )
+            est.opt_state = None if opt_state is None else (
+                jax.tree_util.tree_map(
+                    np.asarray,
+                    multihost_utils.process_allgather(
+                        opt_state, tiled=True
+                    ),
+                )
+            )
+        else:
+            est.params = jax.device_get(params)
+            est.opt_state = (
+                None if opt_state is None else jax.device_get(opt_state)
+            )
+
     # -- public surface -----------------------------------------------------
 
     def fit(
@@ -269,20 +313,20 @@ class DistributedTrainer:
 
         ``callbacks``/``early_stopping`` mirror the single-device
         surface: callbacks run per epoch as ``cb(epoch, metrics,
-        trainer)`` and may set ``trainer.stop_training = True``;
-        ``early_stopping`` takes the same REST-JSON spec, minus
-        ``restoreBestWeights`` (a sharded-state snapshot/rollback isn't
-        wired yet — requesting it raises rather than silently training
-        on)."""
+        trainer)`` and may set ``trainer.stop_training = True``.
+        ``restoreBestWeights`` works here too: the best epoch's params
+        are snapshotted DEVICE-SIDE as a sharded copy (``jnp.copy``
+        preserves each leaf's mesh sharding — no host gather, no
+        resharding) and rolled back on stop; optimizer moments are
+        dropped exactly as on the single-device path (they belong to
+        later epochs)."""
         from learningorchestra_tpu.train.neural import _is_sharded
 
         from learningorchestra_tpu.train.neural import (
             build_stop_callbacks,
         )
 
-        callbacks = build_stop_callbacks(
-            self, callbacks, early_stopping, allow_restore=False
-        )
+        callbacks = build_stop_callbacks(self, callbacks, early_stopping)
         if _is_sharded(x) or _is_sharded(y):
             return self._fit_streaming(
                 x, y, epochs=epochs, batch_size=batch_size,
@@ -386,9 +430,20 @@ class DistributedTrainer:
                     # Callbacks run before the checkpoint decision so an
                     # early stop still gets its "final epoch" save —
                     # through the ONE shared policy (should_save).
+                    # Re-anchor the live sharded state on the trainer
+                    # first: EarlyStopping restore-best snapshots
+                    # self.params (a device-side sharded jnp.copy) and
+                    # on stop replaces it, dropping the moments.
+                    self.params, self.opt_state = params, opt_state
                     for cb in callbacks or []:
                         if callable(cb):
                             cb(epoch_i, metrics, self)
+                    params, opt_state = self.params, self.opt_state
+                    if opt_state is None and not self.stop_training:
+                        # A callback rolled params back but training
+                        # continues: fresh moments for the new state.
+                        opt_state = self._fresh_moments(params)
+                        self.opt_state = opt_state
                     from learningorchestra_tpu.train import (
                         checkpoint as ckpt,
                     )
@@ -398,9 +453,16 @@ class DistributedTrainer:
                         checkpoint_min_interval_s, last_save,
                         stopped=self.stop_training,
                     ):
+                        save_opt = opt_state
+                        if save_opt is None:
+                            # restore-best dropped the moments: persist
+                            # the restored params with FRESH moments so
+                            # resume never replays pre-restore state
+                            # (same contract as the single-device fit).
+                            save_opt = self._fresh_moments(params)
                         ckpt.save(
                             checkpoint_dir, epoch_i + 1,
-                            {"params": params, "opt_state": opt_state},
+                            {"params": params, "opt_state": save_opt},
                             history=dict(self.history),
                             async_save=checkpoint_async,
                         )
@@ -423,28 +485,7 @@ class DistributedTrainer:
                 # The last async save must be durable when fit
                 # returns — exception paths included.
                 ckpt.finalize_async(checkpoint_dir)
-        # Hand the trained state back to the estimator (host pytree) so the
-        # artifact contract — any step re-executable from the stored binary
-        # (SURVEY §5.4) — holds regardless of which path trained it.
-        # Multi-process: the fsdp/tp shards live on other hosts, so a
-        # plain device_get cannot see them — all-gather across processes
-        # (the rank-0-persists analogue of the reference returning rank-0
-        # weights, binary_execution.py:270-272, except every host gets a
-        # consistent copy).
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-
-            est.params = jax.tree_util.tree_map(
-                np.asarray,
-                multihost_utils.process_allgather(params, tiled=True),
-            )
-            est.opt_state = jax.tree_util.tree_map(
-                np.asarray,
-                multihost_utils.process_allgather(opt_state, tiled=True),
-            )
-        else:
-            est.params = jax.device_get(params)
-            est.opt_state = jax.device_get(opt_state)
+        self._hand_back(params, opt_state)
         n_epochs = len(self.history.get("loss", ()))
         for i in range(n_epochs - ran, n_epochs):
             est.history.append(
@@ -607,17 +648,31 @@ class DistributedTrainer:
                                 "epoch %d/%d: %s", epoch_i + 1, epochs,
                                 metrics,
                             )
+                        # Re-anchor so restore-best can snapshot/replace
+                        # the sharded state (see the in-memory loop).
+                        self.params, self.opt_state = params, opt_state
                         for cb in callbacks or []:
                             if callable(cb):
                                 cb(epoch_i, metrics, self)
+                        params, opt_state = self.params, self.opt_state
+                        if opt_state is None and not self.stop_training:
+                            opt_state = self._fresh_moments(params)
+                            self.opt_state = opt_state
                         if checkpoint_dir and ckpt.should_save(
                             epoch_i, epochs, checkpoint_every,
                             checkpoint_min_interval_s, last_save,
                             stopped=self.stop_training,
                         ):
+                            save_opt = opt_state
+                            if save_opt is None:
+                                # restore-best: restored params persist
+                                # with fresh moments (single-device
+                                # contract).
+                                save_opt = self._fresh_moments(params)
                             ckpt.save(
                                 checkpoint_dir, epoch_i + 1,
-                                {"params": params, "opt_state": opt_state},
+                                {"params": params,
+                                 "opt_state": save_opt},
                                 history=dict(self.history),
                                 async_save=checkpoint_async,
                             )
@@ -633,20 +688,7 @@ class DistributedTrainer:
 
                 # Durable-on-return, exception paths included.
                 ckpt.finalize_async(checkpoint_dir)
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-
-            est.params = jax.tree_util.tree_map(
-                np.asarray,
-                multihost_utils.process_allgather(params, tiled=True),
-            )
-            est.opt_state = jax.tree_util.tree_map(
-                np.asarray,
-                multihost_utils.process_allgather(opt_state, tiled=True),
-            )
-        else:
-            est.params = jax.device_get(params)
-            est.opt_state = jax.device_get(opt_state)
+        self._hand_back(params, opt_state)
         n_epochs = len(self.history.get("loss", ()))
         for i in range(n_epochs - ran, n_epochs):
             est.history.append(

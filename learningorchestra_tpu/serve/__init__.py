@@ -1,0 +1,41 @@
+"""Resident model serving — the online-inference subsystem.
+
+The reference (and this framework through PR 1) only exposes predict as
+an asynchronous persisted JOB: submit, poll, read result rows — fine
+for batch analytics, hopeless for online traffic where every request
+would pay job dispatch plus two store round-trips.  This package turns
+the framework into an inference server:
+
+- :mod:`bucketing` — power-of-two shape buckets and row padding, shared
+  with ``NeuralEstimator.predict`` (one compiled shape per bucket);
+- :mod:`registry` — ``ModelRegistry``: trained artifacts' params pinned
+  resident on device, LRU with a byte cap, invalidated when the backing
+  artifact is overwritten or deleted;
+- :mod:`batcher` — ``MicroBatcher``: concurrent predict requests
+  coalesce into one padded bucket-shaped dispatch (max-batch or
+  flush-deadline, whichever first), with a bounded queue for
+  backpressure and latency/occupancy stats;
+- :mod:`service` — ``ServingService``: the REST-facing facade
+  (load/unload/list/predict + observability).
+
+Sizing knobs live in config.py (``LO_TPU_SERVE_*``).
+"""
+
+from learningorchestra_tpu.serve.batcher import MicroBatcher, QueueFull
+from learningorchestra_tpu.serve.bucketing import (
+    bucket_for,
+    bucket_sizes,
+    pad_rows,
+)
+from learningorchestra_tpu.serve.registry import ModelRegistry
+from learningorchestra_tpu.serve.service import ServingService
+
+__all__ = [
+    "MicroBatcher",
+    "ModelRegistry",
+    "QueueFull",
+    "ServingService",
+    "bucket_for",
+    "bucket_sizes",
+    "pad_rows",
+]

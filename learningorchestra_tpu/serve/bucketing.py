@@ -1,0 +1,66 @@
+"""Power-of-two shape buckets + row padding.
+
+XLA compiles one executable per input shape.  Online traffic arrives in
+arbitrary row counts, so dispatching raw request shapes would compile an
+executable per DISTINCT count — unbounded compile churn, exactly the
+failure mode the pjit serving discipline avoids by keeping a small fixed
+set of shapes hot (PAPERS.md: Gemma-on-TPU serving, pjit dispatch).
+Rounding every dispatch up to the next power of two bounds the whole
+deployment at ``log2(max_batch)+1`` executables per model, at a worst
+case of <2x padded compute.
+
+Shared by the serving path (MicroBatcher) and ``NeuralEstimator.predict``
+(which pads its ragged tail batch up to ``batch_size`` so repeat predicts
+compile at most one shape per batch size).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def bucket_for(rows: int, max_bucket: int) -> int:
+    """Smallest power of two >= ``rows``, capped at ``max_bucket``.
+
+    ``max_bucket`` itself is always a legal bucket even when it is not a
+    power of two (the cap wins: dispatches never exceed it).
+    """
+    if rows <= 0:
+        raise ValueError(f"rows must be positive, got {rows}")
+    if rows >= max_bucket:
+        return max_bucket
+    return min(1 << (rows - 1).bit_length(), max_bucket)
+
+
+def bucket_sizes(max_bucket: int) -> list[int]:
+    """Every bucket ``bucket_for`` can produce for this cap — the bound
+    on compiled executables per model (observability/tests)."""
+    out = []
+    b = 1
+    while b < max_bucket:
+        out.append(b)
+        b <<= 1
+    out.append(max_bucket)
+    return out
+
+
+def pad_rows(x: np.ndarray, target: int) -> np.ndarray:
+    """Pad ``x`` along axis 0 up to ``target`` rows by repeating row 0.
+
+    Row repetition (not zeros) keeps pad rows inside the input
+    distribution — a zero row can be out-of-vocabulary garbage for
+    token models, and while outputs for pad rows are discarded, feeding
+    NaN-producing garbage through the network risks poisoning XLA's
+    whole-batch fast paths.  Callers slice the first ``len(x)`` output
+    rows; per-row independence holds for the zoo (GroupNorm, no batch
+    statistics).
+    """
+    n = x.shape[0]
+    if n == 0:
+        raise ValueError("cannot pad an empty batch")
+    if n > target:
+        raise ValueError(f"batch of {n} rows exceeds bucket {target}")
+    if n == target:
+        return x
+    pad = np.broadcast_to(x[:1], (target - n, *x.shape[1:]))
+    return np.concatenate([x, pad], axis=0)

@@ -1,0 +1,228 @@
+"""ModelRegistry — trained artifacts pinned resident on device.
+
+The async predict job pays an artifact read (dill load) plus a full
+host→device parameter upload PER REQUEST.  Online serving cannot: the
+registry loads a trained ``NeuralEstimator`` artifact once, places its
+parameters on device, and keeps them resident across requests — the
+"params live in HBM, host sees them at job edges" discipline extended
+from training to serving.
+
+- LRU with BOTH an entry cap and a byte cap (real bytes: the sum of
+  parameter leaf ``nbytes`` — unlike compiled executables, parameter
+  residency is exactly measurable), ``LO_TPU_SERVE_*`` knobs;
+- invalidation: the owning service subscribes to artifact-change
+  notifications (overwrite by a PATCH re-train, DELETE), so a resident
+  model can never serve a deleted or superseded artifact's weights.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable
+
+
+class ServeError(Exception):
+    """Model cannot be served (bad artifact type, no params) → 406."""
+
+
+class _Resident:
+    __slots__ = (
+        "name", "estimator", "params", "nbytes", "loaded_at", "requests",
+        "apply_fns",
+    )
+
+    def __init__(self, name, estimator, params, nbytes):
+        self.name = name
+        self.estimator = estimator
+        self.params = params
+        self.nbytes = nbytes
+        self.loaded_at = time.time()
+        self.requests = 0
+        # bucket → jitted apply, resolved once per bucket through the
+        # compile cache (fingerprinting per dispatch would waste the
+        # serving hot path); dies with the entry, so invalidation can
+        # never serve a stale architecture's program.
+        self.apply_fns: dict = {}
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "module": type(self.estimator.module).__name__,
+            "paramBytes": self.nbytes,
+            "loadedAt": self.loaded_at,
+            "requests": self.requests,
+        }
+
+
+class ModelRegistry:
+    """name → resident model, LRU over entry count and parameter bytes.
+
+    ``loader`` maps an artifact name to a trained estimator (the
+    serving service binds it to the artifact store); the registry only
+    owns residency.
+    """
+
+    def __init__(
+        self,
+        loader: Callable[[str], Any],
+        *,
+        max_models: int = 4,
+        max_bytes: int = 1 << 30,
+        on_evict: Callable[[str], None] | None = None,
+    ):
+        self._loader = loader
+        # Fired (outside the registry lock) with each LRU-evicted
+        # model's name, so per-model satellite state (the serving
+        # service's MicroBatcher threads) is released with the entry.
+        self._on_evict = on_evict
+        self.max_models = int(max_models)
+        self.max_bytes = int(max_bytes)
+        self._entries: OrderedDict[str, _Resident] = OrderedDict()
+        self._lock = threading.Lock()
+        # Per-name load coalescing: concurrent first requests for one
+        # model must pay a single artifact read + device upload.
+        self._loading: dict[str, threading.Event] = {}
+        # Names invalidated/unloaded WHILE their load was in flight:
+        # the finished load must not insert (its binary may predate
+        # the overwrite/delete that raced it) — the caller gets its
+        # one result, the next request reloads fresh.
+        self._doomed: set[str] = set()
+        self.loads = 0
+        self.evictions = 0
+        self.invalidations = 0
+
+    # -- internals -----------------------------------------------------------
+
+    @staticmethod
+    def _place(estimator) -> tuple[Any, int]:
+        """Device-put the params once; returns (device tree, bytes)."""
+        import jax
+
+        if getattr(estimator, "params", None) is None:
+            raise ServeError(
+                "artifact holds no trained parameters (was it fit?)"
+            )
+        params = jax.device_put(estimator.params)
+        nbytes = sum(
+            int(getattr(leaf, "nbytes", 0))
+            for leaf in jax.tree_util.tree_leaves(params)
+        )
+        return params, nbytes
+
+    def _evict_locked(self) -> list[str]:
+        def total():
+            return sum(e.nbytes for e in self._entries.values())
+
+        evicted: list[str] = []
+        while self._entries and (
+            len(self._entries) > self.max_models
+            or total() > self.max_bytes
+        ):
+            if len(self._entries) == 1:
+                break  # never evict the entry just loaded
+            name, _ = self._entries.popitem(last=False)
+            evicted.append(name)
+            self.evictions += 1
+        return evicted
+
+    # -- public surface ------------------------------------------------------
+
+    def get(self, name: str) -> _Resident:
+        """Resident entry for ``name``, loading (once, under concurrent
+        callers) on a miss."""
+        while True:
+            with self._lock:
+                entry = self._entries.get(name)
+                if entry is not None:
+                    self._entries.move_to_end(name)
+                    return entry
+                pending = self._loading.get(name)
+                if pending is None:
+                    pending = self._loading[name] = threading.Event()
+                    break
+            pending.wait()
+        try:
+            estimator = self._loader(name)
+            params, nbytes = self._place(estimator)
+            entry = _Resident(name, estimator, params, nbytes)
+        except BaseException:
+            with self._lock:
+                ev = self._loading.pop(name, None)
+                self._doomed.discard(name)
+            if ev is not None:
+                ev.set()
+            raise
+        with self._lock:
+            ev = self._loading.pop(name, None)
+            self.loads += 1
+            if name in self._doomed:
+                # Invalidated mid-load: serve THIS caller from what
+                # was read (a complete binary — the volume publish is
+                # atomic) but never cache it; at most one response can
+                # see superseded weights.
+                self._doomed.discard(name)
+                evicted = []
+            else:
+                self._entries[name] = entry
+                self._entries.move_to_end(name)
+                evicted = self._evict_locked()
+        if ev is not None:
+            ev.set()
+        for victim in evicted:
+            if self._on_evict is not None:
+                try:
+                    self._on_evict(victim)
+                except Exception:  # noqa: BLE001 — never fail a load
+                    pass
+        return entry
+
+    def peek(self, name: str) -> _Resident | None:
+        """Resident entry or None — never loads (list/unload paths)."""
+        with self._lock:
+            return self._entries.get(name)
+
+    def unload(self, name: str) -> bool:
+        with self._lock:
+            if name in self._loading:
+                self._doomed.add(name)
+                return True
+            return self._entries.pop(name, None) is not None
+
+    def invalidate(self, name: str) -> bool:
+        """Drop a resident model whose backing artifact changed
+        (overwrite/delete) — the next request reloads or 404s.  A load
+        in flight for the name is doomed: its result serves only the
+        caller that started it, never the cache."""
+        with self._lock:
+            hit = self._entries.pop(name, None) is not None
+            if name in self._loading:
+                self._doomed.add(name)
+                hit = True
+            if hit:
+                self.invalidations += 1
+            return hit
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._doomed.update(self._loading)
+
+    def list(self) -> list[dict]:
+        with self._lock:
+            return [e.to_dict() for e in self._entries.values()]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "residentModels": len(self._entries),
+                "maxModels": self.max_models,
+                "residentBytes": sum(
+                    e.nbytes for e in self._entries.values()
+                ),
+                "maxBytes": self.max_bytes,
+                "loads": self.loads,
+                "evictions": self.evictions,
+                "invalidations": self.invalidations,
+            }

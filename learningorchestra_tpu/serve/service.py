@@ -1,0 +1,282 @@
+"""ServingService — the REST-facing facade over registry + batchers.
+
+Ties a :class:`~learningorchestra_tpu.serve.registry.ModelRegistry`
+(artifact → device-resident params) to one
+:class:`~learningorchestra_tpu.serve.batcher.MicroBatcher` per served
+model, resolves each bucket's jitted ``apply`` through the process-wide
+compiled-program cache (``compile_cache.apply_program_key`` — one
+executable per (architecture, bucket) for the whole deployment), and
+exposes the synchronous predict the API layer serves at
+``POST /serve/<model>/predict``.
+
+Invalidation: subscribes to the service context's artifact-change
+notifications, so a PATCH re-train or DELETE of a served artifact drops
+its resident weights before the next request.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+
+from learningorchestra_tpu.serve.batcher import MicroBatcher
+from learningorchestra_tpu.serve.registry import ModelRegistry, ServeError
+
+#: Steps of serving_* scalar history kept (and rewritten per snapshot).
+_SCALAR_WINDOW = 512
+
+
+class ServingService:
+    def __init__(self, ctx, monitoring_root: str | None = None):
+        self.ctx = ctx
+        self.cfg = ctx.config.serve
+        self.monitoring_root = monitoring_root
+        self.registry = ModelRegistry(
+            self._load_estimator,
+            max_models=self.cfg.max_models,
+            max_bytes=self.cfg.max_bytes,
+            # An LRU-evicted model's batcher (worker thread + stats)
+            # must die with its entry, or serving N distinct models
+            # over a process lifetime leaks N threads.
+            on_evict=self._drop_batcher,
+        )
+        self._batchers: dict[str, MicroBatcher] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+        # tfevents snapshot state: a fixed wall_time keeps one stable
+        # events file that each snapshot rewrites with the (windowed)
+        # history; the lock serializes concurrent monitoring polls —
+        # two truncating writers on one file would interleave records
+        # and break the CRC framing.
+        self._t0 = time.time()
+        self._scalar_history: dict[str, list] = {}
+        self._scalar_lock = threading.Lock()
+        ctx.add_artifact_change_listener(self._on_artifact_changed)
+
+    # -- model residency -----------------------------------------------------
+
+    def _load_estimator(self, name: str):
+        from learningorchestra_tpu.services.context import ValidationError
+        from learningorchestra_tpu.train.neural import NeuralEstimator
+
+        meta = self.ctx.require_finished_parent(name)
+        instance = self.ctx.volumes.read_object(meta.get("type", ""), name)
+        if not isinstance(instance, NeuralEstimator):
+            raise ValidationError(
+                f"artifact {name!r} is not a neural model binary "
+                f"({type(instance).__name__}); only NeuralEstimator "
+                "artifacts are servable"
+            )
+        return instance
+
+    def load(self, name: str) -> dict:
+        """Pin ``name`` resident (idempotent) — the explicit warm-up the
+        ops path uses before pointing traffic at a model."""
+        return self.registry.get(name).to_dict()
+
+    def unload(self, name: str) -> bool:
+        self._drop_batcher(name)
+        return self.registry.unload(name)
+
+    def list_loaded(self) -> list[dict]:
+        return self.registry.list()
+
+    def _on_artifact_changed(self, name: str) -> None:
+        """Artifact overwritten (re-train) or deleted: resident weights
+        are stale — drop them; the next request reloads or 404s."""
+        if self.registry.invalidate(name):
+            self._drop_batcher(name)
+
+    def _drop_batcher(self, name: str) -> None:
+        with self._lock:
+            batcher = self._batchers.pop(name, None)
+        if batcher is not None:
+            batcher.close()
+
+    # -- predict -------------------------------------------------------------
+
+    def _batcher_for(self, name: str) -> MicroBatcher:
+        with self._lock:
+            batcher = self._batchers.get(name)
+            if batcher is None:
+                if self._closed:
+                    raise RuntimeError("serving is shut down")
+                batcher = self._batchers[name] = MicroBatcher(
+                    lambda padded, _n=name: self._dispatch(_n, padded),
+                    max_batch=self.cfg.max_batch,
+                    max_queue=self.cfg.max_queue,
+                    flush_ms=self.cfg.flush_ms,
+                    name=name,
+                )
+            return batcher
+
+    def _dispatch(self, name: str, padded: np.ndarray):
+        """Run one padded bucket through the cache-resolved apply.
+
+        Resolving the registry entry HERE (not at batcher creation)
+        means an invalidation between requests serves the reloaded
+        artifact's weights, never a stale closure's."""
+        import jax
+        import jax.numpy as jnp
+
+        from learningorchestra_tpu.train import compile_cache as cc
+
+        entry = self.registry.get(name)
+        apply = entry.apply_fns.get(padded.shape[0])
+        if apply is None:
+            apply = entry.apply_fns[padded.shape[0]] = (
+                cc.get_cache().get_or_build(
+                    cc.apply_program_key(
+                        entry.estimator.module, rows=padded.shape[0]
+                    ),
+                    lambda: jax.jit(entry.estimator.module.apply),
+                    label=(
+                        f"serve:{type(entry.estimator.module).__name__}"
+                        f":b{padded.shape[0]}"
+                    ),
+                )
+            )
+        return apply(entry.params, jnp.asarray(padded))
+
+    @staticmethod
+    def _as_batch(instances) -> np.ndarray:
+        """Request JSON → input batch, REST dtype discipline: float
+        features land f32 (f64 would retrace against f32-traced
+        programs), integer features stay int (token models)."""
+        try:
+            x = np.asarray(instances)
+        except (ValueError, TypeError) as exc:
+            # Ragged rows (inhomogeneous shapes) are a malformed
+            # request body → 406, not an unhandled 500.
+            raise ServeError(
+                f"'instances' is not a rectangular array: {exc}"
+            ) from None
+        if x.ndim == 0:
+            raise ServeError("'instances' must be a non-empty array")
+        if x.ndim == 1:
+            # A single instance's feature vector: serve it as one row.
+            x = x[None, :] if x.shape[0] else x
+        if x.shape[0] == 0:
+            raise ServeError("'instances' must be a non-empty array")
+        if np.issubdtype(x.dtype, np.floating):
+            return x.astype(np.float32)
+        if np.issubdtype(x.dtype, np.integer):
+            return x.astype(np.int32)
+        raise ServeError(
+            f"instances dtype {x.dtype} is not numeric"
+        )
+
+    def predict(self, name: str, instances) -> dict:
+        """Synchronous low-latency predict: coalesced, bucketed, split.
+
+        Raises ``QueueFull`` under backpressure (API → 429) and the
+        context's NotFound/Validation errors for bad models (404/406).
+        """
+        x = self._as_batch(instances)
+        entry = self.registry.get(name)  # load-before-queue: 404 fast
+        t0 = time.perf_counter()
+        out = self._batcher_for(name).submit(x)
+        entry.requests += 1
+        return {
+            "model": name,
+            "predictions": out.tolist(),
+            "latencyMs": round((time.perf_counter() - t0) * 1e3, 3),
+        }
+
+    # -- observability -------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            per_model = {
+                name: b.stats() for name, b in self._batchers.items()
+            }
+        return {
+            "registry": self.registry.stats(),
+            "models": per_model,
+            "config": {
+                "maxBatch": self.cfg.max_batch,
+                "maxQueue": self.cfg.max_queue,
+                "flushMs": self.cfg.flush_ms,
+                "maxModels": self.cfg.max_models,
+                "maxBytes": self.cfg.max_bytes,
+                "retryAfterS": self.cfg.retry_after_s,
+            },
+        }
+
+    def snapshot_scalars(self, stats: dict | None = None) -> dict:
+        """Append current aggregate stats to the serving history and
+        (when a monitoring root exists) rewrite them as ``serving_*``
+        tfevents scalars — each poll of the monitoring endpoint adds
+        one step, so TensorBoard shows serving health over time.
+        Pass ``stats`` when the caller already computed :meth:`stats`
+        (the monitoring route serves both) to avoid taking every
+        batcher lock twice per poll."""
+        if stats is None:
+            stats = self.stats()
+        agg = {
+            "serving_requests": 0, "serving_rows": 0,
+            "serving_batches": 0, "serving_overflows": 0,
+            "serving_queue_depth": 0,
+        }
+        occ, lat50, lat95, lat99, n_models = [], [], [], [], 0
+        for mstats in stats["models"].values():
+            n_models += 1
+            agg["serving_requests"] += mstats["requests"]
+            agg["serving_rows"] += mstats["rows"]
+            agg["serving_batches"] += mstats["batches"]
+            agg["serving_overflows"] += mstats["overflows"]
+            agg["serving_queue_depth"] += mstats["queueDepth"]
+            occ.append(mstats["batchOccupancy"])
+            lat50.append(mstats["latencyMs"]["p50"])
+            lat95.append(mstats["latencyMs"]["p95"])
+            lat99.append(mstats["latencyMs"]["p99"])
+        agg["serving_batch_occupancy"] = (
+            round(sum(occ) / n_models, 4) if n_models else 0.0
+        )
+        agg["serving_p50_ms"] = max(lat50, default=0.0)
+        agg["serving_p95_ms"] = max(lat95, default=0.0)
+        agg["serving_p99_ms"] = max(lat99, default=0.0)
+        agg["serving_resident_models"] = (
+            stats["registry"]["residentModels"]
+        )
+        agg["serving_resident_bytes"] = stats["registry"]["residentBytes"]
+        with self._scalar_lock:
+            for key, val in agg.items():
+                steps = self._scalar_history.setdefault(key, [])
+                steps.append(float(val))
+                # Bounded window: a long-lived server polled every few
+                # seconds must not grow this (or the rewritten events
+                # file) without limit.
+                if len(steps) > _SCALAR_WINDOW:
+                    del steps[:-_SCALAR_WINDOW]
+            if self.monitoring_root:
+                from learningorchestra_tpu.services.tfevents import (
+                    write_scalars,
+                )
+
+                logdir = os.path.join(
+                    str(self.monitoring_root), "serving"
+                )
+                try:
+                    # Fixed wall_time → fixed file name: every
+                    # snapshot rewrites ONE events file with the
+                    # windowed history.
+                    write_scalars(
+                        logdir, self._scalar_history,
+                        wall_time=self._t0,
+                    )
+                except OSError:
+                    pass  # observability must never fail the poll
+        return agg
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            batchers = list(self._batchers.values())
+            self._batchers.clear()
+        for batcher in batchers:
+            batcher.close()
+        self.registry.clear()

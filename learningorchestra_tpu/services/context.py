@@ -92,8 +92,28 @@ class ServiceContext:
         compile_cache.get_cache().add_invalidation_listener(
             _drop_warm_hints
         )
+        # Artifact-change fan-out: anything holding derived state keyed
+        # by artifact name (the serving registry's device-resident
+        # params, serve/registry.py) subscribes here; delete and
+        # binary-overwrite paths notify so stale state is dropped
+        # before the next read.
+        self._artifact_change_listeners: list = []
         self._reflag_interrupted_jobs()
         self._init_backend()
+
+    def add_artifact_change_listener(self, listener) -> None:
+        """Register ``listener(name)`` to fire when an artifact's
+        binary or metadata is replaced or deleted.  Listeners must be
+        fast and must not raise (exceptions are swallowed — a broken
+        subscriber must not fail a delete)."""
+        self._artifact_change_listeners.append(listener)
+
+    def notify_artifact_changed(self, name: str) -> None:
+        for listener in self._artifact_change_listeners:
+            try:
+                listener(name)
+            except Exception:  # noqa: BLE001 — never fail the mutation
+                pass
 
     def _reflag_interrupted_jobs(self) -> None:
         """Any pending/running jobState at startup belonged to a DEAD
@@ -264,6 +284,10 @@ class ServiceContext:
         meta = self.require_existing(name)
         self.artifacts.delete(name)
         self.volumes.delete(meta.get("type", ""), name)
+        # Serving registry (and any other subscriber) must drop
+        # resident state derived from this artifact NOW — a recreated
+        # artifact with the same name must never serve deleted weights.
+        self.notify_artifact_changed(name)
         # A text transform also owns a trained-tokenizer binary next to
         # its shard directory; deleting the artifact must not leave it
         # behind (a later tokenizerFrom would silently load the stale

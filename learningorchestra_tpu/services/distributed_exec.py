@@ -260,6 +260,11 @@ class DistributedExecutorService:
                     trainer.fit(**params)
                 fit_time = time.perf_counter() - t0
             self.ctx.volumes.save_object(artifact_type, name, instance)
+            # A re-train just replaced this artifact's binary: a
+            # serving registry holding the old params resident must
+            # reload before the next request (same contract as the
+            # single-device executor path).
+            self.ctx.notify_artifact_changed(name)
             # Replace (not append) history rows on re-runs.
             for doc in self.ctx.documents.find(
                 name, query={"docType": "history"}

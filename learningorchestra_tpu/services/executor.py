@@ -214,6 +214,10 @@ class ExecutorService:
                 # Train semantics: persist the mutated instance
                 # (binary_execution.py:195-200).
                 self.ctx.volumes.save_object(artifact_type, name, instance)
+                # A PATCH re-train just replaced this artifact's binary:
+                # a serving registry holding its old params resident
+                # must reload before the next request.
+                self.ctx.notify_artifact_changed(name)
                 extra = {"fitTime": fit_time,
                          "compileCache": cache_delta}
                 hist = getattr(instance, "history", None)
@@ -414,6 +418,7 @@ class ExecutorService:
                         pending.cancel()
                     raise
             self.ctx.volumes.save_object(artifact_type, name, best_instance)
+            self.ctx.notify_artifact_changed(name)
             if trials_lease and compile_cache.enabled():
                 self.ctx.engine.note_warm(warm_key)
             # Grid-level compile-cache accounting: candidates sharing

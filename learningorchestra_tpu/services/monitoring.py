@@ -34,9 +34,11 @@ _PORT_RE = re.compile(r"http://[^\s:]+:(\d+)")
 # First char alphanumeric/underscore: forbids '.', '..' and path escapes.
 _NICK_RE = re.compile(r"[A-Za-z0-9_][A-Za-z0-9_.\-]*")
 # Fixed API sub-routes under /monitoring/<tool>/ (compiled-program
-# cache counters): a session so named could be created but never read
-# back — its GET is shadowed.
-_RESERVED_NICKNAMES = frozenset({"compileCache", "compile_cache"})
+# cache counters, serving stats): a session so named could be created
+# but never read back — its GET is shadowed.
+_RESERVED_NICKNAMES = frozenset(
+    {"compileCache", "compile_cache", "serving"}
+)
 
 
 class MonitoringError(Exception):

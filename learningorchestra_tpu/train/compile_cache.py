@@ -56,6 +56,7 @@ from typing import Any, Callable
 
 __all__ = [
     "CompiledProgramCache",
+    "apply_program_key",
     "canonical",
     "fingerprint",
     "get_cache",
@@ -209,6 +210,27 @@ def program_key(
     return fingerprint(
         kind, module, optimizer, str(loss), str(dtype), shapes, mesh,
         donate,
+    )
+
+
+def apply_program_key(module: Any, *, rows: int | None = None) -> str:
+    """Key for a pure-inference ``apply`` program.
+
+    Optimizer and loss play no part in inference, so every consumer of
+    an architecture shares one program family.  ``rows`` is the
+    SHAPE-BUCKET dimension (a serving bucket or predict's batch size):
+    keyed this way, a whole deployment compiles at most one executable
+    per (architecture, bucket) and the cache's miss counter counts
+    buckets — never requests.  The one place the predict/serve key
+    scheme lives; train/neural.py and serve/ both resolve through it.
+    """
+    return program_key(
+        "apply",
+        module=module_fingerprint(module),
+        optimizer=None,
+        loss="-",
+        dtype="-",
+        shapes=None if rows is None else ("rows", int(rows)),
     )
 
 

@@ -170,9 +170,11 @@ def build_stop_callbacks(owner, callbacks, early_stopping,
                          *, allow_restore: bool = True) -> list:
     """Shared fit-surface plumbing: normalize the callback list, fold
     in an ``early_stopping`` spec, reset reused EarlyStopping
-    instances, and clear ``owner.stop_training``.  The distributed/
-    pipelined surfaces pass ``allow_restore=False`` — their state is
-    mesh-sharded and best-weights rollback isn't wired there."""
+    instances, and clear ``owner.stop_training``.  The pipelined
+    surface passes ``allow_restore=False`` — its stage-partitioned
+    state has no rollback wired; the single-device AND mesh-sharded
+    fits both support restore-best (the latter snapshots device-side,
+    sharding preserved — parallel/distributed.py)."""
     owner.stop_training = False
     cbs = list(callbacks or [])
     # False is the natural JSON off-toggle mirroring True — disabled,
@@ -184,11 +186,34 @@ def build_stop_callbacks(owner, callbacks, early_stopping,
             if cb.restore_best_weights and not allow_restore:
                 raise ValueError(
                     "restoreBestWeights is not supported on this fit "
-                    "surface (sharded state); use the single-device "
-                    "fit, or drop the flag"
+                    "surface (pipeline-stage-partitioned state); use "
+                    "the single-device or mesh-sharded fit, or drop "
+                    "the flag"
                 )
             cb.reset()
     return cbs
+
+
+_SNAPSHOT_FN = None
+
+
+def snapshot_params(params):
+    """Device-side copy of a parameter tree for best-weights rollback.
+
+    Eager ``jnp.copy`` rejects non-fully-addressable arrays (a
+    multi-host mesh's fsdp/tp shards live on other hosts), so the copy
+    runs under ONE cached jit: each leaf copies following its own
+    sharding, which covers host numpy trees, single-device arrays and
+    global sharded arrays alike.  Every process of a multi-controller
+    fit issues the same call in the same order (callbacks run the same
+    loop on every host), the SPMD requirement.
+    """
+    global _SNAPSHOT_FN
+    if _SNAPSHOT_FN is None:
+        _SNAPSHOT_FN = jax.jit(
+            lambda t: jax.tree_util.tree_map(jnp.copy, t)
+        )
+    return _SNAPSHOT_FN(params)
 
 
 class EarlyStopping:
@@ -283,9 +308,7 @@ class EarlyStopping:
         if improved:
             self.best, self.best_epoch, self.wait = value, epoch, 0
             if self.restore_best_weights:
-                self.best_params = jax.tree_util.tree_map(
-                    jnp.copy, model.params
-                )
+                self.best_params = snapshot_params(model.params)
         else:
             self.wait += 1
         # keras parity: patience=N stops after N consecutive
@@ -1407,31 +1430,55 @@ class NeuralEstimator(Estimator):
                 self.predict(view.load_shard(k), batch_size)
                 for k in range(view.dataset.n_shards)
             ], axis=0)
+        from learningorchestra_tpu.serve.bucketing import (
+            bucket_for,
+            pad_rows,
+        )
+
         x = np.asarray(as_array(x))
         outs = []
-        if self._apply_fn is None:
+        for i in range(0, len(x), batch_size):
+            xb = x[i:i + batch_size]
+            k = xb.shape[0]
+            # The ragged final slice used to dispatch at its own shape,
+            # so EVERY distinct tail length re-traced and re-compiled
+            # apply.  Pad it up to its power-of-two bucket (capped at
+            # batch_size — full batches dispatch at batch_size exactly)
+            # and slice the pad rows off the output: compile count is
+            # bounded by the bucket set, never by tail diversity.  Same
+            # helper and discipline as the serving path (serve/).
+            bucket = bucket_for(k, batch_size)
+            out = np.asarray(
+                self._apply_for(bucket)(
+                    self.params,
+                    jnp.asarray(pad_rows(xb, bucket) if k != bucket
+                                else xb),
+                )
+            )
+            outs.append(out[:k] if k != bucket else out)
+        return np.concatenate(outs, axis=0)
+
+    def _apply_for(self, rows: int):
+        """Cache-resolved jitted ``apply`` for a ``rows``-row input.
+
+        Keyed through :func:`compile_cache.apply_program_key` —
+        optimizer/loss play no part in inference, and ``rows`` is the
+        shape-bucket dimension, so every predict job AND the serving
+        path share one executable per (architecture, bucket) and the
+        cache's miss counter counts buckets, not calls."""
+        fns = getattr(self, "_apply_fns", None)
+        if fns is None:
+            fns = self._apply_fns = {}
+        fn = fns.get(rows)
+        if fn is None:
             from learningorchestra_tpu.train import compile_cache as cc
 
-            # Optimizer/loss play no part in inference — key on the
-            # architecture alone so predict shares one traced apply
-            # across every job serving this arch.
-            self._apply_fn = cc.get_cache().get_or_build(
-                cc.program_key(
-                    "apply",
-                    module=cc.module_fingerprint(self.module),
-                    optimizer=None,
-                    loss="-",
-                    dtype="-",
-                ),
+            fn = fns[rows] = cc.get_cache().get_or_build(
+                cc.apply_program_key(self.module, rows=rows),
                 lambda: jax.jit(self.module.apply),
-                label=f"apply:{type(self.module).__name__}",
+                label=f"apply:{type(self.module).__name__}:b{rows}",
             )
-        apply = self._apply_fn
-        for i in range(0, len(x), batch_size):
-            outs.append(
-                np.asarray(apply(self.params, jnp.asarray(x[i:i + batch_size])))
-            )
-        return np.concatenate(outs, axis=0)
+        return fn
 
     def predict_classes(self, x, batch_size: int = 512):
         return np.argmax(self.predict(x, batch_size), axis=-1)
@@ -1513,6 +1560,7 @@ class NeuralEstimator(Estimator):
         d["_step_fn"] = None
         d["_eval_fn"] = None
         d["_apply_fn"] = None
+        d.pop("_apply_fns", None)  # per-bucket jitted applies
         d["_device_epoch"] = None
         d["_device_epoch_key"] = None
         d["params"] = jax.device_get(d["params"]) if d["params"] is not None \

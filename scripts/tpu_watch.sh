@@ -32,18 +32,23 @@ print('PROBE_OK')
 " 2>/dev/null | grep -q PROBE_OK
 }
 
-# Flash-evidence first: the 2026-08-01 window lasted ~3 minutes, which
-# the two-model quick-evidence script overran.  The flash stage banks
-# ONE number (bf16 MNIST throughput, the headline continuity metric)
-# in under a minute of tunnel time; quick-evidence then adds BERT.
+# Stages run in ASCENDING expected-runtime order (the :timeout suffix
+# doubles as the runtime estimate): observed tunnel windows are short
+# (~3 min to tens of minutes), so every window should bank the
+# shortest remaining stages first instead of starving them behind a
+# long sweep that the window can't fit anyway.  Flash-evidence leads —
+# it banks ONE number (bf16 MNIST throughput, the headline continuity
+# metric) in under a minute of tunnel time; of the two equal-budget
+# 3600s stages, bench.py goes first because it banks the round's
+# headline record while resnet_mfu_sweep only refines a rider.
 STAGES=(
   "scripts/tpu_flash_evidence.py:300"
   "scripts/tpu_quick_evidence.py:900"
   "scripts/tpu_validate_r2.py:2700"
   "scripts/tpu_validate_r3.py:2700"
-  "scripts/bert_mfu_sweep.py:5400"
-  "scripts/resnet_mfu_sweep.py:3600"
   "bench.py:3600"
+  "scripts/resnet_mfu_sweep.py:3600"
+  "scripts/bert_mfu_sweep.py:5400"
 )
 declare -A DONE
 declare -A FAILS

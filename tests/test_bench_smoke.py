@@ -51,6 +51,26 @@ def test_tpu_suite_smoke_end_to_end():
     json.loads(json.dumps(record))
 
 
+def test_serving_probe_smoke():
+    """Drive bench._serving_probe's exact code path at tiny scale: the
+    record must assemble JSON-clean, latencies must be ordered, and
+    compile misses must be bounded by the bucket set — the
+    shape-bucketing contract the full probe asserts on chip."""
+    out = bench._serving_probe(
+        n_features=8, hidden=(16,), n_sequential=8, n_concurrent=32,
+        concurrency=8, max_batch=8,
+    )
+    assert out["sequential_rps"] > 0
+    assert out["concurrent_rps"] > 0
+    assert out["coalescing_speedup"] > 0
+    assert 0 <= out["p50_ms"] <= out["p99_ms"]
+    assert 0 < out["batch_occupancy"] <= 1
+    # Misses bounded by buckets, never request count (48 requests ran).
+    assert out["compile_misses"] <= out["buckets_possible"] == 4
+    assert all(int(b) <= 8 for b in out["bucket_histogram"])
+    json.loads(json.dumps(out))
+
+
 def test_prior_best_never_crosses_backends(tmp_path):
     # A CPU fallback round must not ratio itself against TPU history:
     # _prior_best(cpu_metric, allow_cross_backend=False) may only match

@@ -113,10 +113,7 @@ def test_distributed_fit_learns_and_matches_contract():
 
 def test_distributed_early_stopping():
     """The distributed surface honors the same early_stopping spec as
-    the single-device fit; restore-best is refused loudly (sharded
-    state has no rollback wired)."""
-    import pytest as _pytest
-
+    the single-device fit."""
     from learningorchestra_tpu.models.mlp import MLPClassifier
 
     x, y = _toy_problem()
@@ -132,12 +129,55 @@ def test_distributed_early_stopping():
     # and the stitched estimator history matches the actual count.
     assert len(trainer.history["loss"]) == 3
     assert len(est.history["loss"]) == 3
-    with _pytest.raises(ValueError, match="restoreBestWeights"):
-        trainer.fit(
-            x, y, epochs=2, batch_size=64,
-            early_stopping={"monitor": "loss", "patience": 1,
-                             "restoreBestWeights": True},
-        )
+
+
+def test_distributed_restore_best_weights():
+    """restoreBestWeights on the mesh-sharded fit: the best epoch's
+    params are snapshotted device-side (sharded jnp.copy) and rolled
+    back on stop; the moments are dropped (they belong to later
+    epochs), matching the single-device contract."""
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    from learningorchestra_tpu.models.mlp import MLPClassifier
+    from learningorchestra_tpu.train.neural import EarlyStopping
+
+    x, y = _toy_problem()
+    # A huge learning rate makes later epochs WORSE, so the restored
+    # best must differ measurably from the final epoch's params.
+    est = MLPClassifier(
+        hidden_layer_sizes=(16,), num_classes=4, seed=1, learning_rate=5.0
+    )
+    cb = EarlyStopping(monitor="loss", patience=2,
+                       restore_best_weights=True)
+    seen = {}
+
+    def record(epoch, metrics, model):
+        # Runs BEFORE the EarlyStopping callback each epoch, so it
+        # captures that epoch's params pre-rollback.
+        seen[epoch] = _jax.tree_util.tree_map(_jnp.copy, model.params)
+
+    trainer = DistributedTrainer(est, spec=MeshSpec(dp=8))
+    trainer.fit(x, y, epochs=20, batch_size=64, callbacks=[record, cb])
+    assert cb.best_epoch is not None
+    last_epoch = max(seen)
+    assert cb.best_epoch < last_epoch  # lr 5.0: later epochs got worse
+    best = _jax.tree_util.tree_leaves(_jax.device_get(seen[cb.best_epoch]))
+    last = _jax.tree_util.tree_leaves(_jax.device_get(seen[last_epoch]))
+    now = _jax.tree_util.tree_leaves(est.params)
+    # The estimator got exactly the BEST epoch's params back...
+    for a, b in zip(best, now):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6)
+    # ...which genuinely differ from the final epoch's.
+    assert any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(last, now)
+    )
+    # Moments dropped: continuation training re-inits them.
+    assert est.opt_state is None
+    # Handed-back params are host pytrees, single-device usable.
+    assert est.score(x, y) >= 0
 
 
 def test_distributed_matches_single_device_loss_first_epoch():
