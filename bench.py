@@ -552,6 +552,178 @@ def _serving_probe(
     }
 
 
+def _obs_probe(n_jobs: int = 60, rounds: int = 3) -> dict:
+    """Observability-overhead probe: what the obs layer (metrics +
+    tracing, the deployed default) costs per dispatched job, against
+    the system's real dispatch path with LO_TPU_OBS_ENABLED=0
+    semantics.
+
+    Two measurements, deliberately split:
+
+    - **A/B windows** (context + denominator): alternating off/on
+      rounds, each driving ``n_jobs`` function jobs through the FULL
+      dispatch path — APIServer.handle POST → validation → metadata
+      create → engine submit → job run → completion — exactly what
+      "dispatch throughput" means to a client of this server
+      (~5 ms/job on the CPU bench box).  On a shared 2-core box,
+      IDENTICAL-config windows differ by ±8% (measured: off-vs-off
+      swings -8%..+6%), so the window rps bound the truth but cannot
+      resolve a ~50 µs/job effect; each side keeps its best window
+      (noise only ever adds time).
+    - **Direct cost** (the verdict's numerator): tight-loop timings
+      of exactly the per-job obs work — the full trace lifecycle
+      (create, queue-wait span, job span begin/activate/end, to_doc),
+      the engine + HTTP metric ops, and the ledger write delta from
+      carrying the trace doc.  ``overhead_pct`` is that total over
+      the best OFF window's per-job dispatch time.  Tight loops are
+      stable to ~1 µs where A/B windows are not.
+
+    The acceptance bar is < 5% dispatch-throughput cost with obs on —
+    beyond that means a hot-path regression in obs/, not box noise.
+    """
+    import tempfile
+    from pathlib import Path
+
+    from learningorchestra_tpu.api.server import APIServer
+    from learningorchestra_tpu.config import Config
+    from learningorchestra_tpu.jobs.engine import _job_metrics
+    from learningorchestra_tpu.obs import metrics as obs_metrics
+    from learningorchestra_tpu.obs import tracing as obs_tracing
+    from learningorchestra_tpu.store import ArtifactStore, DocumentStore
+
+    prefix = "/api/learningOrchestra/v1"
+
+    def one_window(enabled: bool) -> float:
+        """One API-level window → per-job dispatch seconds
+        (POST accepted → job finished, pipelined over n_jobs)."""
+        obs_metrics.reset_registry(
+            enabled=enabled, trace_enabled=enabled
+        )
+        with tempfile.TemporaryDirectory() as td:
+            cfg = Config()
+            cfg.store.root = str(Path(td) / "store")
+            cfg.store.volume_root = str(Path(td) / "volumes")
+            server = APIServer(cfg)
+            try:
+                read = server.ctx.artifacts.metadata.read
+                t0 = time.perf_counter()
+                for i in range(n_jobs):
+                    status, payload = server.handle(
+                        "POST", prefix + "/function/python",
+                        {"name": f"f{i}", "function": "response = 1"},
+                        {},
+                    )
+                    assert status == 201, payload
+                deadline = time.time() + 120
+                while time.time() < deadline:
+                    metas = [read(f"f{i}") or {} for i in range(n_jobs)]
+                    if all(m.get("finished") for m in metas):
+                        break
+                    time.sleep(0.01)
+                else:
+                    raise RuntimeError("obs probe window timed out")
+                dt = time.perf_counter() - t0
+            finally:
+                server.shutdown()
+        return dt / n_jobs
+
+    def tight(fn, m: int = 400, reps: int = 6) -> float:
+        """Per-call seconds, BEST of ``reps`` windows: scheduler/steal
+        noise only ever ADDS time, so the minimum is the robust
+        estimator (the same discipline as _fused_throughput)."""
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(m):
+                fn()
+            best = min(best, (time.perf_counter() - t0) / m)
+        return best
+
+    try:
+        one_window(True)  # warm-up: imports, allocator, store paths
+        off_s, on_s = [], []
+        for _ in range(rounds):
+            off_s.append(one_window(False))
+            on_s.append(one_window(True))
+        off_med = min(off_s)
+        on_med = min(on_s)
+
+        # -- direct per-job obs cost, obs ON ---------------------------
+        obs_metrics.reset_registry(enabled=True, trace_enabled=True)
+
+        def trace_lifecycle():
+            trace = obs_tracing.new_trace("probe")
+            trace.add_span("queue_wait", 0.0, 0.001,
+                           attrs={"class": "bench"})
+            sid = trace.begin("job")
+            with obs_tracing.activate(trace, sid):
+                pass
+            trace.end(sid)
+            trace.to_doc()
+
+        trace_us = tight(trace_lifecycle) * 1e6
+        reg = obs_metrics.get_registry()
+        http_hist = reg.histogram("probe_http_seconds", labels=("route",))
+        http_total = reg.counter(
+            "probe_http_total", labels=("route", "status")
+        )
+        http_max = reg.gauge("probe_http_max_ms", labels=("route",))
+
+        def metric_ops():
+            # Engine-side (queue-wait observe + terminal counter) plus
+            # HTTP-side (_record_metric's histogram/counter/max) — the
+            # full per-dispatch metric footprint.
+            h, c = _job_metrics()
+            h.observe(0.003, job_class="bench")
+            c.inc(job_class="bench", state="finished")
+            http_hist.observe(0.005, route="POST /function/python")
+            http_total.inc(route="POST /function/python", status="2xx")
+            http_max.set_max(5.0, route="POST /function/python")
+
+        metrics_us = tight(metric_ops) * 1e6
+
+        trace_doc = obs_tracing.JobTrace("probe")
+        trace_doc.add_span("queue_wait", 0.0, 0.001)
+        sid = trace_doc.begin("job")
+        trace_doc.end(sid)
+        doc = trace_doc.to_doc()
+        with tempfile.TemporaryDirectory() as td:
+            store = DocumentStore(Path(td) / "store")
+            try:
+                arts = ArtifactStore(store)
+                arts.metadata.create("probe", "bench/obs")
+                bare_us = tight(
+                    lambda: arts.ledger.record("probe", state="finished"),
+                    m=300,
+                ) * 1e6
+                with_us = tight(
+                    lambda: arts.ledger.record(
+                        "probe", state="finished", trace=doc
+                    ),
+                    m=300,
+                ) * 1e6
+            finally:
+                store.close()
+        ledger_us = max(0.0, with_us - bare_us)
+    finally:
+        obs_metrics.reset_registry()  # back to config-driven defaults
+
+    total_us = trace_us + metrics_us + ledger_us
+    dispatch_us = off_med * 1e6
+    return {
+        "dispatch_rps_obs_on": round(1.0 / on_med, 1),
+        "dispatch_rps_obs_off": round(1.0 / off_med, 1),
+        "obs_cost_us_per_job": {
+            "trace": round(trace_us, 2),
+            "metrics": round(metrics_us, 2),
+            "ledger_trace": round(ledger_us, 2),
+            "total": round(total_us, 2),
+        },
+        "dispatch_us_per_job": round(dispatch_us, 1),
+        "overhead_pct": round(total_us / dispatch_us * 100.0, 2),
+    }
+
+
 def _cpu_reference_flops(duration_s: float = 2.0) -> float:
     """Dense f32 matmul FLOP/s this host sustains through the same
     jit pipeline — the box-speed denominator for the live fallback
@@ -699,6 +871,10 @@ def _tpu_suite_child_main() -> None:
         suite["_serving"] = _serving_probe()
     except Exception as exc:  # noqa: BLE001 — record, don't hide
         suite["_serving"] = f"FAILED: {exc!r}"
+    try:
+        suite["_obs"] = _obs_probe()
+    except Exception as exc:  # noqa: BLE001 — record, don't hide
+        suite["_obs"] = f"FAILED: {exc!r}"
     print(json.dumps(suite))
 
 
@@ -712,12 +888,15 @@ def main() -> None:
         flash = suite.pop("_flash", {})
         cache_probe = suite.pop("_compile_cache", None)
         serving_probe = suite.pop("_serving", None)
+        obs_probe = suite.pop("_obs", None)
         throughput, extra = _assemble_tpu(suite)
         extra.update(flash)
         if cache_probe is not None:
             extra["compile_cache"] = cache_probe
         if serving_probe is not None:
             extra["serving"] = serving_probe
+        if obs_probe is not None:
+            extra["obs"] = obs_probe
     else:
         _force_cpu()  # record a CPU number rather than hang the driver
         import jax
@@ -741,6 +920,10 @@ def main() -> None:
             extra["serving"] = _serving_probe()
         except Exception as exc:  # noqa: BLE001 — record, don't hide
             extra["serving"] = f"FAILED: {exc!r}"
+        try:
+            extra["obs"] = _obs_probe()
+        except Exception as exc:  # noqa: BLE001 — record, don't hide
+            extra["obs"] = f"FAILED: {exc!r}"
 
     metric = f"mnist_cnn_train_samples_per_sec_per_chip_{platform}"
     prior = _prior_best(metric, allow_cross_backend=platform == "tpu")

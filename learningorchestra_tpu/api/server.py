@@ -23,6 +23,8 @@ from typing import Callable
 from urllib.parse import parse_qs, urlparse
 
 from learningorchestra_tpu.config import Config, get_config
+from learningorchestra_tpu.obs import metrics as obs_metrics
+from learningorchestra_tpu.obs import tracing as obs_tracing
 from learningorchestra_tpu.services import (
     BuilderService,
     DatasetService,
@@ -203,6 +205,17 @@ class APIServer:
         # predict over device-pinned params, request-coalescing
         # micro-batches, shape-bucketed compiles.
         self.serving = ServingService(self.ctx, monitoring_root)
+        # Unified observability (obs/): push metrics for the HTTP
+        # layer, pull collectors over every subsystem's existing stats,
+        # rendered at GET /metrics.prom.  The legacy JSON endpoints
+        # remain as views over the same instrumentation points.
+        # Handles bind lazily against the CURRENT registry (identity-
+        # checked per use, like the engine/lease helpers), so a
+        # reset_registry() mid-life re-homes both the push metrics and
+        # the collector instead of splitting them across registries.
+        self._obs_registry = None
+        self._obs_rebind_lock = threading.Lock()
+        self._obs_handles()
         self.router = Router(self.config.api.api_prefix)
         self._register_routes()
         self._httpd: ThreadingHTTPServer | None = None
@@ -1369,6 +1382,8 @@ class APIServer:
         add("GET", r"/health", lambda m, b, q: (200, {"status": "ok"}))
 
         def metrics_view(m, body, query):
+            # Legacy JSON view, now backed by the same per-route
+            # instrumentation that feeds the registry histograms.
             with self._metrics_lock:
                 routes = {
                     k: {
@@ -1390,6 +1405,51 @@ class APIServer:
         # Per-route request counts/latencies — the krakend :8090
         # metrics exporter's role (SURVEY §5.1).
         add("GET", r"/metrics", metrics_view)
+
+        # ---- Unified observability (obs/) ----
+        def metrics_prom(m, body, query):
+            """Prometheus text exposition over the whole registry:
+            HTTP latency histograms, job queue waits, lease
+            utilization, compile-cache counters, serving occupancy
+            and store/replication state — one scrapeable surface
+            unifying the four legacy JSON endpoints."""
+            text = self.obs.render_prometheus()
+            return 200, (
+                "text/plain; version=0.0.4; charset=utf-8",
+                text.encode(),
+            )
+
+        add("GET", r"/metrics\.prom", metrics_prom)
+
+        def job_trace(m, body, query):
+            """Span tree of a job's life (queue wait → lease →
+            compile → per-epoch steps), read back from the newest
+            execution-ledger record carrying a trace."""
+            name = m.group("name")
+            self.ctx.require_existing(name)
+            doc = None
+            for rec in reversed(
+                self.ctx.artifacts.ledger.history(name)
+            ):
+                if rec.get("trace"):
+                    doc = rec["trace"]
+                    break
+            if doc is None:
+                return 404, {
+                    "error": f"no trace recorded for {name!r} (job "
+                             "still running, predates tracing, or "
+                             "LO_TPU_OBS_TRACE=0)"
+                }
+            spans = doc.get("spans", [])
+            return 200, {
+                "name": name,
+                "requestId": doc.get("requestId"),
+                "droppedSpans": doc.get("droppedSpans", 0),
+                "spans": spans,
+                "tree": obs_tracing.span_tree(spans),
+            }
+
+        add("GET", rf"/observability/jobs/{NAME}/trace", job_trace)
 
         # ---- Ops status page (the reference's Portainer GUI role,
         # reference: docker-compose.yml:102-129): one human-readable
@@ -1519,6 +1579,46 @@ class APIServer:
             get_logger("api").exception("unhandled handler error: %r", exc)
             return 500, {"error": repr(exc)}
 
+    @property
+    def obs(self):
+        """The registry this server currently exposes (collector
+        registration guaranteed) — the process-wide one."""
+        self._obs_handles()
+        return self._obs_registry
+
+    def _obs_handles(self):
+        """HTTP metric handles on the current registry, rebinding (and
+        re-registering the collector) if reset_registry() replaced it
+        since the last use.  Double-checked under a lock: two racing
+        requests must not register the collector twice."""
+        reg = obs_metrics.get_registry()
+        if reg is not self._obs_registry:
+            with self._obs_rebind_lock:
+                if reg is not self._obs_registry:
+                    buckets_s = tuple(
+                        ms / 1e3
+                        for ms in self.config.obs.latency_buckets_ms
+                    )
+                    self._http_hist = reg.histogram(
+                        "lo_http_request_duration_seconds",
+                        "HTTP request latency by route.",
+                        labels=("route",),
+                        buckets=buckets_s,
+                    )
+                    self._http_total = reg.counter(
+                        "lo_http_requests_total",
+                        "HTTP requests by route and status class.",
+                        labels=("route", "status"),
+                    )
+                    self._http_max = reg.gauge(
+                        "lo_http_request_max_ms",
+                        "Max observed request latency by route.",
+                        labels=("route",),
+                    )
+                    reg.add_collector(self._collect_families)
+                    self._obs_registry = reg
+        return self._http_hist, self._http_total, self._http_max
+
     def _record_metric(self, key: str, status: int, dt_ms: float) -> None:
         with self._metrics_lock:
             rec = self._metrics.setdefault(
@@ -1530,9 +1630,177 @@ class APIServer:
                 rec["errors"] += 1
             rec["total_ms"] += dt_ms
             rec["max_ms"] = max(rec["max_ms"], dt_ms)
+        # Registry mirror (obs/metrics.py): real latency HISTOGRAMS —
+        # the avg/max dict above survives only as the legacy /metrics
+        # JSON view's backing.  No-ops when LO_TPU_OBS_ENABLED=0.
+        http_hist, http_total, http_max = self._obs_handles()
+        http_hist.observe(dt_ms / 1e3, route=key)
+        http_total.inc(
+            route=key, status=f"{min(max(status // 100, 1), 5)}xx"
+        )
+        http_max.set_max(dt_ms, route=key)
+
+    def _collect_families(self):
+        """Pull-side exposition for GET /metrics.prom: snapshot the
+        subsystems that already keep exact counters under their own
+        locks — job queues, the chip-lease pool, the compiled-program
+        cache, serving batchers, store WALs and replication state —
+        into Prometheus families.  Runs at scrape time; must stay
+        fast and must not throw (the renderer drops a failing
+        collector's families, never the exposition)."""
+        import time as _time
+
+        from learningorchestra_tpu.obs.metrics import Family
+        from learningorchestra_tpu.store.ha import is_fenced
+        from learningorchestra_tpu.store.replica import read_epoch
+        from learningorchestra_tpu.train import compile_cache
+
+        fams: list[Family] = []
+        fams.append(
+            Family(
+                "gauge", "lo_uptime_seconds",
+                "Seconds since this API process started.",
+            ).sample(_time.time() - self._t_start)
+        )
+
+        # -- job engine: queue depth per fairness class ---------------
+        depth = Family(
+            "gauge", "lo_jobs_queue_depth",
+            "Queued-but-undispatched jobs per fairness class.",
+        )
+        for cls, n in self.ctx.engine.queue_depths(
+            include_empty=True
+        ).items():
+            depth.sample(n, job_class=cls)
+        fams.append(depth)
+
+        # -- chip-lease pool utilization ------------------------------
+        snap = self.ctx.leaser.snapshot()
+        n_all, n_free = len(snap["all"]), len(snap["free"])
+        fams.append(
+            Family(
+                "gauge", "lo_lease_devices",
+                "Chip-lease pool state (all/free/in_use).",
+            )
+            .sample(n_all, state="all")
+            .sample(n_free, state="free")
+            .sample(n_all - n_free, state="in_use")
+        )
+
+        # -- compiled-program cache -----------------------------------
+        stats = compile_cache.get_cache().stats()
+        events = Family(
+            "counter", "lo_compile_cache_events_total",
+            "Compiled-program cache lifetime counters.",
+        )
+        for kind in ("hits", "misses", "evictions", "coalesced"):
+            events.sample(stats[kind], kind=kind)
+        events.sample(
+            stats["deviceInvalidations"], kind="device_invalidations"
+        )
+        fams.append(events)
+        fams.append(
+            Family(
+                "counter", "lo_compile_cache_trace_seconds_total",
+                "Cumulative seconds spent tracing/compiling programs.",
+            ).sample(stats["traceTimeS"])
+        )
+        fams.append(
+            Family(
+                "gauge", "lo_compile_cache_entries",
+                "Resident compiled-program cache entries.",
+            ).sample(stats["entries"])
+        )
+        fams.append(
+            Family(
+                "gauge", "lo_compile_cache_bytes_estimate",
+                "Estimated resident bytes of cached programs.",
+            ).sample(stats["bytesEstimate"])
+        )
+
+        # -- serving: registry residency + batcher aggregates (the
+        # same roll-up the tfevents snapshot uses — ONE aggregation,
+        # serve/service.py aggregate()) ------------------------------
+        agg = self.serving.aggregate()
+        fams.append(
+            Family(
+                "gauge", "lo_serving_resident_models",
+                "Models pinned resident on device.",
+            ).sample(agg["resident_models"])
+        )
+        fams.append(
+            Family(
+                "gauge", "lo_serving_resident_bytes",
+                "Parameter bytes pinned resident on device.",
+            ).sample(agg["resident_bytes"])
+        )
+        sevents = Family(
+            "counter", "lo_serving_events_total",
+            "Serving lifetime counters, summed over served models.",
+        )
+        for kind in ("requests", "rows", "batches", "overflows",
+                     "padded_rows"):
+            sevents.sample(agg[kind], kind=kind)
+        fams.append(sevents)
+        fams.append(
+            Family(
+                "gauge", "lo_serving_queue_depth",
+                "Rows queued across serving batchers.",
+            ).sample(agg["queue_depth"])
+        )
+        fams.append(
+            Family(
+                "gauge", "lo_serving_batch_occupancy",
+                "Mean dispatch occupancy (rows/bucket) over models.",
+            ).sample(agg["occupancy"])
+        )
+        slat = Family(
+            "gauge", "lo_serving_latency_ms",
+            "Rolling request-latency quantiles (max over models).",
+        )
+        for q, val in agg["quantiles"].items():
+            slat.sample(val, quantile=q)
+        fams.append(slat)
+
+        # -- store WALs + replication ---------------------------------
+        root = self.config.store.store_path()
+        wal_bytes, wal_files = 0, 0
+        if root.is_dir():
+            for wal in root.glob("*.wal"):
+                try:
+                    wal_bytes += wal.stat().st_size
+                    wal_files += 1
+                except OSError:
+                    continue  # dropped between glob and stat
+        fams.append(
+            Family(
+                "gauge", "lo_store_wal_bytes",
+                "Total bytes across store WAL files.",
+            ).sample(wal_bytes)
+        )
+        fams.append(
+            Family(
+                "gauge", "lo_store_wal_files",
+                "Store WAL file count.",
+            ).sample(wal_files)
+        )
+        fams.append(
+            Family(
+                "gauge", "lo_replication_epoch",
+                "This store's election epoch.",
+            ).sample(read_epoch(root))
+        )
+        fams.append(
+            Family(
+                "gauge", "lo_store_fenced",
+                "1 when a standby fenced this store, else 0.",
+            ).sample(1 if is_fenced(root) is not None else 0)
+        )
+        return fams
 
     def handle(self, verb: str, path: str, body: dict, query: dict,
-               idem_key: str | None = None):
+               idem_key: str | None = None,
+               request_id: str | None = None):
         """Dispatch with the gateway budget enforced: request deadline
         (reference: krakend 10 s global timeout → 504), TTL response
         cache on opted-in GETs (300 s ``cache_ttl``), and per-route
@@ -1548,7 +1816,8 @@ class APIServer:
         t0 = _time.perf_counter()
         if self._inflight is None:
             return self._handle_admitted(
-                verb, path, body, query, t0, _Slot(None), idem_key
+                verb, path, body, query, t0, _Slot(None), idem_key,
+                request_id,
             )
         if not self._inflight.acquire(blocking=False):
             # Saturated: shed load NOW rather than queue behind
@@ -1561,14 +1830,15 @@ class APIServer:
                          "in flight); retry with backoff"
             }
         return self._handle_admitted(
-            verb, path, body, query, t0, _Slot(self._inflight), idem_key
+            verb, path, body, query, t0, _Slot(self._inflight),
+            idem_key, request_id,
         )
 
     def _handle_admitted(self, verb, path, body, query, t0, slot,
-                         idem_key=None):
+                         idem_key=None, request_id=None):
         try:
             return self._handle_slotted(
-                verb, path, body, query, t0, slot, idem_key
+                verb, path, body, query, t0, slot, idem_key, request_id
             )
         finally:
             # The slot frees only when its LAST owner releases: for a
@@ -1578,7 +1848,7 @@ class APIServer:
             slot.release()
 
     def _handle_slotted(self, verb, path, body, query, t0, slot,
-                        idem_key=None):
+                        idem_key=None, request_id=None):
         import time as _time
 
         handler, m, route_key, flags = self.router.resolve(verb, path)
@@ -1647,7 +1917,20 @@ class APIServer:
             idem_id = rest[0]
 
         def invoke():
-            result = self._handle_raw(handler, m, body, query)
+            # Bind the request id INSIDE invoke: on the timeout path
+            # the handler runs on a fresh worker thread, which does not
+            # inherit the HTTP thread's context — binding here covers
+            # both the inline and the threaded execution, so a job
+            # submitted anywhere below carries the id into its trace.
+            token = (
+                obs_tracing.set_request_id(request_id)
+                if request_id else None
+            )
+            try:
+                result = self._handle_raw(handler, m, body, query)
+            finally:
+                if token is not None:
+                    obs_tracing.reset_request_id(token)
             if idem_id is not None:
                 self._idem_finish(idem_id, *result)
             return result
@@ -1701,7 +1984,18 @@ class APIServer:
             def log_message(self, *args):
                 pass
 
+            #: Client-supplied request ids must be header-safe and
+            #: bounded; anything else gets a freshly minted id.
+            _RID_RE = re.compile(r"[A-Za-z0-9_.\-]{1,64}")
+
             def _run(self, verb: str):
+                # Request id: echo the client's X-Request-Id or mint
+                # one — set BEFORE the drain check so even a 503
+                # carries it.
+                rid = (self.headers.get("X-Request-Id") or "").strip()
+                if not self._RID_RE.fullmatch(rid):
+                    rid = obs_tracing.new_request_id()
+                self._request_id = rid
                 if api._drain_if_shutting_down(self):
                     return
                 parsed = urlparse(self.path)
@@ -1720,6 +2014,7 @@ class APIServer:
                 status, payload = api.handle(
                     verb, parsed.path, body, query,
                     idem_key=self.headers.get("X-Idempotency-Key"),
+                    request_id=rid,
                 )
                 self._send(status, payload)
 
@@ -1736,6 +2031,12 @@ class APIServer:
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                rid = getattr(self, "_request_id", None)
+                if rid:
+                    # Echoed on EVERY response (including errors): the
+                    # correlation key across logs, metadata and the
+                    # job's span tree.
+                    self.send_header("X-Request-Id", rid)
                 if status == 429 and isinstance(payload, dict) and \
                         payload.get("retryAfter") is not None:
                     # Backpressure contract (serving queue overflow):
@@ -1866,6 +2167,10 @@ class APIServer:
                 return
             self._shut_down = True
         self._shutting_down.set()
+        # The registry outlives this server (process-global): drop the
+        # collector so scrapes never touch a closed context.
+        if self._obs_registry is not None:
+            self._obs_registry.remove_collector(self._collect_families)
         httpd, self._httpd = self._httpd, None
         if httpd is not None:
             httpd.shutdown()

@@ -104,6 +104,7 @@ class Context:
         self.monitoring = _Monitoring(self)
         self.observe = _Observe(self)
         self.serve = _Serve(self)
+        self.observability = _Observability(self)
 
     # -- transport ----------------------------------------------------------
 
@@ -777,6 +778,33 @@ class _Serve:
         ``serving_*`` tfevents scalars server-side)."""
         return self.ctx.request(
             "GET", "/monitoring/tensorflow/serving"
+        )
+
+
+class _Observability:
+    """The unified observability layer (server obs/): Prometheus text
+    exposition and per-job trace span trees.  The JSON endpoints the
+    other bindings use remain; these are the scrape/trace surfaces."""
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+
+    def metrics_prom(self) -> str:
+        """GET /metrics.prom — the whole registry (HTTP latency
+        histograms, job queue waits, lease utilization, compile-cache
+        counters, serving occupancy, store/replication state) in
+        Prometheus text exposition format."""
+        return self.ctx.request(
+            "GET", "/metrics.prom", raw=True
+        ).decode()
+
+    def trace(self, name: str) -> dict:
+        """GET /observability/jobs/<name>/trace — the job's span tree
+        (queue wait → lease → compile → per-epoch steps) with the
+        request id the submission carried; 404 until a completed run
+        has recorded one."""
+        return self.ctx.request(
+            "GET", f"/observability/jobs/{name}/trace"
         )
 
 

@@ -136,6 +136,36 @@ class ServeConfig:
 
 
 @dataclasses.dataclass
+class ObsConfig:
+    """Unified observability layer (obs/): metrics registry +
+    Prometheus exposition at GET /metrics.prom + end-to-end job trace
+    spans.  Env knobs: LO_TPU_OBS_*."""
+
+    # Master switch: off makes every metric/span primitive a no-op
+    # (the bench's overhead probe measures exactly this delta).
+    # Env: LO_TPU_OBS_ENABLED.
+    enabled: bool = True
+    # Job tracing (request-id propagation + spans persisted into the
+    # execution ledger); metrics stay on when only this is off.
+    # Env: LO_TPU_OBS_TRACE.
+    trace: bool = True
+    # Label-cardinality cap per metric: past it, new label
+    # combinations collapse into one ``_overflow`` series.
+    # Env: LO_TPU_OBS_MAX_SERIES.
+    max_series: int = 1024
+    # Span cap per job trace (an epoch-per-span 10k-epoch fit must
+    # not grow the ledger record without bound).
+    # Env: LO_TPU_OBS_MAX_SPANS.
+    max_spans: int = 512
+    # Latency histogram bucket edges, milliseconds, ascending.
+    # Env: LO_TPU_OBS_BUCKETS_MS (comma-separated).
+    latency_buckets_ms: tuple = (
+        1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+        250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0, 30000.0,
+    )
+
+
+@dataclasses.dataclass
 class MeshConfig:
     """Logical device-mesh shape for distributed execution.
 
@@ -238,6 +268,7 @@ class Config:
         default_factory=CompileCacheConfig
     )
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+    obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     dist: DistributedConfig = dataclasses.field(
         default_factory=DistributedConfig
@@ -298,6 +329,39 @@ class Config:
             cfg.serve.retry_after_s = float(
                 env["LO_TPU_SERVE_RETRY_AFTER"]
             )
+        def _bool_env(key: str) -> bool:
+            # Same loud-rejection contract as LO_HA_AUTO_REJOIN: a
+            # silently-misparsed "true" would run production blind.
+            raw = env[key].strip().lower()
+            if raw in ("1", "true", "yes", "on"):
+                return True
+            if raw in ("0", "false", "no", "off", ""):
+                return False
+            raise ValueError(
+                f"{key}={env[key]!r} is not a recognized boolean "
+                "(use 1/0, true/false, yes/no, on/off)"
+            )
+
+        if "LO_TPU_OBS_ENABLED" in env:
+            cfg.obs.enabled = _bool_env("LO_TPU_OBS_ENABLED")
+        if "LO_TPU_OBS_TRACE" in env:
+            cfg.obs.trace = _bool_env("LO_TPU_OBS_TRACE")
+        if "LO_TPU_OBS_MAX_SERIES" in env:
+            cfg.obs.max_series = int(env["LO_TPU_OBS_MAX_SERIES"])
+        if "LO_TPU_OBS_MAX_SPANS" in env:
+            cfg.obs.max_spans = int(env["LO_TPU_OBS_MAX_SPANS"])
+        if "LO_TPU_OBS_BUCKETS_MS" in env:
+            edges = tuple(
+                float(tok)
+                for tok in env["LO_TPU_OBS_BUCKETS_MS"].split(",")
+                if tok.strip()
+            )
+            if not edges or list(edges) != sorted(edges):
+                raise ValueError(
+                    "LO_TPU_OBS_BUCKETS_MS must be a non-empty "
+                    "ascending comma-separated list of milliseconds"
+                )
+            cfg.obs.latency_buckets_ms = edges
         if "LO_TPU_TASK_COORDINATOR" in env:
             cfg.dist.task_coordinator = env["LO_TPU_TASK_COORDINATOR"]
         if "LO_TPU_JAX_COORDINATOR" in env:

@@ -35,9 +35,33 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable
 
 from learningorchestra_tpu.log import capture_thread_stdout, get_logger, kv
+from learningorchestra_tpu.obs import tracing
 from learningorchestra_tpu.store import ArtifactStore
 
 logger = get_logger("jobs")
+
+
+def _job_metrics():
+    """Engine instrumentation handles, resolved per use so a registry
+    reset (tests, the bench's on/off probe) takes effect immediately."""
+    from learningorchestra_tpu.obs.metrics import get_registry
+
+    reg = get_registry()
+    return (
+        reg.histogram(
+            "lo_jobs_queue_wait_seconds",
+            "Queue wait from submit to dispatch, per fairness class.",
+            labels=("job_class",),
+            buckets=(0.001, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0,
+                     60.0, 300.0, 1800.0),
+        ),
+        reg.counter(
+            "lo_jobs_total",
+            "Job state transitions by class (finished/failed are "
+            "terminal; preempted counts each retry attempt).",
+            labels=("job_class", "state"),
+        ),
+    )
 
 
 class JobState:
@@ -144,16 +168,26 @@ class JobEngine:
         fairness is untouched; the hint only reorders one class's
         queue so freed workers favor zero-trace starts.
         """
+        # Observability: the submitting request's id (minted/echoed at
+        # the API layer) rides into the job's metadata, log lines and
+        # trace; the trace collects queue-wait/lease/compile/epoch
+        # spans and persists into the execution ledger on completion.
+        request_id = tracing.get_request_id()
+        trace = tracing.new_trace(name, request_id)
+        t_submit = time.monotonic()
         # Persist the request parameters NOW, not only in the terminal
         # ledger record: a job killed mid-run (process death, store
         # failover) otherwise leaves no parameters anywhere, and the
         # recovery story — "bare PATCH re-uses the last recorded
         # parameters" — would be unfulfillable for a first run.
+        stamp = {}
         if parameters is not None:
+            stamp["requestParameters"] = parameters
+        if request_id:
+            stamp["requestId"] = request_id
+        if stamp:
             try:
-                self.artifacts.metadata.update(
-                    name, {"requestParameters": parameters}
-                )
+                self.artifacts.metadata.update(name, stamp)
             except Exception:  # noqa: BLE001 — recording is best-effort
                 pass
 
@@ -162,82 +196,140 @@ class JobEngine:
             ledger = self.artifacts.ledger
             attempts = 0
             t_start = time.monotonic()
-            while True:
-                meta.mark_running(name)
-                logger.info(kv(job=name, state="running", method=method))
-                # Feed-only event (no webhook fires for "running" —
-                # registrations are finished/failed; the global event
-                # feed still records the transition).
-                self._notify(name, "running")
-                # Rebound by the capture context; the empty default
-                # keeps the except-path buf.getvalue() calls safe if
-                # capture setup itself ever raises.
-                buf = io.StringIO()
-                try:
-                    if capture_stdout:
-                        # Thread-scoped: redirect_stdout would capture
-                        # every concurrent thread's prints, not this
-                        # job's (log.capture_thread_stdout docstring).
-                        with capture_thread_stdout() as buf:
-                            result = fn()
-                    else:
-                        result = fn()
-                except Preempted:
-                    attempts += 1
-                    logger.warning(
-                        kv(job=name, state="preempted", attempt=attempts)
-                    )
-                    ledger.record(
-                        name,
-                        description=description,
-                        method=method,
-                        parameters=parameters,
-                        state="preempted",
-                        stdout=buf.getvalue() if capture_stdout else None,
-                    )
-                    if attempts <= self.max_preemption_retries:
-                        continue
-                    meta.mark_failed(name, "Preempted (retries exhausted)")
-                    self._notify(name, "failed")
-                    return None
-                except BaseException as exc:  # jobs must never kill workers
-                    err = repr(exc)
-                    logger.error(
-                        kv(job=name, state="failed", error=err,
-                           dt=f"{time.monotonic() - t_start:.2f}s")
-                    )
-                    meta.mark_failed(name, err)
-                    ledger.record(
-                        name,
-                        description=description,
-                        method=method,
-                        parameters=parameters,
-                        state=JobState.FAILED,
-                        exception=err,
-                        stdout=buf.getvalue() if capture_stdout else None,
-                    )
-                    # Keep the traceback reachable for debugging without
-                    # crashing the pool thread.
-                    self._last_tracebacks[name] = traceback.format_exc()
-                    self._notify(name, "failed")
-                    return None
+            queue_wait_hist, jobs_total = _job_metrics()
+            queue_wait_hist.observe(
+                t_start - t_submit, job_class=job_class
+            )
+            if trace is not None:
+                trace.add_span(
+                    "queue_wait", t_submit, t_start,
+                    attrs={"class": job_class},
+                )
+            job_sid = trace.begin("job") if trace is not None else None
 
-                extra = on_success(result) if on_success else None
-                logger.info(
-                    kv(job=name, state="finished",
-                       dt=f"{time.monotonic() - t_start:.2f}s")
-                )
-                meta.mark_finished(name, extra or None)
-                ledger.record(
-                    name,
-                    description=description,
-                    method=method,
-                    parameters=parameters,
-                    state=JobState.FINISHED,
-                    stdout=buf.getvalue() if capture_stdout else None,
-                )
-                self._notify(name, "finished")
-                return result
+            def trace_doc():
+                """Finalize + snapshot the trace for a TERMINAL ledger
+                record (None when tracing is off).  Ends the job span
+                first, so the recorded durations cover exactly what
+                ran."""
+                if trace is None:
+                    return None
+                trace.end(job_sid)
+                return trace.to_doc()
+
+            # req=<id> on every engine log line for this job: the one
+            # grep key tying logs, metadata and the span tree together.
+            req = {"req": request_id} if request_id else {}
+            with tracing.activate(trace, job_sid):
+                while True:
+                    meta.mark_running(name)
+                    logger.info(kv(job=name, state="running",
+                                   method=method, **req))
+                    # Feed-only event (no webhook fires for "running" —
+                    # registrations are finished/failed; the global event
+                    # feed still records the transition).
+                    self._notify(name, "running")
+                    # Rebound by the capture context; the empty default
+                    # keeps the except-path buf.getvalue() calls safe if
+                    # capture setup itself ever raises.
+                    buf = io.StringIO()
+                    try:
+                        if capture_stdout:
+                            # Thread-scoped: redirect_stdout would capture
+                            # every concurrent thread's prints, not this
+                            # job's (log.capture_thread_stdout docstring).
+                            with capture_thread_stdout() as buf:
+                                result = fn()
+                        else:
+                            result = fn()
+                    except Preempted:
+                        attempts += 1
+                        exhausted = (
+                            attempts > self.max_preemption_retries
+                        )
+                        logger.warning(
+                            kv(job=name, state="preempted",
+                               attempt=attempts, **req)
+                        )
+                        jobs_total.inc(
+                            job_class=job_class, state="preempted"
+                        )
+                        ledger.record(
+                            name,
+                            description=description,
+                            method=method,
+                            parameters=parameters,
+                            state="preempted",
+                            stdout=buf.getvalue() if capture_stdout
+                            else None,
+                            # The exhausting attempt IS the terminal
+                            # record (no failed-state record follows
+                            # it): persist the trace here or the
+                            # failed run's spans are lost.
+                            trace=trace_doc() if exhausted else None,
+                        )
+                        if not exhausted:
+                            continue
+                        meta.mark_failed(
+                            name, "Preempted (retries exhausted)"
+                        )
+                        jobs_total.inc(
+                            job_class=job_class, state="failed"
+                        )
+                        self._notify(name, "failed")
+                        return None
+                    except BaseException as exc:  # never kill workers
+                        err = repr(exc)
+                        logger.error(
+                            kv(job=name, state="failed", error=err,
+                               dt=f"{time.monotonic() - t_start:.2f}s",
+                               **req)
+                        )
+                        meta.mark_failed(name, err)
+                        jobs_total.inc(
+                            job_class=job_class, state="failed"
+                        )
+                        ledger.record(
+                            name,
+                            description=description,
+                            method=method,
+                            parameters=parameters,
+                            state=JobState.FAILED,
+                            exception=err,
+                            stdout=buf.getvalue() if capture_stdout
+                            else None,
+                            trace=trace_doc(),
+                        )
+                        # Keep the traceback reachable for debugging
+                        # without crashing the pool thread.
+                        self._last_tracebacks[name] = (
+                            traceback.format_exc()
+                        )
+                        self._notify(name, "failed")
+                        return None
+
+                    extra = on_success(result) if on_success else None
+                    logger.info(
+                        kv(job=name, state="finished",
+                           dt=f"{time.monotonic() - t_start:.2f}s",
+                           **req)
+                    )
+                    meta.mark_finished(name, extra or None)
+                    jobs_total.inc(
+                        job_class=job_class, state="finished"
+                    )
+                    ledger.record(
+                        name,
+                        description=description,
+                        method=method,
+                        parameters=parameters,
+                        state=JobState.FINISHED,
+                        stdout=buf.getvalue() if capture_stdout
+                        else None,
+                        trace=trace_doc(),
+                    )
+                    self._notify(name, "finished")
+                    return result
 
         future: Future = Future()
         with self._lock:
@@ -426,12 +518,16 @@ class JobEngine:
         with self._lock:
             return [n for n, f in self._futures.items() if not f.done()]
 
-    def queue_depths(self) -> dict[str, int]:
+    def queue_depths(self, include_empty: bool = False) -> dict[str, int]:
         """Queued-but-undispatched jobs per class (the fairness pools) —
-        the ops status page's contention gauge."""
+        the ops status page's contention gauge.  ``include_empty``
+        keeps drained classes at 0 (the Prometheus collector needs the
+        series to REPORT zero, not vanish and go stale)."""
         with self._lock:
             return {
-                cls: len(q) for cls, q in self._queues.items() if q
+                cls: len(q)
+                for cls, q in self._queues.items()
+                if q or include_empty
             }
 
     def shutdown(self, wait: bool = True) -> None:

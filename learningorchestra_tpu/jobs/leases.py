@@ -32,10 +32,37 @@ import time
 from typing import Sequence
 
 from learningorchestra_tpu.log import get_logger, kv
+from learningorchestra_tpu.obs import tracing
 
 logger = get_logger("leases")
 
 DEFAULT_LEASE_TIMEOUT_S = 120.0  # reference parity: placement timeout
+
+
+def _lease_metrics():
+    """Lease instrumentation handles (obs/metrics.py), resolved per
+    lease so registry resets take effect immediately."""
+    from learningorchestra_tpu.obs.metrics import get_registry
+
+    reg = get_registry()
+    return (
+        reg.histogram(
+            "lo_lease_wait_seconds",
+            "Time a job waited for its chip lease.",
+            buckets=(0.001, 0.01, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0,
+                     300.0, 1800.0),
+        ),
+        reg.histogram(
+            "lo_lease_hold_seconds",
+            "Time a job held its chip lease.",
+            buckets=(0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0,
+                     7200.0, 43200.0),
+        ),
+        reg.counter(
+            "lo_leases_total",
+            "Chip leases granted.",
+        ),
+    )
 
 
 class LeaseTimeout(Exception):
@@ -113,6 +140,7 @@ class DeviceLeaser:
         timeout to get ``LeaseTimeout`` instead (the reference's 120 s
         placement-timeout semantics).
         """
+        t_req = time.monotonic()
         with self._cv:
             self._ensure_devices()
             if not self._all:
@@ -139,9 +167,22 @@ class DeviceLeaser:
                 taken = [self._free.pop() for _ in range(want)]
         t0 = time.monotonic()
         if taken:
+            wait_hist, hold_hist, leases_total = _lease_metrics()
+            wait_hist.observe(t0 - t_req)
+            leases_total.inc()
             logger.info(kv(event="lease", job=label, devices=taken))
         try:
-            yield taken
+            if taken:
+                # The span covers the whole with-block, so compile and
+                # per-epoch spans recorded inside nest under it.
+                with tracing.span(
+                    "lease",
+                    devices=",".join(taken),
+                    waitS=round(t0 - t_req, 6),
+                ):
+                    yield taken
+            else:
+                yield taken
         finally:
             t1 = time.monotonic()
             with self._cv:
@@ -150,6 +191,7 @@ class DeviceLeaser:
                     self.history.append((label, dev, t0, t1))
                 self._cv.notify_all()
             if taken:
+                hold_hist.observe(t1 - t0)
                 logger.info(kv(
                     event="release", job=label, devices=taken,
                     held=f"{t1 - t0:.2f}s",

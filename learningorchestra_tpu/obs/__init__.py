@@ -1,0 +1,45 @@
+"""Unified observability layer: metrics registry, Prometheus
+exposition (obs/metrics.py) and end-to-end job tracing
+(obs/tracing.py).
+
+One coherent surface over what previously lived on four disjoint JSON
+endpoints: ``GET /metrics.prom`` exposes every subsystem's counters
+and histograms in Prometheus text format, and
+``GET /observability/jobs/<name>/trace`` serves the span tree of a
+job's life (queue wait → lease → compile → per-epoch steps), keyed by
+the ``X-Request-Id`` the API mints or echoes.
+
+Knobs: ``LO_TPU_OBS_*`` (config.py ObsConfig).
+"""
+
+from learningorchestra_tpu.obs.metrics import (  # noqa: F401
+    Family,
+    MetricsRegistry,
+    get_registry,
+    reset_registry,
+)
+from learningorchestra_tpu.obs.tracing import (  # noqa: F401
+    JobTrace,
+    current_trace,
+    get_request_id,
+    new_request_id,
+    new_trace,
+    record_span,
+    span,
+    span_tree,
+)
+
+__all__ = [
+    "Family",
+    "JobTrace",
+    "MetricsRegistry",
+    "current_trace",
+    "get_registry",
+    "get_request_id",
+    "new_request_id",
+    "new_trace",
+    "record_span",
+    "reset_registry",
+    "span",
+    "span_tree",
+]

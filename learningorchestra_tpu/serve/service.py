@@ -206,6 +206,37 @@ class ServingService:
             },
         }
 
+    def aggregate(self, stats: dict | None = None) -> dict:
+        """Cross-model roll-up over :meth:`stats` — the ONE place the
+        per-batcher aggregation lives; the tfevents snapshot below and
+        the API server's Prometheus collector both consume it, so a
+        new batcher stat lands on every surface from here."""
+        if stats is None:
+            stats = self.stats()
+        agg = {"requests": 0, "rows": 0, "batches": 0, "overflows": 0,
+               "padded_rows": 0, "queue_depth": 0}
+        occ: list[float] = []
+        quantiles = {"p50": 0.0, "p95": 0.0, "p99": 0.0}
+        for mstats in stats["models"].values():
+            agg["requests"] += mstats["requests"]
+            agg["rows"] += mstats["rows"]
+            agg["batches"] += mstats["batches"]
+            agg["overflows"] += mstats["overflows"]
+            agg["padded_rows"] += mstats["paddedRows"]
+            agg["queue_depth"] += mstats["queueDepth"]
+            occ.append(mstats["batchOccupancy"])
+            for q in quantiles:
+                # Max over models: the worst served model is the one
+                # an SLO cares about.
+                quantiles[q] = max(quantiles[q], mstats["latencyMs"][q])
+        agg["occupancy"] = (
+            round(sum(occ) / len(occ), 4) if occ else 0.0
+        )
+        agg["quantiles"] = quantiles
+        agg["resident_models"] = stats["registry"]["residentModels"]
+        agg["resident_bytes"] = stats["registry"]["residentBytes"]
+        return agg
+
     def snapshot_scalars(self, stats: dict | None = None) -> dict:
         """Append current aggregate stats to the serving history and
         (when a monitoring root exists) rewrite them as ``serving_*``
@@ -214,35 +245,20 @@ class ServingService:
         Pass ``stats`` when the caller already computed :meth:`stats`
         (the monitoring route serves both) to avoid taking every
         batcher lock twice per poll."""
-        if stats is None:
-            stats = self.stats()
+        a = self.aggregate(stats)
         agg = {
-            "serving_requests": 0, "serving_rows": 0,
-            "serving_batches": 0, "serving_overflows": 0,
-            "serving_queue_depth": 0,
+            "serving_requests": a["requests"],
+            "serving_rows": a["rows"],
+            "serving_batches": a["batches"],
+            "serving_overflows": a["overflows"],
+            "serving_queue_depth": a["queue_depth"],
+            "serving_batch_occupancy": a["occupancy"],
+            "serving_p50_ms": a["quantiles"]["p50"],
+            "serving_p95_ms": a["quantiles"]["p95"],
+            "serving_p99_ms": a["quantiles"]["p99"],
+            "serving_resident_models": a["resident_models"],
+            "serving_resident_bytes": a["resident_bytes"],
         }
-        occ, lat50, lat95, lat99, n_models = [], [], [], [], 0
-        for mstats in stats["models"].values():
-            n_models += 1
-            agg["serving_requests"] += mstats["requests"]
-            agg["serving_rows"] += mstats["rows"]
-            agg["serving_batches"] += mstats["batches"]
-            agg["serving_overflows"] += mstats["overflows"]
-            agg["serving_queue_depth"] += mstats["queueDepth"]
-            occ.append(mstats["batchOccupancy"])
-            lat50.append(mstats["latencyMs"]["p50"])
-            lat95.append(mstats["latencyMs"]["p95"])
-            lat99.append(mstats["latencyMs"]["p99"])
-        agg["serving_batch_occupancy"] = (
-            round(sum(occ) / n_models, 4) if n_models else 0.0
-        )
-        agg["serving_p50_ms"] = max(lat50, default=0.0)
-        agg["serving_p95_ms"] = max(lat95, default=0.0)
-        agg["serving_p99_ms"] = max(lat99, default=0.0)
-        agg["serving_resident_models"] = (
-            stats["registry"]["residentModels"]
-        )
-        agg["serving_resident_bytes"] = stats["registry"]["residentBytes"]
         with self._scalar_lock:
             for key, val in agg.items():
                 steps = self._scalar_history.setdefault(key, [])

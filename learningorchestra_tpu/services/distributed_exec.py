@@ -252,12 +252,19 @@ class DistributedExecutorService:
                     self.ctx.artifacts.metadata.update(
                         name, {"leasedDevices": devs}
                     )
+                from learningorchestra_tpu.obs import (
+                    tracing as obs_tracing,
+                )
+
                 t0 = time.perf_counter()
-                if session_name is not None:
-                    with self.monitoring.trace(session_name):
+                with obs_tracing.span(
+                    "trainer_fit", mesh=str(_json_safe(mesh or {}))
+                ):
+                    if session_name is not None:
+                        with self.monitoring.trace(session_name):
+                            trainer.fit(**params)
+                    else:
                         trainer.fit(**params)
-                else:
-                    trainer.fit(**params)
                 fit_time = time.perf_counter() - t0
             self.ctx.volumes.save_object(artifact_type, name, instance)
             # A re-train just replaced this artifact's binary: a
@@ -417,11 +424,18 @@ class DistributedExecutorService:
                     },
                     n_agents=world,
                 )
-                t0 = time.perf_counter()
-                job = wait_job(
-                    coord, job_id, timeout=cfg.job_timeout_s,
-                    poll_interval=1.0,
+                from learningorchestra_tpu.obs import (
+                    tracing as obs_tracing,
                 )
+
+                t0 = time.perf_counter()
+                with obs_tracing.span(
+                    "cluster_fit", world=world, clusterJob=job_id
+                ):
+                    job = wait_job(
+                        coord, job_id, timeout=cfg.job_timeout_s,
+                        poll_interval=1.0,
+                    )
                 if job["state"] != "finished":
                     raise RuntimeError(
                         f"cluster fit {job['state']}: {job.get('errors')}"

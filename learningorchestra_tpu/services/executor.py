@@ -163,10 +163,14 @@ class ExecutorService:
         kind = artifact_type.split("/", 1)[0]
 
         def run():
+            from learningorchestra_tpu.obs import tracing as obs_tracing
             from learningorchestra_tpu.train import compile_cache
 
             cache_before = compile_cache.counters_snapshot()
-            instance = self.ctx.volumes.read_object(parent_type, parent_name)
+            with obs_tracing.span("load_artifact", parent=parent_name):
+                instance = self.ctx.volumes.read_object(
+                    parent_type, parent_name
+                )
             params = dsl.resolve_params(method_parameters, self.ctx.loader)
             if (
                 kind in TRAIN_KINDS

@@ -178,7 +178,15 @@ class MonitoringService:
                 with socket.socket() as probe:
                     probe.settimeout(0.2)
                     if probe.connect_ex((probe_host, port)) == 0:
-                        session.url = self.advertised_url(port)
+                        # Re-check under the lock: stop() may have
+                        # popped the session (and terminated the
+                        # process) while this probe was connecting — a
+                        # stopped session must never advertise a live
+                        # TensorBoard address to a concurrent lookup
+                        # holding the same session object.
+                        with self._lock:
+                            if not session.stopped:
+                                session.url = self.advertised_url(port)
                         return
                 time.sleep(0.2)
 

@@ -186,6 +186,7 @@ class ExecutionLedger:
         exception: str | None = None,
         stdout: str | None = None,
         metrics: dict | None = None,
+        trace: dict | None = None,
     ) -> int:
         doc: dict = {
             "executionTime": _now(),
@@ -208,6 +209,11 @@ class ExecutionLedger:
             doc["functionMessage"] = stdout
         if metrics:
             doc["metrics"] = metrics
+        if trace:
+            # The job's span record (obs/tracing.py): queue wait,
+            # lease, compile, per-epoch steps — served back by
+            # GET /observability/jobs/<name>/trace.
+            doc["trace"] = trace
         return self.store.insert_one(name, doc)
 
     def history(self, name: str) -> list[dict]:

@@ -249,6 +249,23 @@ def _device_signature() -> tuple:
         return ()
 
 
+def _record_compile_span(built_s: float, label, key: str) -> None:
+    """Trace span for one program build: the build IS the "where did
+    the time go" event this cache exists to amortize — a traced job
+    shows each miss as a compile span nested where it happened (inside
+    the lease, under the job root), including when the cache is
+    disabled and every lookup builds.  No-op outside an active trace;
+    never fails a build."""
+    try:
+        from learningorchestra_tpu.obs import tracing
+
+        tracing.record_span(
+            "compile", built_s, label=label or "", key=key[:12]
+        )
+    except Exception:  # noqa: BLE001
+        pass
+
+
 # -- the cache ---------------------------------------------------------------
 
 
@@ -353,7 +370,12 @@ class CompiledProgramCache:
         if self.max_entries <= 0:
             with self._lock:
                 self.misses += 1
-            return builder()
+            t0 = time.perf_counter()
+            value = builder()
+            _record_compile_span(
+                time.perf_counter() - t0, label, key
+            )
+            return value
         while True:
             with self._lock:
                 self._check_devices_locked()
@@ -388,6 +410,7 @@ class CompiledProgramCache:
                 ev.set()
             raise
         built_s = time.perf_counter() - t0
+        _record_compile_span(built_s, label, key)
         with self._lock:
             ev = self._building.pop(key, None)
             self.misses += 1

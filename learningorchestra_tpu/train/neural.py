@@ -31,6 +31,7 @@ import numpy as np
 import optax
 from flax import linen as nn
 
+from learningorchestra_tpu.obs import tracing as obs_tracing
 from learningorchestra_tpu.toolkit.base import Estimator, as_array
 
 
@@ -1084,6 +1085,12 @@ class NeuralEstimator(Estimator):
                     )
                     metrics.update({f"val_{k}": v for k, v in vmetrics.items()})
                 self.history.append(metrics)
+                # Trace span per epoch (train step + validation): the
+                # job's span tree shows exactly where fit time went.
+                # Single contextvar read when no trace is active.
+                obs_tracing.record_span(
+                    "epoch", time.perf_counter() - t0, epoch=epoch_i
+                )
                 if verbose:
                     _train_logger().info(
                         "epoch %d/%d: %s", epoch_i + 1, epochs, metrics
@@ -1301,6 +1308,10 @@ class NeuralEstimator(Estimator):
                             {f"val_{k2}": v for k2, v in vmetrics.items()}
                         )
                     self.history.append(metrics)
+                    obs_tracing.record_span(
+                        "epoch", time.perf_counter() - t0,
+                        epoch=epoch_i, streaming=True,
+                    )
                     if verbose:
                         _train_logger().info(
                             "epoch %d/%d: %s", epoch_i + 1, epochs, metrics

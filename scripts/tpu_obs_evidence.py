@@ -1,0 +1,32 @@
+"""On-box observability-overhead evidence: run bench._obs_probe and
+print its JSON — dispatch throughput with the obs layer on vs off plus
+the direct per-job cost breakdown (trace lifecycle, metric ops, ledger
+trace write).  Short stage (~1-2 min): the probe is host-side, so it
+banks a number whether or not the TPU tunnel stays up, but running it
+in the chain records the number for the SAME box and build the other
+stages measure.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from bench import _obs_probe  # noqa: E402
+
+
+def main() -> None:
+    result = {"obs": _obs_probe()}
+    overhead = result["obs"]["overhead_pct"]
+    # Loud verdict line for the watch log; the JSON is the record.
+    print(
+        f"obs overhead {overhead}% "
+        f"({'OK' if overhead < 5.0 else 'REGRESSION: >= 5%'})",
+        file=sys.stderr, flush=True,
+    )
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -43,6 +43,7 @@ print('PROBE_OK')
 # headline record while resnet_mfu_sweep only refines a rider.
 STAGES=(
   "scripts/tpu_flash_evidence.py:300"
+  "scripts/tpu_obs_evidence.py:300"
   "scripts/tpu_quick_evidence.py:900"
   "scripts/tpu_validate_r2.py:2700"
   "scripts/tpu_validate_r3.py:2700"
