@@ -22,7 +22,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 from urllib.parse import parse_qs, urlparse
 
+from learningorchestra_tpu import faults
 from learningorchestra_tpu.config import Config, get_config
+from learningorchestra_tpu.jobs.leases import LeaseTimeout
 from learningorchestra_tpu.obs import metrics as obs_metrics
 from learningorchestra_tpu.obs import tracing as obs_tracing
 from learningorchestra_tpu.services import (
@@ -257,6 +259,13 @@ class APIServer:
             self.FENCE_CHECK_INTERVAL_S = max(
                 0.05, self.config.ha.fence_interval_s
             )
+        # Fault-injection plane: arm any LO_TPU_FAULT_* schedules the
+        # config carried, so a deployment boots straight into its
+        # chaos drill.  Bad specs raise HERE (boot), loudly.
+        faults.load_env({
+            faults.ENV_PREFIX + suffix: spec
+            for suffix, spec in self.config.faults.specs.items()
+        })
 
     # -- idempotency ----------------------------------------------------------
 
@@ -981,6 +990,20 @@ class APIServer:
         )
 
         # ---- Tune / Train / Evaluate / Predict ----
+        def _deadline_s(body):
+            """Per-submit job deadline override (``deadlineS``): None
+            inherits the engine default (LO_TPU_JOB_DEADLINE_S), 0
+            disables for this job."""
+            raw = body.get("deadlineS")
+            if raw is None:
+                return None
+            try:
+                return float(raw)
+            except (TypeError, ValueError):
+                raise ValidationError(
+                    f"deadlineS must be a number, got {raw!r}"
+                ) from None
+
         def exec_create(service):
             def handler(m, body, query):
                 tool = m.group("tool")
@@ -996,6 +1019,7 @@ class APIServer:
                         scoring_parameters=body.get("scoringParameters"),
                         artifact_type=f"tune/{tool}",
                         description=body.get("description", ""),
+                        deadline_s=_deadline_s(body),
                     )
                 else:
                     meta = self.executor.create(
@@ -1005,6 +1029,7 @@ class APIServer:
                         method_parameters=body.get("methodParameters"),
                         artifact_type=f"{service}/{tool}",
                         description=body.get("description", ""),
+                        deadline_s=_deadline_s(body),
                     )
                 return self._created(f"{service}/{tool}", meta)
 
@@ -1015,6 +1040,7 @@ class APIServer:
                 m.group("name"),
                 method_parameters=body.get("methodParameters"),
                 description=body.get("description", ""),
+                deadline_s=_deadline_s(body),
             )
             return 200, {"metadata": meta}
 
@@ -1227,6 +1253,7 @@ class APIServer:
                 function=body.get("function"),
                 function_parameters=body.get("functionParameters"),
                 description=body.get("description", ""),
+                deadline_s=_deadline_s(body),
             )
             return self._created("function/python", meta)
 
@@ -1236,6 +1263,7 @@ class APIServer:
                 function=body.get("function"),
                 function_parameters=body.get("functionParameters"),
                 description=body.get("description", ""),
+                deadline_s=_deadline_s(body),
             )
             return 200, {"metadata": meta}
 
@@ -1451,6 +1479,53 @@ class APIServer:
 
         add("GET", rf"/observability/jobs/{NAME}/trace", job_trace)
 
+        # ---- Fault-injection plane (faults/plane.py) ----
+        # The chaos drill's REST surface: inspect every registered
+        # fault point, arm a seeded schedule against one, disarm one
+        # or all.  Trigger counters also export at /metrics.prom
+        # (lo_fault_triggers_total).
+        def faults_status(m, body, query):
+            return 200, faults.status()
+
+        def faults_arm(m, body, query):
+            body = body or {}
+            mode = body.get("mode")
+            if not mode:
+                raise ValidationError(
+                    f"missing 'mode' (one of {list(faults.MODES)})"
+                )
+            try:
+                doc = faults.arm(
+                    m.group("name"), str(mode),
+                    rate=float(body.get("rate", 1.0)),
+                    seed=int(body.get("seed", 0)),
+                    after=int(body.get("after", 0)),
+                    max_triggers=int(body.get("maxTriggers", 0)),
+                    delay_ms=float(body.get("delayMs", 0.0)),
+                )
+            except (TypeError, ValueError) as exc:
+                raise ValidationError(str(exc)) from None
+            return 201, {"point": m.group("name"), "armed": doc}
+
+        def faults_disarm(m, body, query):
+            try:
+                disarmed = faults.disarm(m.group("name"))
+            except ValueError as exc:  # unknown point
+                raise ValidationError(str(exc)) from None
+            if not disarmed:
+                return 404, {
+                    "error": f"fault point {m.group('name')!r} is "
+                             "not armed"
+                }
+            return 200, {"result": "disarmed"}
+
+        add("GET", r"/faults", faults_status)
+        add("DELETE", r"/faults",
+            lambda m, b, q: (faults.disarm_all(),
+                             (200, {"result": "disarmed"}))[1])
+        add("POST", rf"/faults/{NAME}", faults_arm)
+        add("DELETE", rf"/faults/{NAME}", faults_disarm)
+
         # ---- Ops status page (the reference's Portainer GUI role,
         # reference: docker-compose.yml:102-129): one human-readable
         # HTML view over the JSON the system already exposes — jobs,
@@ -1562,6 +1637,11 @@ class APIServer:
 
     def _handle_raw(self, handler, m, body, query):
         try:
+            # Chaos probe: an armed ``http.handler`` schedule can
+            # delay or fail any admitted request — inside the try, so
+            # an injected error exercises the real 500 path and an
+            # injected delay the real gateway-timeout path.
+            faults.hit("http.handler")
             return handler(m, body, query)
         except (DuplicateArtifact, ConflictError) as exc:
             return 409, {"error": str(exc)}
@@ -1569,6 +1649,15 @@ class APIServer:
             return 404, {"error": str(exc)}
         except (ValidationError, RegistryError, ServeError) as exc:
             return 406, {"error": str(exc)}
+        except LeaseTimeout as exc:
+            # No chip lease within the placement budget: the pool is
+            # saturated, not broken — same contract as the serving
+            # tier's 429: explicit retry budget instead of a generic
+            # 500 (Retry-After attached by the HTTP layer).
+            return 503, {
+                "error": str(exc),
+                "retryAfter": self.config.serve.retry_after_s,
+            }
         except (json.JSONDecodeError, BadRequest) as exc:
             return 400, {"error": f"bad JSON: {exc}"
                          if isinstance(exc, json.JSONDecodeError)
@@ -2037,11 +2126,12 @@ class APIServer:
                     # correlation key across logs, metadata and the
                     # job's span tree.
                     self.send_header("X-Request-Id", rid)
-                if status == 429 and isinstance(payload, dict) and \
-                        payload.get("retryAfter") is not None:
-                    # Backpressure contract (serving queue overflow):
-                    # clients honor the standard header, the JSON field
-                    # carries the same value for non-HTTP consumers.
+                if status in (429, 503) and isinstance(payload, dict) \
+                        and payload.get("retryAfter") is not None:
+                    # Backpressure contract (serving queue overflow,
+                    # chip-lease timeout): clients honor the standard
+                    # header, the JSON field carries the same value
+                    # for non-HTTP consumers.
                     self.send_header(
                         "Retry-After", str(payload["retryAfter"])
                     )

@@ -105,6 +105,7 @@ class Context:
         self.observe = _Observe(self)
         self.serve = _Serve(self)
         self.observability = _Observability(self)
+        self.faults = _Faults(self)
 
     # -- transport ----------------------------------------------------------
 
@@ -606,7 +607,8 @@ class _Executor(_Service):
                method_parameters: dict | None = None,
                param_grid: dict | None = None,
                scoring_parameters: dict | None = None,
-               description: str = "") -> dict:
+               description: str = "",
+               deadline_s: float | None = None) -> dict:
         body: dict = {
             "name": name,
             "parentName": parent_name or model_name,
@@ -620,14 +622,23 @@ class _Executor(_Service):
             body["paramGrid"] = param_grid
             if scoring_parameters:
                 body["scoringParameters"] = scoring_parameters
+        if deadline_s is not None:
+            # Per-job wall-clock bound: past it the engine watchdog
+            # fails the job and reclaims its worker and chip leases
+            # (0 disables for this job, None inherits the server's
+            # LO_TPU_JOB_DEADLINE_S default).
+            body["deadlineS"] = deadline_s
         return self.ctx.request("POST", f"/{self.service_path}", body)
 
     def update(self, name: str, *, method_parameters: dict | None = None,
-               description: str = "") -> dict:
+               description: str = "",
+               deadline_s: float | None = None) -> dict:
+        body: dict = {"methodParameters": method_parameters,
+                      "description": description}
+        if deadline_s is not None:
+            body["deadlineS"] = deadline_s
         return self.ctx.request(
-            "PATCH", f"/{self.service_path}/{name}",
-            {"methodParameters": method_parameters,
-             "description": description},
+            "PATCH", f"/{self.service_path}/{name}", body
         )
 
 
@@ -669,22 +680,26 @@ class _Function(_Service):
 
     def create(self, name: str, *, function: str,
                function_parameters: dict | None = None,
-               description: str = "") -> dict:
-        return self.ctx.request(
-            "POST", "/function/python",
-            {"name": name, "function": function,
-             "functionParameters": function_parameters or {},
-             "description": description},
-        )
+               description: str = "",
+               deadline_s: float | None = None) -> dict:
+        body: dict = {"name": name, "function": function,
+                      "functionParameters": function_parameters or {},
+                      "description": description}
+        if deadline_s is not None:
+            body["deadlineS"] = deadline_s
+        return self.ctx.request("POST", "/function/python", body)
 
     def update(self, name: str, *, function: str | None = None,
                function_parameters: dict | None = None,
-               description: str = "") -> dict:
+               description: str = "",
+               deadline_s: float | None = None) -> dict:
+        body: dict = {"function": function,
+                      "functionParameters": function_parameters,
+                      "description": description}
+        if deadline_s is not None:
+            body["deadlineS"] = deadline_s
         return self.ctx.request(
-            "PATCH", f"/function/python/{name}",
-            {"function": function,
-             "functionParameters": function_parameters,
-             "description": description},
+            "PATCH", f"/function/python/{name}", body
         )
 
 
@@ -806,6 +821,43 @@ class _Observability:
         return self.ctx.request(
             "GET", f"/observability/jobs/{name}/trace"
         )
+
+
+class _Faults:
+    """Fault-injection plane (server faults/): arm deterministic,
+    seeded chaos schedules against named fault points
+    (``engine.dispatch``, ``train.epoch``, ``store.wal_write``, ...)
+    and read per-point hit/trigger counters.  The drill surface behind
+    the self-healing claims — see README "Fault tolerance"."""
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+
+    def status(self) -> dict:
+        """GET /faults — every registered point with its armed
+        schedule (if any) and cumulative hit/trigger counts."""
+        return self.ctx.request("GET", "/faults")
+
+    def arm(self, point: str, mode: str, *, rate: float = 1.0,
+            seed: int = 0, after: int = 0, max_triggers: int = 0,
+            delay_ms: float = 0.0) -> dict:
+        """Arm ``point`` with a seeded schedule: ``mode`` is
+        ``preempt`` (raise the engine's retryable preemption),
+        ``error`` (ordinary crash) or ``delay`` (sleep ``delay_ms``);
+        ``after`` skips the first N hits, ``max_triggers`` bounds
+        total firings, ``rate < 1`` fires a seeded-deterministic
+        subset."""
+        return self.ctx.request(
+            "POST", f"/faults/{point}",
+            {"mode": mode, "rate": rate, "seed": seed, "after": after,
+             "maxTriggers": max_triggers, "delayMs": delay_ms},
+        )
+
+    def disarm(self, point: str) -> dict:
+        return self.ctx.request("DELETE", f"/faults/{point}")
+
+    def disarm_all(self) -> dict:
+        return self.ctx.request("DELETE", "/faults")
 
 
 class _Observe:

@@ -86,6 +86,20 @@ class JobConfig:
     # round-robin turn, so {"train": 2} gives training twice the share
     # under contention.  Env: LO_TPU_JOB_WEIGHTS='{"train": 2}'.
     class_weights: dict = dataclasses.field(default_factory=dict)
+    # Preemption-retry budget per job (a body raising ``Preempted``
+    # re-executes up to this many times).  Env: LO_TPU_JOB_RETRIES.
+    max_preemption_retries: int = 3
+    # Retry backoff: attempt N sleeps min(max, base * 2**(N-1)) with
+    # U[0.5, 1.5) jitter before re-executing — preempted jobs must not
+    # re-slam a recovering device pool in lockstep.
+    # Env: LO_TPU_JOB_BACKOFF_S / LO_TPU_JOB_BACKOFF_MAX_S.
+    retry_backoff_s: float = 0.05
+    retry_backoff_max_s: float = 5.0
+    # Default wall-clock deadline per dispatched job run (preemption
+    # retries included); past it the engine watchdog fails the job
+    # and reclaims its worker and chip leases.  <= 0 disables;
+    # per-submit ``deadlineS`` overrides.  Env: LO_TPU_JOB_DEADLINE_S.
+    deadline_s: float = 0.0
 
 
 @dataclasses.dataclass
@@ -260,6 +274,19 @@ class HAConfig:
 
 
 @dataclasses.dataclass
+class FaultsConfig:
+    """Fault-injection plane (faults/plane.py): seeded chaos schedules
+    armed at boot from ``LO_TPU_FAULT_<POINT>=<mode>[:k=v,...]`` env
+    vars (e.g. ``LO_TPU_FAULT_ENGINE_DISPATCH=preempt:rate=0.5,seed=7``)
+    — the API server passes ``specs`` to ``faults.load_env`` at
+    construction.  Disabled (no vars) the plane costs one dict-empty
+    check per probe."""
+
+    # point-name suffix (env spelling) -> raw spec string.
+    specs: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
 class Config:
     store: StoreConfig = dataclasses.field(default_factory=StoreConfig)
     api: APIConfig = dataclasses.field(default_factory=APIConfig)
@@ -274,6 +301,9 @@ class Config:
         default_factory=DistributedConfig
     )
     ha: HAConfig = dataclasses.field(default_factory=HAConfig)
+    faults: FaultsConfig = dataclasses.field(
+        default_factory=FaultsConfig
+    )
 
     @staticmethod
     def from_env() -> "Config":
@@ -303,6 +333,25 @@ class Config:
                 str(k): int(v)
                 for k, v in _json.loads(env["LO_TPU_JOB_WEIGHTS"]).items()
             }
+        if "LO_TPU_JOB_RETRIES" in env:
+            cfg.jobs.max_preemption_retries = int(
+                env["LO_TPU_JOB_RETRIES"]
+            )
+        if "LO_TPU_JOB_BACKOFF_S" in env:
+            cfg.jobs.retry_backoff_s = float(env["LO_TPU_JOB_BACKOFF_S"])
+        if "LO_TPU_JOB_BACKOFF_MAX_S" in env:
+            cfg.jobs.retry_backoff_max_s = float(
+                env["LO_TPU_JOB_BACKOFF_MAX_S"]
+            )
+        if "LO_TPU_JOB_DEADLINE_S" in env:
+            cfg.jobs.deadline_s = float(env["LO_TPU_JOB_DEADLINE_S"])
+        # Fault-injection schedules: every LO_TPU_FAULT_<POINT> var is
+        # carried verbatim; the API server arms them via faults.load_env
+        # (bad specs are rejected LOUDLY there — a typo'd chaos knob
+        # silently doing nothing would fake a green drill).
+        for key, raw in env.items():
+            if key.startswith("LO_TPU_FAULT_") and raw.strip():
+                cfg.faults.specs[key[len("LO_TPU_FAULT_"):]] = raw
         if "LO_TPU_COMPILE_CACHE_ENTRIES" in env:
             cfg.compile_cache.max_entries = int(
                 env["LO_TPU_COMPILE_CACHE_ENTRIES"]

@@ -2,6 +2,18 @@
 ``ThreadPoolExecutor.submit(__pipeline)`` pattern (e.g. reference:
 microservices/binary_executor_image/binary_execution.py:139,155-186)."""
 
-from learningorchestra_tpu.jobs.engine import JobEngine, JobState
+from learningorchestra_tpu.jobs.engine import (
+    JobDeadlineExceeded,
+    JobEngine,
+    JobState,
+    Preempted,
+    current_attempt,
+)
 
-__all__ = ["JobEngine", "JobState"]
+__all__ = [
+    "JobDeadlineExceeded",
+    "JobEngine",
+    "JobState",
+    "Preempted",
+    "current_attempt",
+]

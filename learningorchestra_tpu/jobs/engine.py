@@ -26,19 +26,39 @@ This engine keeps that durable contract but adds what the reference lacks
 
 from __future__ import annotations
 
+import contextvars
 import io
+import random
 import threading
 import time
 import traceback
 from collections import OrderedDict, deque
-from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import Future, InvalidStateError
 from typing import Any, Callable
 
+from learningorchestra_tpu import faults
 from learningorchestra_tpu.log import capture_thread_stdout, get_logger, kv
 from learningorchestra_tpu.obs import tracing
 from learningorchestra_tpu.store import ArtifactStore
 
 logger = get_logger("jobs")
+
+#: Which retry attempt the calling job body is running as: 0 on the
+#: first execution, N after N preemptions.  Job bodies read it through
+#: :func:`current_attempt` to adapt — the executor service resumes a
+#: retried train fit from its newest managed checkpoint instead of
+#: epoch 0 (services/executor.py), without the engine knowing anything
+#: about checkpoints.
+_ATTEMPT: contextvars.ContextVar = contextvars.ContextVar(
+    "lo_job_attempt", default=0
+)
+
+
+def current_attempt() -> int:
+    """0 on a job's first execution, N inside its Nth preemption
+    retry.  Valid anywhere down the job body's call stack (the engine
+    binds it around each attempt)."""
+    return _ATTEMPT.get()
 
 
 def _job_metrics():
@@ -76,20 +96,60 @@ class Preempted(Exception):
     """Raised by a job body to request re-execution after preemption."""
 
 
+class JobDeadlineExceeded(Exception):
+    """A job body ran past its deadline; the watchdog failed the job
+    and reclaimed its worker and leases (the body itself cannot be
+    killed — it finishes as an abandoned zombie whose result is
+    discarded, the same semantics as a gateway-timed-out handler)."""
+
+
 class JobEngine:
+    #: Watchdog poll cadence.  Deadlines are a coarse hang bound, not a
+    #: scheduler — sub-100ms precision is not a goal.
+    WATCHDOG_INTERVAL_S = 0.1
+
     def __init__(
         self,
         artifacts: ArtifactStore,
         max_workers: int = 8,
         max_preemption_retries: int = 3,
         class_weights: dict[str, int] | None = None,
+        retry_backoff_s: float = 0.05,
+        retry_backoff_max_s: float = 5.0,
+        deadline_s: float = 0.0,
     ):
         self.artifacts = artifacts
         self.max_workers = max_workers
-        self.pool = ThreadPoolExecutor(
-            max_workers=max_workers, thread_name_prefix="lo-job"
-        )
+        # One dedicated thread per DISPATCHED job, gated by _inflight
+        # (< max_workers), not a ThreadPoolExecutor: a fixed pool's
+        # own thread cap would silently double the concurrency gate —
+        # when the deadline watchdog reclaims a hung job's worker
+        # slot, the zombie body still pins its thread, and an
+        # equal-sized pool would have no thread left for the very job
+        # the reclaim freed a slot for.  Threads are trivial next to
+        # job bodies (model fits, dataset loads).
+        self._threads: set[threading.Thread] = set()
         self.max_preemption_retries = max_preemption_retries
+        # Preemption-retry backoff: attempt N sleeps
+        # min(max, base * 2**(N-1)) * jitter, jitter ~ U[0.5, 1.5).
+        # Immediate zero-backoff retries would slam a preempting
+        # device pool in lockstep with every other retrying job —
+        # the thundering-herd the jitter decorrelates.
+        self.retry_backoff_s = max(0.0, float(retry_backoff_s))
+        self.retry_backoff_max_s = max(0.0, float(retry_backoff_max_s))
+        # Default wall-clock bound per dispatched job run (preemption
+        # retries included); <= 0 disables.  Per-submit deadline_s
+        # overrides.
+        self.default_deadline_s = float(deadline_s)
+        # Chip-lease pool (set by the service context): the deadline
+        # watchdog revokes an expired job's leases through it so the
+        # zombie body cannot pin chips it no longer owns.
+        self.leaser = None
+        # name -> dispatch record for RUNNING jobs ({t0, deadline,
+        # future, job_class, ctl}); the watchdog scans it.
+        self._running_recs: dict[str, dict] = {}
+        self._watchdog: threading.Thread | None = None
+        self._watchdog_wake = threading.Event()
         self._futures: dict[str, Future] = {}
         self._last_tracebacks: dict[str, str] = {}
         self._lock = threading.Lock()
@@ -147,6 +207,7 @@ class JobEngine:
         on_success: Callable[[Any], dict | None] | None = None,
         job_class: str = "default",
         warm_key: str | None = None,
+        deadline_s: float | None = None,
     ) -> Future:
         """Run ``fn`` asynchronously as the job for artifact ``name``.
 
@@ -167,6 +228,13 @@ class JobEngine:
         preferred WITHIN their class's round-robin turn — cross-class
         fairness is untouched; the hint only reorders one class's
         queue so freed workers favor zero-trace starts.
+
+        ``deadline_s`` bounds the job body's wall clock per dispatch
+        (None inherits the engine default, ``<= 0`` disables): past
+        it, the watchdog marks the job failed, reclaims its worker
+        slot and chip leases, and resolves the future with
+        :class:`JobDeadlineExceeded`; the unkillable body finishes as
+        an abandoned zombie whose writes are discarded.
         """
         # Observability: the submitting request's id (minted/echoed at
         # the API layer) rides into the job's metadata, log lines and
@@ -191,6 +259,12 @@ class JobEngine:
             except Exception:  # noqa: BLE001 — recording is best-effort
                 pass
 
+        # Deadline control block, shared with the watchdog: once it
+        # flips ``expired`` the (unkillable) body becomes a zombie —
+        # every terminal write below checks it and discards instead of
+        # overwriting the watchdog's recorded failure.
+        ctl = {"expired": False}
+
         def run() -> Any:
             meta = self.artifacts.metadata
             ledger = self.artifacts.ledger
@@ -205,13 +279,13 @@ class JobEngine:
                     "queue_wait", t_submit, t_start,
                     attrs={"class": job_class},
                 )
-            job_sid = trace.begin("job") if trace is not None else None
+            job_sid = None  # the CURRENT attempt's span
 
             def trace_doc():
                 """Finalize + snapshot the trace for a TERMINAL ledger
-                record (None when tracing is off).  Ends the job span
-                first, so the recorded durations cover exactly what
-                ran."""
+                record (None when tracing is off).  Ends the attempt
+                span first, so the recorded durations cover exactly
+                what ran."""
                 if trace is None:
                     return None
                 trace.end(job_sid)
@@ -220,11 +294,29 @@ class JobEngine:
             # req=<id> on every engine log line for this job: the one
             # grep key tying logs, metadata and the span tree together.
             req = {"req": request_id} if request_id else {}
-            with tracing.activate(trace, job_sid):
-                while True:
+            while True:
+                if ctl["expired"]:
+                    # The watchdog expired this job while it slept in
+                    # retry backoff: its failure is already recorded
+                    # and its worker/leases handed on.  Starting
+                    # another attempt here would mark_running over the
+                    # watchdog's failed state and re-contend for the
+                    # just-revoked leases.
+                    logger.warning(kv(job=name, state="abandoned",
+                                      **req))
+                    return None
+                # One span PER ATTEMPT (attrs attempt=1..N): retries
+                # are separate intervals in the persisted trace, not
+                # one opaque job span swallowing every re-execution.
+                if trace is not None:
+                    job_sid = trace.begin(
+                        "job", attrs={"attempt": attempts + 1}
+                    )
+                with tracing.activate(trace, job_sid):
                     meta.mark_running(name)
                     logger.info(kv(job=name, state="running",
-                                   method=method, **req))
+                                   method=method, attempt=attempts + 1,
+                                   **req))
                     # Feed-only event (no webhook fires for "running" —
                     # registrations are finished/failed; the global event
                     # feed still records the transition).
@@ -233,7 +325,9 @@ class JobEngine:
                     # keeps the except-path buf.getvalue() calls safe if
                     # capture setup itself ever raises.
                     buf = io.StringIO()
+                    attempt_token = _ATTEMPT.set(attempts)
                     try:
+                        faults.hit("engine.dispatch")
                         if capture_stdout:
                             # Thread-scoped: redirect_stdout would capture
                             # every concurrent thread's prints, not this
@@ -243,6 +337,13 @@ class JobEngine:
                         else:
                             result = fn()
                     except Preempted:
+                        if ctl["expired"]:
+                            # The watchdog already failed this job and
+                            # reclaimed its worker — no retry, no
+                            # state writes.
+                            logger.warning(kv(job=name,
+                                              state="abandoned", **req))
+                            return None
                         attempts += 1
                         exhausted = (
                             attempts > self.max_preemption_retries
@@ -269,6 +370,17 @@ class JobEngine:
                             trace=trace_doc() if exhausted else None,
                         )
                         if not exhausted:
+                            # Preemption survivors observable from the
+                            # ordinary GET/poll path.
+                            try:
+                                meta.update(
+                                    name, {"preemptions": attempts}
+                                )
+                            except Exception:  # noqa: BLE001
+                                pass
+                            if trace is not None:
+                                trace.end(job_sid)
+                            self._backoff(name, attempts, trace, req)
                             continue
                         meta.mark_failed(
                             name, "Preempted (retries exhausted)"
@@ -280,6 +392,12 @@ class JobEngine:
                         return None
                     except BaseException as exc:  # never kill workers
                         err = repr(exc)
+                        if ctl["expired"]:
+                            logger.warning(
+                                kv(job=name, state="abandoned",
+                                   error=err, **req)
+                            )
+                            return None
                         logger.error(
                             kv(job=name, state="failed", error=err,
                                dt=f"{time.monotonic() - t_start:.2f}s",
@@ -307,7 +425,19 @@ class JobEngine:
                         )
                         self._notify(name, "failed")
                         return None
+                    finally:
+                        _ATTEMPT.reset(attempt_token)
 
+                    if ctl["expired"]:
+                        # Finished after its deadline: the job is
+                        # already failed and its worker/leases handed
+                        # on — a late mark_finished would resurrect it.
+                        logger.warning(
+                            kv(job=name, state="abandoned",
+                               dt=f"{time.monotonic() - t_start:.2f}s",
+                               **req)
+                        )
+                        return None
                     extra = on_success(result) if on_success else None
                     logger.info(
                         kv(job=name, state="finished",
@@ -332,6 +462,16 @@ class JobEngine:
                     return result
 
         future: Future = Future()
+        deadline = (
+            self.default_deadline_s if deadline_s is None
+            else float(deadline_s)
+        )
+        info = {
+            "name": name,
+            "job_class": job_class,
+            "deadline": deadline,
+            "ctl": ctl,
+        }
         with self._lock:
             if self._shutdown:
                 # Same contract as handing the job to a shut-down
@@ -344,11 +484,31 @@ class JobEngine:
                 queue = self._queues[job_class] = deque()
                 self._rr_order.append(job_class)
                 self._credits[job_class] = self._weight(job_class)
-            queue.append((run, future, warm_key))
+            queue.append((run, future, warm_key, info))
             self._futures[name] = future
             self._prune_locked()
             self._dispatch_locked()
         return future
+
+    def _backoff(self, name: str, attempt: int, trace, req: dict) -> None:
+        """Sleep the jittered exponential backoff before retry
+        ``attempt`` and record it as a ``retry_backoff`` span."""
+        base = self.retry_backoff_s
+        if base <= 0:
+            return
+        delay = min(
+            self.retry_backoff_max_s,
+            base * (2 ** max(0, attempt - 1)),
+        ) * (0.5 + random.random())
+        logger.info(kv(job=name, state="backoff",
+                       delay=f"{delay:.3f}s", attempt=attempt, **req))
+        t0 = time.monotonic()
+        time.sleep(delay)
+        if trace is not None:
+            trace.add_span(
+                "retry_backoff", t0, time.monotonic(),
+                attrs={"attempt": attempt, "delayS": round(delay, 4)},
+            )
 
     # -- weighted-fair dispatch ----------------------------------------------
 
@@ -387,7 +547,7 @@ class JobEngine:
             self._warm_keys
             and self._warm_bypass.get(job_class, 0) < self._max_warm_bypass
         ):
-            for i, (runner, future, wk) in enumerate(queue):
+            for i, (runner, future, wk, info) in enumerate(queue):
                 if future.cancelled():
                     continue
                 if wk is not None and wk in self._warm_keys:
@@ -398,10 +558,10 @@ class JobEngine:
                     else:
                         self._warm_bypass[job_class] = 0
                     del queue[i]
-                    return runner, future
+                    return runner, future, info
         self._warm_bypass[job_class] = 0
-        runner, future, _wk = queue.popleft()
-        return runner, future
+        runner, future, _wk, info = queue.popleft()
+        return runner, future, info
 
     def _dispatch_locked(self) -> None:
         """Hand freed workers to queued jobs, class by class (WRR)."""
@@ -409,11 +569,38 @@ class JobEngine:
             item = self._pick_locked()
             if item is None:
                 return
-            runner, future = item
+            runner, future, info = item
             if not future.set_running_or_notify_cancel():
                 continue  # cancelled while queued — skip, pick again
             self._inflight += 1
-            self.pool.submit(self._run_dispatched, runner, future)
+            rec = self._register_running_locked(info, future)
+            self._spawn_worker_locked(runner, future, rec)
+
+    def _spawn_worker_locked(self, runner, future: Future,
+                             rec: dict) -> None:
+        thread = threading.Thread(
+            target=self._run_dispatched, args=(runner, future, rec),
+            name=f"lo-job-{rec['name']}", daemon=True,
+        )
+        self._threads.add(thread)
+        thread.start()
+
+    def _register_running_locked(self, info: dict, future: Future) -> dict:
+        """Running-job record the deadline watchdog scans; caller
+        holds the lock and has already charged ``_inflight``."""
+        rec = {
+            "name": info["name"],
+            "future": future,
+            "deadline": info["deadline"],
+            "job_class": info["job_class"],
+            "ctl": info["ctl"],
+            "t0": time.monotonic(),
+            "released": False,
+        }
+        self._running_recs[info["name"]] = rec
+        if rec["deadline"] and rec["deadline"] > 0:
+            self._ensure_watchdog_locked()
+        return rec
 
     def _pick_locked(self):
         """Next queued job under weighted round-robin.
@@ -448,18 +635,124 @@ class JobEngine:
             self._rr_idx += 1
         return None
 
-    def _run_dispatched(self, runner, future: Future) -> None:
+    def _run_dispatched(self, runner, future: Future, rec: dict) -> None:
         try:
             result = runner()
         except BaseException as exc:  # pragma: no cover — run() is
             # exception-safe by construction; never leak a worker.
-            future.set_exception(exc)
+            try:
+                future.set_exception(exc)
+            except InvalidStateError:
+                pass  # deadline watchdog resolved the future first
         else:
-            future.set_result(result)
+            try:
+                future.set_result(result)
+            except InvalidStateError:
+                pass
         finally:
             with self._lock:
-                self._inflight -= 1
-                self._dispatch_locked()
+                if self._running_recs.get(rec["name"]) is rec:
+                    del self._running_recs[rec["name"]]
+                if not rec["released"]:
+                    # An expired job's worker was already released by
+                    # the watchdog — the zombie's return must not
+                    # double-credit the pool.
+                    rec["released"] = True
+                    self._inflight -= 1
+                    self._dispatch_locked()
+                self._threads.discard(threading.current_thread())
+
+    # -- deadline watchdog ----------------------------------------------------
+
+    def _ensure_watchdog_locked(self) -> None:
+        """Start the watchdog lazily — engines that never see a
+        deadline'd job never grow the thread."""
+        if self._shutdown:
+            return  # nothing to enforce; don't unclear the wake event
+        if self._watchdog is None or not self._watchdog.is_alive():
+            self._watchdog_wake.clear()
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop,
+                name="lo-job-watchdog", daemon=True,
+            )
+            self._watchdog.start()
+
+    def _watchdog_loop(self) -> None:
+        while True:
+            self._watchdog_wake.wait(self.WATCHDOG_INTERVAL_S)
+            expired: list[tuple[str, dict]] = []
+            with self._lock:
+                if self._shutdown:
+                    return
+                now = time.monotonic()
+                armed = 0
+                for name, rec in list(self._running_recs.items()):
+                    deadline = rec["deadline"]
+                    if (
+                        not deadline or deadline <= 0
+                        or rec["released"]
+                    ):
+                        continue
+                    if now - rec["t0"] > deadline:
+                        # Reclaim the worker NOW: the hung body keeps
+                        # its thread (unkillable), but stops counting
+                        # against max_workers so queued work
+                        # dispatches.
+                        rec["released"] = True
+                        rec["ctl"]["expired"] = True
+                        del self._running_recs[name]
+                        self._inflight -= 1
+                        expired.append((name, rec))
+                    else:
+                        armed += 1
+                if expired:
+                    self._dispatch_locked()
+                if not armed and not expired:
+                    # Nothing left to watch: exit rather than poll a
+                    # long-lived idle process forever.  Cleared under
+                    # the lock so _ensure_watchdog_locked restarts a
+                    # fresh thread for the next deadline'd dispatch.
+                    self._watchdog = None
+                    return
+            for name, rec in expired:
+                self._expire_job(name, rec)
+
+    def _expire_job(self, name: str, rec: dict) -> None:
+        """Terminal bookkeeping for a job the watchdog timed out —
+        runs OUTSIDE the engine lock (store writes, webhooks)."""
+        deadline = rec["deadline"]
+        err = (
+            f"job exceeded its {deadline:g}s deadline; the watchdog "
+            "failed it and reclaimed its worker and chip leases (the "
+            "body finishes as an abandoned zombie)"
+        )
+        logger.error(kv(job=name, state="deadline",
+                        deadlineS=deadline))
+        _, jobs_total = _job_metrics()
+        jobs_total.inc(job_class=rec["job_class"], state="deadline")
+        try:
+            self.artifacts.metadata.mark_failed(name, err)
+        except Exception:  # noqa: BLE001 — the watchdog must survive
+            pass
+        try:
+            self.artifacts.ledger.record(
+                name, state="deadline", exception=err,
+            )
+        except Exception:  # noqa: BLE001
+            pass
+        if self.leaser is not None:
+            try:
+                freed = self.leaser.revoke(name)
+                if freed:
+                    logger.warning(kv(job=name, event="lease_revoked",
+                                      devices=freed))
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            rec["future"].set_exception(JobDeadlineExceeded(err))
+        except InvalidStateError:
+            pass
+        self._notify(name, "failed")
 
     # Cap retained completed futures/tracebacks so a long-lived API process
     # doesn't accumulate every past job's result object.
@@ -533,20 +826,32 @@ class JobEngine:
     def shutdown(self, wait: bool = True) -> None:
         with self._lock:
             self._shutdown = True
-            # Flush every still-queued job into the executor in fair
-            # order before shutting it down: the executor's worker
-            # count still caps concurrency, and shutdown(wait=True)
-            # must run every accepted job — exactly the pre-fairness
-            # contract, where submit() handed jobs straight to the
-            # pool.  Without this, jobs queued above max_workers would
+            self._watchdog_wake.set()
+            # Still-queued jobs keep dispatching as workers free (each
+            # completion re-enters _dispatch_locked), capped at
+            # max_workers throughout — shutdown(wait=True) must run
+            # every accepted job, exactly the pre-fairness contract.
+            # Without the kick, jobs queued behind idle workers would
             # be orphaned with their metadata stuck at "pending".
-            while True:
-                item = self._pick_locked()
-                if item is None:
-                    break
-                runner, future = item
-                if not future.set_running_or_notify_cancel():
-                    continue
-                self._inflight += 1
-                self.pool.submit(self._run_dispatched, runner, future)
-        self.pool.shutdown(wait=wait)
+            # (Deadlines stop being enforced here — the watchdog is
+            # exiting and shutdown(wait=True) waits for every body,
+            # zombies included, anyway.)
+            self._dispatch_locked()
+        if not wait:
+            return
+        while True:
+            with self._lock:
+                thread = next(iter(self._threads), None)
+                drained = (
+                    thread is None
+                    and not any(self._queues.values())
+                    and self._inflight == 0
+                )
+            if drained:
+                return
+            if thread is None:
+                # Transient gap between a worker freeing and the next
+                # queued job's thread appearing.
+                time.sleep(0.005)
+                continue
+            thread.join()

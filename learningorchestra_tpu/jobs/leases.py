@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Sequence
 
+from learningorchestra_tpu import faults
 from learningorchestra_tpu.log import get_logger, kv
 from learningorchestra_tpu.obs import tracing
 
@@ -83,6 +84,11 @@ class DeviceLeaser:
         import collections
 
         self.history: collections.deque = collections.deque(maxlen=1024)
+        # Live leases, for the deadline watchdog's revoke path: each
+        # record is {label, devices, revoked} — ``revoked`` devices
+        # were force-returned to the pool and must NOT be re-freed
+        # when the (possibly zombie) holder's with-block finally runs.
+        self._active: list[dict] = []
 
     def _ensure_devices(self) -> None:
         if self._free is not None:
@@ -141,6 +147,10 @@ class DeviceLeaser:
         placement-timeout semantics).
         """
         t_req = time.monotonic()
+        # Chaos probe: an armed schedule can delay every lease request
+        # (contention drills) or fail it outright — the injected error
+        # flows to the job body exactly as a real placement failure.
+        faults.hit("lease.acquire")
         with self._cv:
             self._ensure_devices()
             if not self._all:
@@ -166,7 +176,11 @@ class DeviceLeaser:
                     self._cv.wait(remaining)
                 taken = [self._free.pop() for _ in range(want)]
         t0 = time.monotonic()
+        rec = {"label": label, "devices": list(taken),
+               "revoked": set()}
         if taken:
+            with self._cv:
+                self._active.append(rec)
             wait_hist, hold_hist, leases_total = _lease_metrics()
             wait_hist.observe(t0 - t_req)
             leases_total.inc()
@@ -187,8 +201,18 @@ class DeviceLeaser:
             t1 = time.monotonic()
             with self._cv:
                 for dev in taken:
+                    if dev in rec["revoked"]:
+                        # The deadline watchdog already returned this
+                        # device to the pool; re-freeing it here would
+                        # double-count it.
+                        continue
                     self._free.append(dev)
                     self.history.append((label, dev, t0, t1))
+                if taken:
+                    try:
+                        self._active.remove(rec)
+                    except ValueError:
+                        pass
                 self._cv.notify_all()
             if taken:
                 hold_hist.observe(t1 - t0)
@@ -196,6 +220,38 @@ class DeviceLeaser:
                     event="release", job=label, devices=taken,
                     held=f"{t1 - t0:.2f}s",
                 ))
+
+    def revoke(self, label: str) -> list[str]:
+        """Force-release every device held by leases labelled
+        ``label`` or ``label:*`` (a tune job's trials lease as
+        ``<job>:trial``) — the deadline watchdog's reclaim path.
+
+        The holder's thread may still be RUNNING device work; on real
+        hardware the next lessee contends with the zombie until it
+        dies.  That is the honest limit of a thread model (the
+        reference's running job dies only with its container) — the
+        deadline's guarantee is that the SCHEDULER stops waiting, not
+        that the computation stops.
+        """
+        freed: list[str] = []
+        t1 = time.monotonic()
+        with self._cv:
+            for rec in self._active:
+                if rec["label"] != label and not \
+                        rec["label"].startswith(label + ":"):
+                    continue
+                for dev in rec["devices"]:
+                    if dev in rec["revoked"]:
+                        continue
+                    rec["revoked"].add(dev)
+                    self._free.append(dev)
+                    self.history.append((rec["label"], dev, t1, t1))
+                    freed.append(dev)
+            if freed:
+                self._cv.notify_all()
+        if freed:
+            logger.warning(kv(event="revoke", job=label, devices=freed))
+        return freed
 
 
 def jax_device_for(device_id: str):

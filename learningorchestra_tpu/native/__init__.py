@@ -21,6 +21,7 @@ import threading
 from pathlib import Path
 from typing import Any, Iterable
 
+from learningorchestra_tpu import faults
 from learningorchestra_tpu.store.document_store import (
     DuplicateKey,
     NoSuchCollection,
@@ -245,8 +246,14 @@ class NativeDocumentStore:
         return self._lib.lods_drop(self._h, name.encode()) == 1
 
     # -- writes -------------------------------------------------------------
+    # Every write entry point carries the same chaos probe as the
+    # Python backend's WAL append (document_store.py _append): an
+    # armed ``store.wal_write`` schedule must fire no matter which
+    # backend the deployment resolved — a probe that exists on only
+    # one backend would fake a green drill on the other.
 
     def insert_one(self, name: str, doc: dict, _id: int | None = None) -> int:
+        faults.hit("store.wal_write")
         if _id is None:
             first = ctypes.c_longlong()
             payload = _dumps(doc) + b"\n"
@@ -265,6 +272,7 @@ class NativeDocumentStore:
         return _id
 
     def insert_unique(self, name: str, doc: dict, _id: int) -> int:
+        faults.hit("store.wal_write")
         rc = self._lib.lods_insert_at(
             self._h, name.encode(), _dumps(doc), _id, 1
         )
@@ -286,6 +294,7 @@ class NativeDocumentStore:
         makes CSV ingest bypass Python object materialisation entirely
         (the reference's per-row hot loop, database_api_image/
         database.py:139-151)."""
+        faults.hit("store.wal_write")
         first = ctypes.c_longlong()
         n = self._lib.lods_insert_many(
             self._h, name.encode(), jsonl, len(jsonl), ctypes.byref(first)
@@ -295,6 +304,7 @@ class NativeDocumentStore:
         return int(n)
 
     def update_one(self, name: str, _id: int, fields: dict) -> bool:
+        faults.hit("store.wal_write")
         rc = self._lib.lods_update(
             self._h, name.encode(), _id, _dumps(fields)
         )
@@ -303,6 +313,7 @@ class NativeDocumentStore:
         return rc == 1
 
     def delete_one(self, name: str, _id: int) -> bool:
+        faults.hit("store.wal_write")
         rc = self._lib.lods_delete(self._h, name.encode(), _id)
         if rc < 0:
             raise NoSuchCollection(name)

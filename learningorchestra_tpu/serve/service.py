@@ -122,8 +122,14 @@ class ServingService:
         import jax
         import jax.numpy as jnp
 
+        from learningorchestra_tpu import faults
         from learningorchestra_tpu.train import compile_cache as cc
 
+        # Chaos probe at the batch boundary: one injected failure
+        # fails every request coalesced into THIS dispatch (the real
+        # blast radius of a device fault mid-batch), leaving the
+        # batcher worker and later dispatches healthy.
+        faults.hit("serve.apply")
         entry = self.registry.get(name)
         apply = entry.apply_fns.get(padded.shape[0])
         if apply is None:

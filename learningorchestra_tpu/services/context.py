@@ -53,7 +53,13 @@ class ServiceContext:
         self.engine = JobEngine(
             self.artifacts,
             max_workers=self.config.jobs.max_workers,
+            max_preemption_retries=(
+                self.config.jobs.max_preemption_retries
+            ),
             class_weights=self.config.jobs.class_weights,
+            retry_backoff_s=self.config.jobs.retry_backoff_s,
+            retry_backoff_max_s=self.config.jobs.retry_backoff_max_s,
+            deadline_s=self.config.jobs.deadline_s,
         )
         self.loader = StoreLoader(self)
         from learningorchestra_tpu.services.webhooks import (
@@ -68,7 +74,10 @@ class ServiceContext:
 
         # Per-job accelerator placement (jobs/leases.py): concurrent
         # neural jobs serialize per chip instead of contending for HBM.
+        # The engine's deadline watchdog revokes an expired job's
+        # leases through the same pool.
         self.leaser = DeviceLeaser()
+        self.engine.leaser = self.leaser
         # When the compiled-program cache clears on a device-set change
         # (TPU restart / tunnel reattach), the engine's warm-start
         # hints are stale — 'warm' jobs would trace like any other.

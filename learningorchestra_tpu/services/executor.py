@@ -100,6 +100,7 @@ class ExecutorService:
         method_parameters: dict | None = None,
         artifact_type: str = "train/tensorflow",
         description: str = "",
+        deadline_s: float | None = None,
     ) -> dict:
         parent_meta, model_meta = self._validate_request(
             name, parent_name, method, method_parameters
@@ -116,6 +117,7 @@ class ExecutorService:
             name, parent_meta, method, method_parameters, artifact_type,
             description, resume_checkpoint=False,
             warm_key=_warm_key(model_meta, method),
+            deadline_s=deadline_s,
         )
         return meta
 
@@ -125,6 +127,7 @@ class ExecutorService:
         *,
         method_parameters: dict | None = None,
         description: str = "",
+        deadline_s: float | None = None,
     ) -> dict:
         """PATCH re-run with new parameters (reference:
         server.py:110-156).
@@ -152,17 +155,19 @@ class ExecutorService:
             name, parent_meta, meta.get("method"), method_parameters,
             meta.get("type"), description, resume_checkpoint=resume,
             warm_key=_warm_key(meta, meta.get("method")),
+            deadline_s=deadline_s,
         )
         return self.ctx.artifacts.metadata.read(name)
 
     def _submit(self, name, parent_meta, method, method_parameters,
                 artifact_type, description, *, resume_checkpoint=False,
-                warm_key=None):
+                warm_key=None, deadline_s=None):
         parent_name = parent_meta["name"]
         parent_type = parent_meta.get("type", "")
         kind = artifact_type.split("/", 1)[0]
 
         def run():
+            from learningorchestra_tpu.jobs import engine as engine_mod
             from learningorchestra_tpu.obs import tracing as obs_tracing
             from learningorchestra_tpu.train import compile_cache
 
@@ -172,6 +177,14 @@ class ExecutorService:
                     parent_type, parent_name
                 )
             params = dsl.resolve_params(method_parameters, self.ctx.loader)
+            # Which preemption-retry attempt is this body running as?
+            # 0 on the first execution; >0 means the engine's in-loop
+            # retry re-invoked us after a ``Preempted`` — resume from
+            # the managed checkpoints THIS run already wrote instead
+            # of restarting at epoch 0 (previously only a manual PATCH
+            # of a failed job got resume semantics).
+            attempt = engine_mod.current_attempt()
+            resume = resume_checkpoint or attempt > 0
             if (
                 kind in TRAIN_KINDS
                 and method == "fit"
@@ -183,12 +196,21 @@ class ExecutorService:
                 # of epoch 0 (train/checkpoint.py; the reference loses
                 # mid-job state entirely, SURVEY §5.4).  Fresh runs and
                 # param-changing re-runs of finished jobs must not
-                # resurrect old state, so their checkpoint dir is wiped.
+                # resurrect old state, so their checkpoint dir is wiped
+                # — but only on attempt 0: a retry's checkpoints are
+                # its own run's state, never stale.
                 ckdir = self.ctx.checkpoint_dir(name)
-                if not resume_checkpoint and ckdir.exists():
+                if not resume and ckdir.exists():
                     shutil.rmtree(ckdir, ignore_errors=True)
                 params["checkpoint_dir"] = str(ckdir)
-                params.setdefault("resume", resume_checkpoint)
+                params.setdefault("resume", resume)
+                if attempt > 0:
+                    # A caller-specified resume=False means "fresh
+                    # fit", which attempt 0 honored (the wipe above
+                    # didn't run on retries); resuming the SAME
+                    # logical run's checkpoints after preemption is
+                    # still that fresh fit, continued.
+                    params["resume"] = True
             t0 = time.perf_counter()
             if isinstance(instance, NeuralEstimator):
                 # On-device work: take a chip lease so concurrent
@@ -248,6 +270,7 @@ class ExecutorService:
             on_success=lambda extra: extra,
             job_class="executor",
             warm_key=warm_key,
+            deadline_s=deadline_s,
         )
 
     def _store_result_rows(self, name: str, result: Any) -> None:
@@ -281,6 +304,7 @@ class ExecutorService:
         scoring_parameters: dict | None = None,
         artifact_type: str = "tune/tensorflow",
         description: str = "",
+        deadline_s: float | None = None,
     ) -> dict:
         """Grid-search over ``param_grid`` (dict of lists).  Each candidate
         re-instantiates the model ancestor's class with those kwargs, fits
@@ -442,6 +466,7 @@ class ExecutorService:
             on_success=lambda extra: extra,
             job_class="executor",
             warm_key=warm_key,
+            deadline_s=deadline_s,
         )
         return meta
 

@@ -49,6 +49,7 @@ class FunctionService:
         function: str,
         function_parameters: dict | None = None,
         description: str = "",
+        deadline_s: float | None = None,
     ) -> dict:
         self.ctx.require_new_name(name)
         if not function or not isinstance(function, str):
@@ -56,7 +57,8 @@ class FunctionService:
         meta = self.ctx.artifacts.metadata.create(
             name, FUNCTION_TYPE, extra={"description": description}
         )
-        self._submit(name, function, function_parameters, description)
+        self._submit(name, function, function_parameters, description,
+                     deadline_s=deadline_s)
         return meta
 
     def update(
@@ -66,15 +68,18 @@ class FunctionService:
         function: str,
         function_parameters: dict | None = None,
         description: str = "",
+        deadline_s: float | None = None,
     ) -> dict:
         self.ctx.require_existing(name)
         if not function or not isinstance(function, str):
             raise ValidationError("missing 'function' code")
         self.ctx.artifacts.metadata.restart(name)
-        self._submit(name, function, function_parameters, description)
+        self._submit(name, function, function_parameters, description,
+                     deadline_s=deadline_s)
         return self.ctx.artifacts.metadata.read(name)
 
-    def _submit(self, name, function, function_parameters, description):
+    def _submit(self, name, function, function_parameters, description,
+                *, deadline_s=None):
         def run():
             code = _fetch_code(function)
             params = dsl.resolve_params(
@@ -104,10 +109,14 @@ class FunctionService:
             )
             return response
 
+        # Arbitrary code is the MOST hang-prone surface the system
+        # offers — the per-submit deadline matters here even more than
+        # on train jobs (None inherits the engine default).
         self.ctx.engine.submit(
             name, run, description=description or "python function",
             capture_stdout=False,
             job_class="function",
+            deadline_s=deadline_s,
         )
 
     def delete(self, name: str) -> None:

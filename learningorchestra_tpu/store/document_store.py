@@ -30,6 +30,8 @@ import threading
 from pathlib import Path
 from typing import Any, Iterable
 
+from learningorchestra_tpu import faults
+
 # Collection names become file names; keep them safe.
 _NAME_RE = re.compile(r"^[A-Za-z0-9_][A-Za-z0-9_.\-]*$")
 
@@ -170,6 +172,11 @@ class _Collection:
         self._fh = open(self.path, "a", encoding="utf-8")
 
     def _append(self, op: dict) -> None:
+        # Chaos probe BEFORE the write: an injected failure models a
+        # full/failing disk at the WAL boundary — the in-memory doc
+        # map may run ahead of the log (exactly what a real fsync
+        # failure produces), and recovery is replay-on-reopen.
+        faults.hit("store.wal_write")
         self._fh.write(json.dumps(op, default=str) + "\n")
         self._fh.flush()
         if self.durable:
@@ -279,6 +286,7 @@ class DocumentStore:
                 lines.append(json.dumps({"op": "i", "d": doc}, default=str))
                 n += 1
             if lines:
+                faults.hit("store.wal_write")  # batched-append boundary
                 coll._fh.write("\n".join(lines) + "\n")
                 coll._fh.flush()
                 if coll.durable:

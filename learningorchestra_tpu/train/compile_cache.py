@@ -69,6 +69,14 @@ __all__ = [
 ]
 
 
+def _faults():
+    """Lazy fault-plane handle: the cache is imported from low-level
+    train paths; keep its import graph flat."""
+    from learningorchestra_tpu import faults
+
+    return faults
+
+
 # -- canonical fingerprinting -------------------------------------------------
 
 
@@ -371,6 +379,7 @@ class CompiledProgramCache:
             with self._lock:
                 self.misses += 1
             t0 = time.perf_counter()
+            _faults().hit("compile.build")
             value = builder()
             _record_compile_span(
                 time.perf_counter() - t0, label, key
@@ -402,6 +411,10 @@ class CompiledProgramCache:
                     return self._entries[key].value
         t0 = time.perf_counter()
         try:
+            # Chaos probe on the MISS path only: cache hits must stay
+            # untouched (a compile fault models tracing/XLA failure,
+            # which by definition happens when a program builds).
+            _faults().hit("compile.build")
             value = builder()
         except BaseException:
             with self._lock:

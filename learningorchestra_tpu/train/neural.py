@@ -41,6 +41,13 @@ def _train_logger():
     return get_logger("train")
 
 
+def _faults():
+    """Lazy fault-plane handle (the ``train.epoch`` chaos probe)."""
+    from learningorchestra_tpu import faults
+
+    return faults
+
+
 def _spec_get(spec: dict, snake: str, default=None, *, required=False):
     """Read a spec key in snake_case OR camelCase — REST bodies use
     camelCase (vocabSize, maxLen) while Python callers write snake."""
@@ -1056,6 +1063,11 @@ class NeuralEstimator(Estimator):
         try:
             for epoch_i in range(start_epoch, epochs):
                 t0 = time.perf_counter()
+                # Chaos probe per epoch: an armed ``preempt`` schedule
+                # models the real TPU event — mid-fit, after some
+                # checkpoints committed — so the engine-retry →
+                # checkpoint-resume path is provable end to end.
+                _faults().hit("train.epoch")
                 params, opt_state, metrics = self._device_epoch(
                     params, opt_state, xs, ys,
                     jax.random.fold_in(root_key, epoch_i),
@@ -1265,6 +1277,7 @@ class NeuralEstimator(Estimator):
             ) as io:
                 for epoch_i in range(start_epoch, epochs):
                     t0 = time.perf_counter()
+                    _faults().hit("train.epoch")  # see in-memory loop
                     # Seeded per (seed, epoch), NOT once per fit: a
                     # checkpoint-resumed epoch 6 must walk the same shard
                     # order the uninterrupted run would have (and the
