@@ -805,6 +805,111 @@ def _faults_probe() -> dict:
     }
 
 
+def _fleet_probe(
+    n_requests: int = 384,
+    concurrency: int = 16,
+    row_service_us: float = 500.0,
+) -> dict:
+    """Fleet-serving probe: router decision cost + 1→2 replica
+    throughput, both as tight-loop best-of numbers (the ROADMAP bench
+    caveat: this box's headline metric is noise-dominated; subsystem
+    probes are the durable evidence).
+
+    **Router overhead** — per-decision cost of ``P2CRouter.choose``
+    over a static depth snapshot, best of N loops.  The contract:
+    routing must be noise next to a batcher flush (µs against the
+    flush deadline's milliseconds), or the fleet taxes the
+    single-replica path it exists to relieve.
+
+    **Replica scaling A/B** — the same concurrent load driven through
+    a real ReplicaSet at 1 then 2 replicas, with a dispatch that
+    sleeps ``row_service_us`` per PADDED row.  The sleep stands in for
+    a throughput-saturated device: on this 2-core CPU box a
+    compute-bound dispatch would measure matmul core-sharing, not
+    replica-level scaling, while a device-bound per-row cost (the TPU
+    serving reality — the batcher worker blocks on the chip, and a
+    saturated chip's batch time scales with rows) overlaps across
+    replicas exactly as chips do.  A per-DISPATCH cost would be the
+    wrong model here: the coalescer absorbs concurrency into bigger
+    batches and one replica looks infinitely scalable.  Best-of
+    windows on both sides.
+    """
+    import threading
+
+    import numpy as np
+
+    from learningorchestra_tpu.config import ServeConfig
+    from learningorchestra_tpu.jobs.leases import DeviceLeaser
+    from learningorchestra_tpu.serve.fleet import P2CRouter, ReplicaSet
+
+    # -- router decision cost ------------------------------------------------
+    router = P2CRouter(seed=0)
+    depths = [3, 0, 5, 1]
+    best = float("inf")
+    for _ in range(7):
+        t0 = time.perf_counter()
+        for _ in range(20_000):
+            router.choose(depths)
+        best = min(best, (time.perf_counter() - t0) / 20_000)
+    decision_us = best * 1e6
+
+    # -- 1→2 replica throughput A/B ------------------------------------------
+    row = np.ones((1, 8), np.float32)
+
+    def run_fleet(n_replicas: int) -> float:
+        leaser = DeviceLeaser([f"probe:{i}" for i in range(n_replicas)])
+        rs = ReplicaSet(
+            "bench-fleet",
+            ServeConfig(max_batch=32, max_queue=1 << 14, flush_ms=0.5),
+            leaser,
+            lambda replica: (
+                lambda padded: (
+                    time.sleep(padded.shape[0] * row_service_us / 1e6),
+                    padded,
+                )[1]
+            ),
+            min_replicas=1,
+            max_replicas=n_replicas,
+        )
+        try:
+            rs.scale_to(n_replicas)
+            per_thread = max(1, n_requests // concurrency)
+
+            def worker():
+                for _ in range(per_thread):
+                    rs.submit(row)
+
+            rps = 0.0
+            for _ in range(3):
+                threads = [
+                    threading.Thread(target=worker)
+                    for _ in range(concurrency)
+                ]
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                rps = max(
+                    rps,
+                    per_thread * concurrency
+                    / (time.perf_counter() - t0),
+                )
+            return rps
+        finally:
+            rs.close()
+
+    rps_1 = run_fleet(1)
+    rps_2 = run_fleet(2)
+    return {
+        "router_decision_us": round(decision_us, 3),
+        "replicas1_rps": round(rps_1, 1),
+        "replicas2_rps": round(rps_2, 1),
+        "replica_scaling_speedup": round(rps_2 / rps_1, 2),
+        "row_service_us": row_service_us,
+    }
+
+
 def _cpu_reference_flops(duration_s: float = 2.0) -> float:
     """Dense f32 matmul FLOP/s this host sustains through the same
     jit pipeline — the box-speed denominator for the live fallback
@@ -960,6 +1065,10 @@ def _tpu_suite_child_main() -> None:
         suite["_faults"] = _faults_probe()
     except Exception as exc:  # noqa: BLE001 — record, don't hide
         suite["_faults"] = f"FAILED: {exc!r}"
+    try:
+        suite["_fleet"] = _fleet_probe()
+    except Exception as exc:  # noqa: BLE001 — record, don't hide
+        suite["_fleet"] = f"FAILED: {exc!r}"
     print(json.dumps(suite))
 
 
@@ -975,6 +1084,7 @@ def main() -> None:
         serving_probe = suite.pop("_serving", None)
         obs_probe = suite.pop("_obs", None)
         faults_probe = suite.pop("_faults", None)
+        fleet_probe = suite.pop("_fleet", None)
         throughput, extra = _assemble_tpu(suite)
         extra.update(flash)
         if cache_probe is not None:
@@ -985,6 +1095,8 @@ def main() -> None:
             extra["obs"] = obs_probe
         if faults_probe is not None:
             extra["faults"] = faults_probe
+        if fleet_probe is not None:
+            extra["fleet"] = fleet_probe
     else:
         _force_cpu()  # record a CPU number rather than hang the driver
         import jax
@@ -1016,6 +1128,10 @@ def main() -> None:
             extra["faults"] = _faults_probe()
         except Exception as exc:  # noqa: BLE001 — record, don't hide
             extra["faults"] = f"FAILED: {exc!r}"
+        try:
+            extra["fleet"] = _fleet_probe()
+        except Exception as exc:  # noqa: BLE001 — record, don't hide
+            extra["fleet"] = f"FAILED: {exc!r}"
 
     metric = f"mnist_cnn_train_samples_per_sec_per_chip_{platform}"
     prior = _prior_best(metric, allow_cross_backend=platform == "tpu")

@@ -42,6 +42,7 @@ from learningorchestra_tpu.services.context import (
     NotFoundError,
     ValidationError,
 )
+from learningorchestra_tpu.serve.batcher import QueueFull
 from learningorchestra_tpu.serve.registry import ServeError
 from learningorchestra_tpu.store.artifacts import DuplicateArtifact
 from learningorchestra_tpu.toolkit import registry
@@ -1123,8 +1124,6 @@ class APIServer:
         # request — coalesced with concurrent requests into a padded
         # shape bucket, run against device-resident params.
         def serve_predict(m, body, query):
-            from learningorchestra_tpu.serve import QueueFull
-
             instances = body.get("instances")
             if instances is None:
                 instances = body.get("x")
@@ -1168,6 +1167,67 @@ class APIServer:
                  "stats": self.serving.stats()},
             ),
         )
+
+        # ---- Fleet (multi-replica data plane, serve/fleet/) ----
+        # Registered BEFORE the per-model replica routes so the
+        # literal "fleet" path never parses as a model name.
+        add(
+            "GET", r"/serve/fleet",
+            lambda m, b, q: (200, self.serving.fleet.snapshot()),
+        )
+
+        def serve_replicas_get(m, body, query):
+            status = self.serving.fleet.status_for(m.group("name"))
+            if not status:
+                return 404, {
+                    "error": f"model {m.group('name')!r} has no "
+                    "replica set (POST bounds/count to create one)"
+                }
+            return 200, status
+
+        add("GET", rf"/serve/{NAME}/replicas", serve_replicas_get)
+
+        def serve_replicas_post(m, body, query):
+            """Create/resize a model's replica set: any of ``min``,
+            ``max`` (autoscaler bounds) and ``count`` (manual scale,
+            clamped to the bounds).  Leases chips per replica;
+            an exhausted pool surfaces as the LeaseTimeout 503."""
+            body = body or {}
+
+            def _int(key):
+                val = body.get(key)
+                if val is None:
+                    return None
+                try:
+                    return int(val)
+                except (TypeError, ValueError):
+                    raise ValidationError(
+                        f"{key!r} must be an integer, got {val!r}"
+                    ) from None
+
+            mn, mx, count = _int("min"), _int("max"), _int("count")
+            if mn is None and mx is None and count is None:
+                raise ValidationError(
+                    "body needs at least one of 'min', 'max', 'count'"
+                )
+            return 200, self.serving.fleet.configure(
+                m.group("name"), min_replicas=mn, max_replicas=mx,
+                count=count,
+            )
+
+        add("POST", rf"/serve/{NAME}/replicas", serve_replicas_post)
+
+        def serve_replicas_delete(m, body, query):
+            """Dissolve the model's fleet: drain replicas, release
+            chips, return to single-path serving (the model stays
+            loaded).  Idempotent."""
+            name = m.group("name")
+            return 200, {
+                "model": name,
+                "dissolved": self.serving.fleet.dissolve(name),
+            }
+
+        add("DELETE", rf"/serve/{NAME}/replicas", serve_replicas_delete)
 
         for service in ("tune", "train", "evaluate", "predict"):
             add("POST", rf"/{service}/{TOOL}", exec_create(service))
@@ -1658,6 +1718,14 @@ class APIServer:
                 "error": str(exc),
                 "retryAfter": self.config.serve.retry_after_s,
             }
+        except QueueFull as exc:
+            # Serving backpressure escaping ANY route (predict maps
+            # it locally; a replicas POST racing shutdown lands here):
+            # saturated/teardown, not broken — shed retriably.
+            return 429, {
+                "error": str(exc),
+                "retryAfter": self.config.serve.retry_after_s,
+            }
         except (json.JSONDecodeError, BadRequest) as exc:
             return 400, {"error": f"bad JSON: {exc}"
                          if isinstance(exc, json.JSONDecodeError)
@@ -1850,6 +1918,59 @@ class APIServer:
         for q, val in agg["quantiles"].items():
             slat.sample(val, quantile=q)
         fams.append(slat)
+
+        # -- fleet: per-replica attribution.  Cardinality is bounded
+        # by construction (models <= registry max_models, replicas <=
+        # the per-model max bound, and replica indices are REUSED
+        # lowest-free-first so scale oscillation cycles a fixed label
+        # set instead of minting new ones), so these stay inside the
+        # LO_TPU_OBS_MAX_SERIES budget without collapsing. -----------
+        fleet = self.serving.fleet.snapshot()
+        if fleet["models"]:
+            nrepl = Family(
+                "gauge", "lo_serving_replicas",
+                "Active replicas per fleet-served model.",
+            )
+            rdepth = Family(
+                "gauge", "lo_serving_replica_queue_depth",
+                "Rows queued per replica batcher.",
+            )
+            rreq = Family(
+                "counter", "lo_serving_replica_requests_total",
+                "Requests routed per replica.",
+            )
+            for model, st in fleet["models"].items():
+                nrepl.sample(st["size"], model=model)
+                for r in st["replicas"]:
+                    labels = {
+                        "model": model,
+                        "replica": str(r["replica"]),
+                        "device": r["device"],
+                    }
+                    rdepth.sample(r["queueDepth"], **labels)
+                    rreq.sample(r["requests"], **labels)
+            fams += [nrepl, rdepth, rreq]
+        if fleet["scaleTotals"]:
+            # From the manager's CUMULATIVE totals, not the live sets:
+            # a counter series must survive dissolve/invalidation
+            # instead of vanishing or resetting mid-series.
+            scale = Family(
+                "counter", "lo_serving_fleet_scale_events_total",
+                "Replica scale events per model and direction.",
+            )
+            for model, t in fleet["scaleTotals"].items():
+                scale.sample(t["up"], model=model, direction="up")
+                scale.sample(t["down"], model=model, direction="down")
+            fams.append(scale)
+        # Emitted even with no replica sets: the control loop keeps
+        # ticking while fleets are drained away, and a counter that
+        # vanishes mid-series breaks rate()/absence liveness alerts.
+        fams.append(
+            Family(
+                "counter", "lo_serving_fleet_autoscaler_ticks_total",
+                "Autoscaler control-loop passes.",
+            ).sample(fleet["autoscaler"]["ticks"])
+        )
 
         # -- store WALs + replication ---------------------------------
         root = self.config.store.store_path()

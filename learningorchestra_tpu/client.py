@@ -795,6 +795,47 @@ class _Serve:
             "GET", "/monitoring/tensorflow/serving"
         )
 
+    # -- fleet (multi-replica data plane + autoscaler) ------------------
+
+    def replicas(self, model: str) -> dict:
+        """GET /serve/<model>/replicas — the model's replica set:
+        per-replica device, queue depth, request counts, plus the
+        min/max autoscaler bounds; 404 until a set exists."""
+        return self.ctx.request("GET", f"/serve/{model}/replicas")
+
+    def scale(self, model: str, *, count: int | None = None,
+              min_replicas: int | None = None,
+              max_replicas: int | None = None) -> dict:
+        """POST /serve/<model>/replicas — create/resize the model's
+        replica set: ``min``/``max`` set the autoscaler bounds,
+        ``count`` scales manually (clamped to the bounds).  Each
+        replica pins a chip through the lease pool; an exhausted pool
+        surfaces as 503 + Retry-After."""
+        body: dict = {}
+        if count is not None:
+            body["count"] = count
+        if min_replicas is not None:
+            body["min"] = min_replicas
+        if max_replicas is not None:
+            body["max"] = max_replicas
+        return self.ctx.request(
+            "POST", f"/serve/{model}/replicas", body
+        )
+
+    def dissolve(self, model: str) -> dict:
+        """DELETE /serve/<model>/replicas — drain the model's fleet
+        and return it to classic single-path serving (chips released,
+        model stays loaded; deployment-wide fleet defaults won't
+        re-fleet it)."""
+        return self.ctx.request(
+            "DELETE", f"/serve/{model}/replicas"
+        )
+
+    def fleet_status(self) -> dict:
+        """GET /serve/fleet — every replica set plus autoscaler state
+        (tick counts, per-model streaks, recent scale decisions)."""
+        return self.ctx.request("GET", "/serve/fleet")
+
 
 class _Observability:
     """The unified observability layer (server obs/): Prometheus text

@@ -150,6 +150,45 @@ class ServeConfig:
 
 
 @dataclasses.dataclass
+class FleetConfig:
+    """Fleet serving (serve/fleet/): multi-replica data plane over
+    leased chips with metrics-driven autoscaling.  Env knobs:
+    LO_TPU_FLEET_*.  Defaults keep the fleet OFF (max 1 replica —
+    classic single-batcher serving) until a deployment raises the
+    bounds globally or per model (POST /serve/<model>/replicas)."""
+
+    # Autoscaler control loop master switch (replica sets and manual
+    # scaling still work when off).  Env: LO_TPU_FLEET_ENABLED.
+    enabled: bool = True
+    # Deployment-wide default replica bounds per served model;
+    # max > 1 puts every served model on the fleet routing path.
+    # Env: LO_TPU_FLEET_MIN / LO_TPU_FLEET_MAX.
+    min_replicas: int = 1
+    max_replicas: int = 1
+    # Autoscaler tick interval; <= 0 disables the loop thread.
+    # Env: LO_TPU_FLEET_INTERVAL_S.
+    interval_s: float = 2.0
+    # Scale-up triggers: fleet queue depth as a fraction of total
+    # queue capacity sustained for up_ticks consecutive ticks, any
+    # shed (429) requests, or p99 latency above up_p99_ms (0 = off).
+    # Env: LO_TPU_FLEET_UP_QUEUE_FRAC / LO_TPU_FLEET_UP_TICKS /
+    # LO_TPU_FLEET_UP_P99_MS.
+    up_queue_frac: float = 0.25
+    up_ticks: int = 2
+    up_p99_ms: float = 0.0
+    # Scale-down after this many consecutive empty-queue ticks.
+    # Env: LO_TPU_FLEET_DOWN_TICKS.
+    down_ticks: int = 5
+    # Chip-lease budget when placing a new replica; on timeout the
+    # scale-up is skipped and retried next tick.
+    # Env: LO_TPU_FLEET_LEASE_TIMEOUT_S.
+    lease_timeout_s: float = 5.0
+    # Router RNG seed (P2C is seeded-deterministic, like the fault
+    # plane's schedules).
+    router_seed: int = 0
+
+
+@dataclasses.dataclass
 class ObsConfig:
     """Unified observability layer (obs/): metrics registry +
     Prometheus exposition at GET /metrics.prom + end-to-end job trace
@@ -295,6 +334,7 @@ class Config:
         default_factory=CompileCacheConfig
     )
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+    fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     dist: DistributedConfig = dataclasses.field(
@@ -391,6 +431,37 @@ class Config:
                 "(use 1/0, true/false, yes/no, on/off)"
             )
 
+        if "LO_TPU_FLEET_ENABLED" in env:
+            cfg.fleet.enabled = _bool_env("LO_TPU_FLEET_ENABLED")
+        if "LO_TPU_FLEET_MIN" in env:
+            cfg.fleet.min_replicas = int(env["LO_TPU_FLEET_MIN"])
+        if "LO_TPU_FLEET_MAX" in env:
+            cfg.fleet.max_replicas = int(env["LO_TPU_FLEET_MAX"])
+        if "LO_TPU_FLEET_INTERVAL_S" in env:
+            cfg.fleet.interval_s = float(env["LO_TPU_FLEET_INTERVAL_S"])
+        if "LO_TPU_FLEET_UP_QUEUE_FRAC" in env:
+            cfg.fleet.up_queue_frac = float(
+                env["LO_TPU_FLEET_UP_QUEUE_FRAC"]
+            )
+        if "LO_TPU_FLEET_UP_TICKS" in env:
+            cfg.fleet.up_ticks = int(env["LO_TPU_FLEET_UP_TICKS"])
+        if "LO_TPU_FLEET_DOWN_TICKS" in env:
+            cfg.fleet.down_ticks = int(env["LO_TPU_FLEET_DOWN_TICKS"])
+        if "LO_TPU_FLEET_UP_P99_MS" in env:
+            cfg.fleet.up_p99_ms = float(env["LO_TPU_FLEET_UP_P99_MS"])
+        if "LO_TPU_FLEET_LEASE_TIMEOUT_S" in env:
+            cfg.fleet.lease_timeout_s = float(
+                env["LO_TPU_FLEET_LEASE_TIMEOUT_S"]
+            )
+        if not 1 <= cfg.fleet.min_replicas <= cfg.fleet.max_replicas:
+            # Loud at BOOT, like the boolean knobs: deferred, these
+            # bounds first fail inside a predict's lazy ReplicaSet
+            # construction — an env typo becoming per-request 500s.
+            raise ValueError(
+                "fleet replica bounds need 1 <= LO_TPU_FLEET_MIN "
+                f"({cfg.fleet.min_replicas}) <= LO_TPU_FLEET_MAX "
+                f"({cfg.fleet.max_replicas})"
+            )
         if "LO_TPU_OBS_ENABLED" in env:
             cfg.obs.enabled = _bool_env("LO_TPU_OBS_ENABLED")
         if "LO_TPU_OBS_TRACE" in env:

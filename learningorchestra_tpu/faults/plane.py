@@ -21,6 +21,7 @@ point                 call site
 ``compile.build``     train/compile_cache.py — before a miss traces/compiles
 ``store.wal_write``   store/document_store.py — before every WAL append
 ``serve.apply``       serve/service.py — before a coalesced batch dispatch
+``serve.route``       serve/fleet/router.py — every fleet routing decision
 ``http.handler``      api/server.py — before every admitted route handler
 ``train.epoch``       train/neural.py — top of every fit epoch
 ====================  =======================================================
@@ -89,6 +90,7 @@ POINTS = (
     "compile.build",
     "store.wal_write",
     "serve.apply",
+    "serve.route",
     "http.handler",
     "train.epoch",
 )

@@ -221,6 +221,34 @@ class DeviceLeaser:
                     held=f"{t1 - t0:.2f}s",
                 ))
 
+    def acquire(
+        self,
+        n_devices: int = 1,
+        *,
+        label: str = "",
+        timeout: float | None = None,
+    ) -> "LeaseHandle":
+        """Non-context lease for LONG-LIVED holders — a serving-fleet
+        replica keeps its chip for the replica's lifetime, which has no
+        with-block: the acquiring thread (a REST handler or the
+        autoscaler's first scale-up) is never the releasing thread (the
+        autoscaler's scale-down, or service shutdown).
+
+        Returns a :class:`LeaseHandle`; call ``release()`` exactly once
+        (idempotent).  Same blocking/timeout semantics as
+        :meth:`lease`.  The with-block's trace span is suppressed: a
+        span opened in the acquiring thread could not legally close in
+        the releasing one (contextvar tokens are thread-bound), and a
+        replica's multi-hour hold is lease-history/metrics material,
+        not a job-trace interval.
+        """
+        from learningorchestra_tpu.obs import tracing
+
+        cm = self.lease(n_devices, label=label, timeout=timeout)
+        with tracing.activate(None):
+            devices = cm.__enter__()
+        return LeaseHandle(cm, list(devices))
+
     def revoke(self, label: str) -> list[str]:
         """Force-release every device held by leases labelled
         ``label`` or ``label:*`` (a tune job's trials lease as
@@ -252,6 +280,35 @@ class DeviceLeaser:
         if freed:
             logger.warning(kv(event="revoke", job=label, devices=freed))
         return freed
+
+
+class LeaseHandle:
+    """A held lease detached from its with-block (see
+    :meth:`DeviceLeaser.acquire`).  ``devices`` is the granted id list
+    (empty on CPU-only backends).  ``release()`` is idempotent and may
+    run on any thread."""
+
+    __slots__ = ("devices", "_cm", "_lock", "_released")
+
+    def __init__(self, cm, devices: list[str]):
+        self._cm = cm
+        self.devices = devices
+        self._lock = threading.Lock()
+        self._released = False
+
+    def release(self) -> None:
+        from learningorchestra_tpu.obs import tracing
+
+        with self._lock:
+            if self._released:
+                return
+            self._released = True
+        # Resume the suspended lease generator with no active trace:
+        # its span fast-path must stay the no-token branch it took at
+        # acquire time (a different thread cannot reset another
+        # thread's contextvar token).
+        with tracing.activate(None):
+            self._cm.__exit__(None, None, None)
 
 
 def jax_device_for(device_id: str):

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from learningorchestra_tpu.obs import tracing as obs_tracing
 from learningorchestra_tpu.parallel.mesh import MeshSpec, build_mesh
 from learningorchestra_tpu.parallel.sharding import param_shardings
 from learningorchestra_tpu.toolkit.base import as_array
@@ -427,6 +428,16 @@ class DistributedTrainer:
                             }
                         )
                     self.history.append(metrics)
+                    # Trace span per epoch (step + metric transfer +
+                    # validation), same contract as the single-device
+                    # fit: the job's span tree shows where the
+                    # distributed fit's time went, not one opaque
+                    # trainer_fit interval.  Single contextvar read
+                    # when no trace is active.
+                    obs_tracing.record_span(
+                        "epoch", time.perf_counter() - t0,
+                        epoch=epoch_i, distributed=True,
+                    )
                     # Callbacks run before the checkpoint decision so an
                     # early stop still gets its "final epoch" save —
                     # through the ONE shared policy (should_save).
@@ -637,6 +648,13 @@ class DistributedTrainer:
                                 ).items()
                             })
                         self.history.append(metrics)
+                        # Same per-epoch span as the in-memory loop;
+                        # ``streaming`` marks the sharded-dataset path.
+                        obs_tracing.record_span(
+                            "epoch", time.perf_counter() - t0,
+                            epoch=epoch_i, distributed=True,
+                            streaming=True,
+                        )
                         from learningorchestra_tpu.train import (
                             checkpoint as ckpt,
                         )

@@ -16,7 +16,10 @@ the framework into an inference server:
   flush-deadline, whichever first), with a bounded queue for
   backpressure and latency/occupancy stats;
 - :mod:`service` — ``ServingService``: the REST-facing facade
-  (load/unload/list/predict + observability).
+  (load/unload/list/predict + observability);
+- :mod:`fleet` — the multi-replica data plane: per-replica chip leases
+  + MicroBatchers, power-of-two-choices routing on live queue depth,
+  and the metrics-driven autoscaler (``LO_TPU_FLEET_*``).
 
 Sizing knobs live in config.py (``LO_TPU_SERVE_*``).
 """
@@ -27,13 +30,23 @@ from learningorchestra_tpu.serve.bucketing import (
     bucket_sizes,
     pad_rows,
 )
+from learningorchestra_tpu.serve.fleet import (
+    Autoscaler,
+    FleetManager,
+    P2CRouter,
+    ReplicaSet,
+)
 from learningorchestra_tpu.serve.registry import ModelRegistry
 from learningorchestra_tpu.serve.service import ServingService
 
 __all__ = [
+    "Autoscaler",
+    "FleetManager",
     "MicroBatcher",
     "ModelRegistry",
+    "P2CRouter",
     "QueueFull",
+    "ReplicaSet",
     "ServingService",
     "bucket_for",
     "bucket_sizes",

@@ -117,10 +117,20 @@ class MicroBatcher:
         x = np.asarray(x)
         if x.ndim == 0 or x.shape[0] == 0:
             raise ValueError("submit needs at least one row")
-        pendings = [
-            self._enqueue(x[i:i + self.max_batch])
-            for i in range(0, x.shape[0], self.max_batch)
-        ]
+        pendings: list[_Pending] = []
+        try:
+            for i in range(0, x.shape[0], self.max_batch):
+                pendings.append(self._enqueue(x[i:i + self.max_batch]))
+        except QueueFull as exc:
+            if pendings:
+                # Earlier chunks already queued and WILL dispatch
+                # (results abandoned).  The flag tells a routing
+                # layer not to replay the whole request elsewhere —
+                # that would duplicate this batcher's device work
+                # under exactly the saturation that caused the
+                # overflow.
+                exc.partial = True
+            raise
         outs = []
         for p in pendings:
             p.event.wait()
@@ -220,6 +230,15 @@ class MicroBatcher:
 
     # -- observability / lifecycle -------------------------------------------
 
+    @property
+    def queue_depth(self) -> int:
+        """Racy snapshot of queued rows — the fleet router's live load
+        signal, read per routing decision.  A plain int read under the
+        GIL: balancing needs freshness, not exactness, and taking the
+        condition lock here would serialize every router pick against
+        every submit."""
+        return self._rows_queued
+
     def stats(self) -> dict:
         with self._cond:
             lat = sorted(self._latencies)
@@ -253,11 +272,23 @@ class MicroBatcher:
                 },
             }
 
-    def close(self) -> None:
-        """Stop accepting work, flush what's queued, join the worker."""
+    def close(self, join: bool = True) -> None:
+        """Stop accepting work, flush what's queued, join the worker.
+        ``join=False`` only signals — callers closing MANY batchers
+        (fleet teardown) signal them all first so the drains overlap,
+        then wait via :meth:`wait_drained`."""
         with self._cond:
             if self._closed:
                 return
             self._closed = True
             self._cond.notify_all()
-        self._worker.join(timeout=30)
+        if join:
+            self._worker.join(timeout=30)
+
+    def wait_drained(self, timeout: float | None = None) -> bool:
+        """True once the worker thread has actually exited (close()'s
+        join can time out behind a slow backlog).  The fleet's
+        drain-before-lease-return gate: a chip must not go back to the
+        pool while this batcher could still be dispatching on it."""
+        self._worker.join(timeout)
+        return not self._worker.is_alive()

@@ -1,0 +1,39 @@
+"""Fleet serving — the multi-replica data plane over leased chips.
+
+Turns the one-device serving tier (serve/) fleet-shaped, the single
+biggest step toward the "millions of users" north star (ROADMAP item
+1): a model's resident params are replicated across chips acquired
+through the lease pool, traffic spreads with power-of-two-choices on
+live batcher queue depth, and a metrics-driven control loop turns
+sustained saturation into replicas instead of 429s.
+
+- :mod:`router` — ``P2CRouter``: seeded power-of-two-choices candidate
+  ranking (plus the ``serve.route`` chaos point);
+- :mod:`replicaset` — ``Replica``/``ReplicaSet``: per-replica chip
+  lease + MicroBatcher + device-placed params, drain-before-unload
+  scale-down, shared compile-cache executables (scaling adds zero
+  compile misses);
+- :mod:`autoscaler` — ``Autoscaler``: the control loop over the same
+  queue-depth/p99/shed/traffic signals ``/metrics.prom`` exports;
+- :mod:`manager` — ``FleetManager``: per-model sets + bounds + the
+  lazily-started autoscaler thread.
+
+Knobs live in config.py (``LO_TPU_FLEET_*``); REST surface is
+``GET/POST /serve/<model>/replicas`` and ``GET /serve/fleet``.
+"""
+
+from learningorchestra_tpu.serve.fleet.autoscaler import Autoscaler
+from learningorchestra_tpu.serve.fleet.manager import FleetManager
+from learningorchestra_tpu.serve.fleet.replicaset import (
+    Replica,
+    ReplicaSet,
+)
+from learningorchestra_tpu.serve.fleet.router import P2CRouter
+
+__all__ = [
+    "Autoscaler",
+    "FleetManager",
+    "P2CRouter",
+    "Replica",
+    "ReplicaSet",
+]

@@ -1,0 +1,229 @@
+"""Metrics-driven replica autoscaling — the 429's remediation path.
+
+The serving tier already EXPORTS the saturation story (batcher queue
+depth, p99 latency, request and 429 overflow counts — the
+``lo_serving_*`` families on ``/metrics.prom``); until now nothing
+consumed it.  This control loop reads those signals — straight from
+the batchers' own counters, the same source the exposition renders —
+and turns sustained pressure into replicas instead of refusals.
+(Batch occupancy stays an operator metric only: bucket padding keeps
+it near 1.0 even at trickle load, so it cannot separate busy from
+idle — see ``ReplicaSet.signals``.)  The decisions:
+
+- **scale up** when the fleet-wide queue fraction holds above
+  ``LO_TPU_FLEET_UP_QUEUE_FRAC`` for ``LO_TPU_FLEET_UP_TICKS``
+  consecutive ticks, when requests were SHED (any new 429 overflow is
+  by definition saturation), or — optionally — when p99 latency
+  crosses ``LO_TPU_FLEET_UP_P99_MS``;
+- **scale down** after ``LO_TPU_FLEET_DOWN_TICKS`` consecutive
+  empty-queue ticks, draining the victim's batcher before its chip
+  lease returns to the pool (training jobs queued on the leaser get
+  the chip back).
+
+Sustain counts (not instantaneous thresholds) are the hysteresis: one
+bursty tick must not thrash a replica up and down, and the counts make
+drills deterministic — k ticks of injected delay scale at exactly tick
+k.  Decisions are bounded per tick (±1 replica per model) so a signal
+spike converges gradually instead of slamming the lease pool.
+
+The loop is a daemon thread owned by the FleetManager, started only
+when some model can actually scale (max > 1) — a default deployment
+pays nothing.  ``tick()`` is public and thread-safe so tests drive the
+schedule deterministically without the thread.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+from learningorchestra_tpu.jobs.leases import LeaseTimeout
+from learningorchestra_tpu.log import get_logger, kv
+
+logger = get_logger("fleet")
+
+
+class Autoscaler:
+    """Per-tick scale decisions over a FleetManager's replica sets."""
+
+    def __init__(self, manager, cfg):
+        self._manager = manager
+        self.cfg = cfg
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        # model -> {"up": streak, "down": streak, "overflows": last}
+        self._state: dict[str, dict] = {}
+        self.ticks = 0
+        self.decisions: collections.deque = collections.deque(maxlen=64)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        with self._lock:
+            if self._thread is not None or self.cfg.interval_s <= 0:
+                return
+            self._thread = threading.Thread(
+                target=self._run, name="fleet-autoscaler", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=10)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.cfg.interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                # any one tick's failure; a dead autoscaler is a fleet
+                # silently frozen at its current size.
+                logger.exception("autoscaler tick failed")
+
+    # -- the control loop body -----------------------------------------------
+
+    def tick(self) -> list[dict]:
+        """One pass over every replica set; returns the decisions made
+        (also appended to the rolling ``decisions`` history)."""
+        made: list[dict] = []
+        with self._lock:
+            self.ticks += 1
+        for name, rs in self._manager.sets_snapshot():
+            sig = rs.signals()
+            with self._lock:
+                st = self._state.setdefault(
+                    name, {"up": 0, "down": 0,
+                           "sheds": sig["sheds"],
+                           "requests": sig["requests"]}
+                )
+                shed = sig["sheds"] - st["sheds"]
+                st["sheds"] = sig["sheds"]
+                served = sig["requests"] - st.get(
+                    "requests", sig["requests"]
+                )
+                st["requests"] = sig["requests"]
+                up_sig = (
+                    sig["queue_frac"] >= self.cfg.up_queue_frac
+                    or shed > 0
+                    # p99 comes from the batchers' rolling latency
+                    # window, which FREEZES when traffic stops — gate
+                    # it on traffic this tick, or a stale high p99
+                    # would hold an idle fleet at max forever.
+                    or (self.cfg.up_p99_ms > 0 and served > 0
+                        and sig["p99_ms"] >= self.cfg.up_p99_ms)
+                )
+                # "Idle" means NO traffic since the last tick, not an
+                # instantaneously empty queue: under steady load the
+                # batchers flush between ticks and queue_depth samples
+                # 0, and scaling down on that would drop a loaded
+                # fleet to min, shed 429s for an up-sustain window,
+                # scale back up, and oscillate.
+                down_sig = (
+                    sig["queue_depth"] == 0 and shed == 0
+                    and served == 0
+                )
+                n = sig["replicas"]
+                target, reason = n, ""
+                # A recent LeaseTimeout means the chip pool is
+                # saturated: skip further scale-UP attempts for this
+                # model until the block expires — each attempt costs
+                # a full lease_timeout_s inside the tick, and a tick
+                # wedged in doomed waits delays every OTHER model's
+                # decisions (including the scale-downs that would
+                # free the very chips being waited on).
+                blocked = time.monotonic() < st.get(
+                    "blocked_until", 0.0
+                )
+                if n < rs.min_replicas:
+                    # Below min (a partially-placed ensure whose later
+                    # leases timed out): heal toward min immediately —
+                    # no sustain window, this is repair, not reaction.
+                    if not blocked:
+                        target, reason = n + 1, "min"
+                elif up_sig and n < rs.max_replicas:
+                    st["down"] = 0
+                    st["up"] += 1
+                    if st["up"] >= self.cfg.up_ticks and not blocked:
+                        st["up"] = 0
+                        target = n + 1
+                        reason = (
+                            "shed" if shed > 0 else
+                            "queue" if sig["queue_frac"]
+                            >= self.cfg.up_queue_frac else "p99"
+                        )
+                elif down_sig and n > rs.min_replicas:
+                    st["up"] = 0
+                    st["down"] += 1
+                    if st["down"] >= self.cfg.down_ticks:
+                        st["down"] = 0
+                        target = n - 1
+                        reason = "idle"
+                else:
+                    st["up"] = st["up"] if up_sig else 0
+                    st["down"] = st["down"] if down_sig else 0
+            if target == n:
+                continue
+            try:
+                result = self._manager.scale(
+                    name, target, reason=f"auto:{reason}"
+                )
+            except LeaseTimeout:
+                # Chip pool saturated: note it and re-arm the streak so
+                # the next tick retries immediately instead of waiting
+                # out a fresh sustain window.  (.get: the model may
+                # have been dropped — forget() — while the lease
+                # attempt blocked.)
+                with self._lock:
+                    st = self._state.get(name)
+                    if st is not None:
+                        st["up"] = self.cfg.up_ticks
+                        st["blocked_until"] = (
+                            time.monotonic()
+                            + self.cfg.lease_timeout_s
+                        )
+                logger.warning(kv(
+                    event="scale_up_blocked", model=name,
+                    wanted=target, reason="lease_timeout",
+                ))
+                continue
+            decision = {
+                "t": time.time(),
+                "model": name,
+                "from": n,
+                "to": result,
+                "signal": reason,
+                "queueFrac": round(sig["queue_frac"], 4),
+                "shed": shed,
+                "p99Ms": sig["p99_ms"],
+            }
+            with self._lock:
+                self.decisions.append(decision)
+            made.append(decision)
+        return made
+
+    def forget(self, name: str) -> None:
+        """Drop a dissolved model's streak state (manager drop path)."""
+        with self._lock:
+            self._state.pop(name, None)
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "running": self._thread is not None
+                and self._thread.is_alive(),
+                "intervalS": self.cfg.interval_s,
+                "upQueueFrac": self.cfg.up_queue_frac,
+                "upTicks": self.cfg.up_ticks,
+                "downTicks": self.cfg.down_ticks,
+                "upP99Ms": self.cfg.up_p99_ms,
+                "ticks": self.ticks,
+                "streaks": {
+                    name: {"up": st["up"], "down": st["down"]}
+                    for name, st in self._state.items()
+                },
+                "decisions": list(self.decisions),
+            }

@@ -1,0 +1,62 @@
+"""Power-of-two-choices routing over replica queue depths.
+
+The fleet's balancing problem is the classic one: per-request
+least-loaded needs a full scan and herds onto one replica between
+depth refreshes; random spreads badly under skew.  Power-of-two-choices
+(sample two replicas, send to the shallower queue) gets exponentially
+better max-load than random for one extra depth read — the standard
+result the Gemma-on-TPU serving comparison's replica tier relies on
+(PAPERS.md).
+
+The router is deliberately dumb and fast: it ranks CANDIDATES from a
+depth snapshot; the caller (``ReplicaSet.submit``) tries them in order
+and only sheds (429) when every replica's bounded queue refuses the
+request.  Decisions must cost microseconds — they sit in front of every
+predict — so the seeded RNG is plain ``random.Random`` and the routing
+fault probe (``serve.route``) is the usual one-dict-check ``hit``.
+
+Determinism: the RNG is seeded per router, so a fixed request order
+yields a fixed routing sequence — drills and the skew-bound test are
+reproducible, not flaky (same discipline as faults/plane.py).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Sequence
+
+from learningorchestra_tpu import faults
+
+
+class P2CRouter:
+    """Rank replica indices for one request from a depth snapshot."""
+
+    def __init__(self, seed: int = 0):
+        self._rng = random.Random(seed)
+
+    def choose(self, depths: Sequence[int]) -> list[int]:
+        """Candidate order for ``len(depths)`` replicas: the P2C winner
+        first, its pair partner second, the rest by ascending depth.
+
+        The chaos probe fires HERE — routing-decision time — so
+        scale-up/down drills can inject latency or failure exactly
+        where traffic is being spread (``serve.route`` point).
+        """
+        faults.hit("serve.route")
+        n = len(depths)
+        if n <= 1:
+            return [0] * n
+        if n == 2:
+            a, b = 0, 1
+        else:
+            a = self._rng.randrange(n)
+            b = self._rng.randrange(n - 1)
+            if b >= a:
+                b += 1
+        if depths[b] < depths[a] or (
+            depths[b] == depths[a] and self._rng.random() < 0.5
+        ):
+            a, b = b, a
+        rest = [i for i in range(n) if i != a and i != b]
+        rest.sort(key=depths.__getitem__)
+        return [a, b, *rest]

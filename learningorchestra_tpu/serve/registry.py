@@ -30,7 +30,7 @@ class ServeError(Exception):
 class _Resident:
     __slots__ = (
         "name", "estimator", "params", "nbytes", "loaded_at", "requests",
-        "apply_fns",
+        "apply_fns", "replica_devices",
     )
 
     def __init__(self, name, estimator, params, nbytes):
@@ -45,6 +45,11 @@ class _Resident:
         # serving hot path); dies with the entry, so invalidation can
         # never serve a stale architecture's program.
         self.apply_fns: dict = {}
+        # replica index → device id ("host" when unplaced), mirrored
+        # in by the fleet manager after every scale event — residency
+        # listings show WHERE each model serves, not just that it is
+        # resident.  Empty for single-path models.
+        self.replica_devices: dict = {}
 
     def to_dict(self) -> dict:
         return {
@@ -53,6 +58,9 @@ class _Resident:
             "paramBytes": self.nbytes,
             "loadedAt": self.loaded_at,
             "requests": self.requests,
+            "replicaDevices": {
+                str(k): v for k, v in self.replica_devices.items()
+            },
         }
 
 

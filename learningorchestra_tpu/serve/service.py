@@ -22,7 +22,9 @@ import time
 
 import numpy as np
 
+from learningorchestra_tpu.jobs.leases import LeaseTimeout
 from learningorchestra_tpu.serve.batcher import MicroBatcher
+from learningorchestra_tpu.serve.fleet.manager import FleetManager
 from learningorchestra_tpu.serve.registry import ModelRegistry, ServeError
 
 #: Steps of serving_* scalar history kept (and rewritten per snapshot).
@@ -41,9 +43,14 @@ class ServingService:
             # An LRU-evicted model's batcher (worker thread + stats)
             # must die with its entry, or serving N distinct models
             # over a process lifetime leaks N threads.
-            on_evict=self._drop_batcher,
+            on_evict=self._teardown_model,
         )
         self._batchers: dict[str, MicroBatcher] = {}
+        # Fleet serving (serve/fleet/): per-model replica sets over
+        # leased chips + the shared autoscaler.  Dormant (one dict
+        # read per predict, no thread) until a model's replica bounds
+        # allow max > 1.
+        self.fleet = FleetManager(self)
         self._lock = threading.Lock()
         self._closed = False
         # tfevents snapshot state: a fixed wall_time keeps one stable
@@ -78,7 +85,7 @@ class ServingService:
         return self.registry.get(name).to_dict()
 
     def unload(self, name: str) -> bool:
-        self._drop_batcher(name)
+        self._teardown_model(name, keep_bounds=False)
         return self.registry.unload(name)
 
     def list_loaded(self) -> list[dict]:
@@ -86,15 +93,35 @@ class ServingService:
 
     def _on_artifact_changed(self, name: str) -> None:
         """Artifact overwritten (re-train) or deleted: resident weights
-        are stale — drop them; the next request reloads or 404s."""
-        if self.registry.invalidate(name):
-            self._drop_batcher(name)
+        are stale — drop them; the next request reloads or 404s.  A
+        DELETED artifact also forgets its fleet bounds — a future
+        model reusing the name must not silently inherit them and
+        fleet itself onto leased chips — while an overwrite keeps
+        them, so a re-trained model comes back at its configured
+        scale."""
+        gone = not self.ctx.artifacts.metadata.exists(name)
+        if self.registry.invalidate(name) or gone:
+            self._teardown_model(name, keep_bounds=not gone)
 
     def _drop_batcher(self, name: str) -> None:
+        """Discard the classic single-path batcher (teardown/unload
+        paths).  NOT the fleet cutover — that goes through
+        :meth:`retire_single_path`, which also carries the batcher's
+        lifetime counters into the replica set."""
         with self._lock:
             batcher = self._batchers.pop(name, None)
         if batcher is not None:
             batcher.close()
+
+    def _teardown_model(self, name: str, *, keep_bounds: bool = True
+                        ) -> None:
+        """Release everything serving ``name``: the single-path
+        batcher AND any fleet replica set (drained, chips released).
+        ``keep_bounds`` survives invalidation/eviction so a re-trained
+        model comes back at its configured scale; an explicit unload
+        forgets the model entirely."""
+        self._drop_batcher(name)
+        self.fleet.drop(name, keep_bounds=keep_bounds)
 
     # -- predict -------------------------------------------------------------
 
@@ -104,6 +131,20 @@ class ServingService:
             if batcher is None:
                 if self._closed:
                     raise RuntimeError("serving is shut down")
+                if self.fleet.engaged(name):
+                    # Raced a fleet enable between the predict's
+                    # routing check and here: refuse retriably (429 +
+                    # Retry-After) instead of resurrecting the batcher
+                    # the fleet just retired — the retry routes onto
+                    # the replicas.
+                    from learningorchestra_tpu.serve.batcher import (
+                        BatcherClosed,
+                    )
+
+                    raise BatcherClosed(
+                        f"model {name!r} is moving to fleet serving; "
+                        "retry"
+                    )
                 batcher = self._batchers[name] = MicroBatcher(
                     lambda padded, _n=name: self._dispatch(_n, padded),
                     max_batch=self.cfg.max_batch,
@@ -113,12 +154,22 @@ class ServingService:
                 )
             return batcher
 
-    def _dispatch(self, name: str, padded: np.ndarray):
+    def _dispatch(self, name: str, padded: np.ndarray, replica=None):
         """Run one padded bucket through the cache-resolved apply.
 
         Resolving the registry entry HERE (not at batcher creation)
         means an invalidation between requests serves the reloaded
-        artifact's weights, never a stale closure's."""
+        artifact's weights, never a stale closure's.
+
+        ``replica`` (a fleet Replica) redirects only the DATA — its
+        device-placed parameter copy and inputs — never the program:
+        every replica of an architecture resolves the same
+        (arch, bucket) executable from the compile cache, so scaling
+        1→N adds zero misses to THIS cache.  (XLA itself still
+        compiles per device underneath the shared jitted callable —
+        a fresh replica's first dispatch per bucket pays that
+        device-side warm-up; see the ROADMAP follow-up on replica
+        pre-warming.)"""
         import jax
         import jax.numpy as jnp
 
@@ -145,7 +196,38 @@ class ServingService:
                     ),
                 )
             )
-        return apply(entry.params, jnp.asarray(padded))
+        if replica is not None:
+            # Hand place() the HOST array: one host→replica-device
+            # transfer, not host→default-device→replica-device.
+            params, x = replica.place(entry, padded)
+        else:
+            params, x = entry.params, jnp.asarray(padded)
+        return apply(params, x)
+
+    def replica_dispatch_factory(self, name: str):
+        """Per-replica dispatch binder for the fleet manager: same
+        registry/compile-cache path as the single-batcher dispatch,
+        plus the replica's device placement.  Binder ONLY — the
+        single-path batcher is retired via :meth:`retire_single_path`
+        after the first replica actually places, so a failed scale-up
+        (chip pool exhausted) leaves the model serving exactly as
+        before instead of knocking it off the air."""
+        def factory(replica):
+            return lambda padded: self._dispatch(
+                name, padded, replica=replica
+            )
+
+        return factory
+
+    def pop_single_path(self, name: str) -> MicroBatcher | None:
+        """Detach (NOT close) the model's single-path batcher — THE
+        fleet cutover entry point (``FleetManager._finish_cutover``).
+        The manager absorbs its counters into the live set, registers
+        the set, and only then drains the detached batcher: predicts
+        route onto replicas immediately instead of stalling behind
+        the old path's flush."""
+        with self._lock:
+            return self._batchers.pop(name, None)
 
     @staticmethod
     def _as_batch(instances) -> np.ndarray:
@@ -184,6 +266,29 @@ class ServingService:
         x = self._as_batch(instances)
         entry = self.registry.get(name)  # load-before-queue: 404 fast
         t0 = time.perf_counter()
+        try:
+            rs = self.fleet.routing_set(name)
+        except LeaseTimeout:
+            # A PARTIAL cutover registers a routable set before
+            # re-raising — serve on it; otherwise the single-path
+            # batcher is only retired AFTER the first replica places,
+            # so degrade to it rather than going dark.  Only with
+            # neither does the 503 + Retry-After surface.
+            rs = self.fleet.registered_set(name)
+            if rs is None and self._batchers.get(name) is None:
+                raise
+        if rs is not None:
+            out, replica = rs.submit(x)
+            entry.requests += 1
+            return {
+                "model": name,
+                "predictions": out.tolist(),
+                "latencyMs": round(
+                    (time.perf_counter() - t0) * 1e3, 3
+                ),
+                "replica": replica.idx,
+                "device": replica.device_id or "host",
+            }
         out = self._batcher_for(name).submit(x)
         entry.requests += 1
         return {
@@ -199,9 +304,16 @@ class ServingService:
             per_model = {
                 name: b.stats() for name, b in self._batchers.items()
             }
+        # Fleet models surface through the SAME per-model stats shape
+        # (replica batchers merged), so aggregate()/tfevents/Prometheus
+        # see one consistent view; per-replica detail rides the
+        # dedicated "fleet" key.
+        for name, rs in self.fleet.sets_snapshot():
+            per_model[name] = rs.merged_stats()
         return {
             "registry": self.registry.stats(),
             "models": per_model,
+            "fleet": self.fleet.snapshot(),
             "config": {
                 "maxBatch": self.cfg.max_batch,
                 "maxQueue": self.cfg.max_queue,
@@ -299,6 +411,9 @@ class ServingService:
             self._closed = True
             batchers = list(self._batchers.values())
             self._batchers.clear()
+        # Fleet first: stops the autoscaler (no scale decisions against
+        # a closing service), drains replica batchers, releases chips.
+        self.fleet.close()
         for batcher in batchers:
             batcher.close()
         self.registry.clear()

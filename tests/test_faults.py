@@ -494,6 +494,30 @@ class TestServeChaos:
         assert faults.triggers("serve.apply") == 1
 
 
+class TestServeRouteChaos:
+    """The fleet's routing-decision fault point (serve.route), driven
+    through its real call site — ``P2CRouter.choose``, the function in
+    front of every fleet predict."""
+
+    def test_injected_route_delay_then_error(self):
+        from learningorchestra_tpu.serve.fleet import P2CRouter
+
+        router = P2CRouter(seed=3)
+        faults.arm("serve.route", "delay", delay_ms=40, max_triggers=1)
+        t0 = time.monotonic()
+        order = router.choose([3, 0])
+        assert 0.03 <= time.monotonic() - t0 < 5.0
+        assert order == [1, 0]  # delayed, not rerouted
+        faults.disarm("serve.route")
+
+        faults.arm("serve.route", "error", max_triggers=1)
+        with pytest.raises(faults.FaultInjected):
+            router.choose([1, 1, 2])
+        # Routing recovers on the very next decision.
+        assert sorted(router.choose([1, 1, 2])) == [0, 1, 2]
+        assert faults.triggers("serve.route") == 2
+
+
 class TestHttpChaos:
     def test_injected_handler_error_then_recovery(self, chaos_api):
         _, base, _ = chaos_api
