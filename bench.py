@@ -552,6 +552,20 @@ def _serving_probe(
     }
 
 
+def _tight_best_of(fn, m: int = 5000, reps: int = 7) -> float:
+    """Per-call seconds, BEST of ``reps`` windows: scheduler/steal
+    noise only ever ADDS time, so the minimum is the robust estimator
+    — the shared tight-loop discipline of the obs/faults/costs
+    probes."""
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(m):
+            fn()
+        best = min(best, (time.perf_counter() - t0) / m)
+    return best
+
+
 def _obs_probe(n_jobs: int = 60, rounds: int = 3) -> dict:
     """Observability-overhead probe: what the obs layer (metrics +
     tracing, the deployed default) costs per dispatched job, against
@@ -628,16 +642,7 @@ def _obs_probe(n_jobs: int = 60, rounds: int = 3) -> dict:
         return dt / n_jobs
 
     def tight(fn, m: int = 400, reps: int = 6) -> float:
-        """Per-call seconds, BEST of ``reps`` windows: scheduler/steal
-        noise only ever ADDS time, so the minimum is the robust
-        estimator (the same discipline as _fused_throughput)."""
-        best = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            for _ in range(m):
-                fn()
-            best = min(best, (time.perf_counter() - t0) / m)
-        return best
+        return _tight_best_of(fn, m=m, reps=reps)
 
     try:
         one_window(True)  # warm-up: imports, allocator, store paths
@@ -752,16 +757,7 @@ def _faults_probe() -> dict:
     from learningorchestra_tpu import faults
     from learningorchestra_tpu.store import DocumentStore
 
-    def tight(fn, m: int = 5000, reps: int = 7) -> float:
-        """Per-call seconds, best of ``reps`` loops (scheduler noise
-        only ever ADDS time — same discipline as _obs_probe)."""
-        best = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            for _ in range(m):
-                fn()
-            best = min(best, (time.perf_counter() - t0) / m)
-        return best
+    tight = _tight_best_of
 
     faults.reset()
     try:
@@ -801,6 +797,97 @@ def _faults_probe() -> dict:
         "wal_append_us": round(wal_append_us, 2),
         "disabled_share_of_wal_append_pct": round(
             disabled_ns / 1e3 / wal_append_us * 100.0, 3
+        ),
+    }
+
+
+def _costs_probe() -> dict:
+    """Per-dispatch cost-accounting hook cost, pinned as a SUBSYSTEM
+    number (the ROADMAP bench caveat: headline A/B windows on this box
+    cannot resolve sub-µs effects; tight-loop best-of can).
+
+    The hook sits on every serving dispatch (serve/service.py
+    ``_dispatch``) and every train epoch.  Three per-hit numbers:
+
+    - ``disabled_ns`` — LO_TPU_COSTS_ENABLED=0 (one config check, the
+      path a deployment that opts out pays);
+    - ``sampled_out_ns`` — enabled but the stride skips this dispatch
+      (``will_record``: lock + counter, no sync, no record);
+    - ``recorded_ns`` — the full sampled-in path, exactly the serving
+      dispatch's call shape (stride + ledger record across
+      totals/model/bucket).
+
+    Denominator: one REAL serving dispatch — a single-row predict
+    through a live MicroBatcher (enqueue → worker wake → jitted apply
+    → result handoff, flush_ms=0), the narrowest interval the hook
+    brackets in production.  Coalesced batches amortize the hook
+    further (it fires per DISPATCH, not per request).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from learningorchestra_tpu.config import CostsConfig
+    from learningorchestra_tpu.obs import costs
+    from learningorchestra_tpu.serve.batcher import MicroBatcher
+
+    tight = _tight_best_of
+
+    try:
+        # Disabled: the deployment-opt-out path (one config check).
+        costs.reset(CostsConfig(enabled=False))
+        disabled_ns = tight(costs.enabled) * 1e9
+
+        # Enabled, thinned to 1-in-100: the common sampled-out hit.
+        costs.reset(CostsConfig(enabled=True, sample=0.01))
+        led = costs.devtime()
+        sampled_out_ns = tight(lambda: led.will_record("m")) * 1e9
+
+        # Enabled, full-rate record — the serve _dispatch call shape.
+        costs.reset(CostsConfig(enabled=True, sample=1.0))
+        led = costs.devtime()
+
+        def full_hit():
+            w = led.will_record("m")
+            if w:
+                led.record_model(w, 1e-4, 1e6, 1e6, "m", 16)
+
+        recorded_ns = tight(full_hit) * 1e9
+
+        # Denominator: the real serving dispatch round-trip.
+        from learningorchestra_tpu.models.mlp import MLPClassifier
+
+        est = MLPClassifier(hidden_layer_sizes=[128], num_classes=4)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((64, 64)).astype(np.float32)
+        est.fit(x, rng.integers(0, 4, (64,)), epochs=1, batch_size=64)
+        apply = jax.jit(est.module.apply)
+
+        batcher = MicroBatcher(
+            lambda padded: apply(est.params, jnp.asarray(padded)),
+            max_batch=64, max_queue=256, flush_ms=0.0, name="bench",
+        )
+        row = x[:1]
+        try:
+            batcher.submit(row)  # warm the bucket-1 executable
+            dispatch_us = tight(
+                lambda: batcher.submit(row), m=300, reps=5
+            ) * 1e6
+        finally:
+            batcher.close()
+    finally:
+        costs.reset()
+
+    return {
+        "hook_disabled_ns": round(disabled_ns, 1),
+        "hook_sampled_out_ns": round(sampled_out_ns, 1),
+        "hook_recorded_ns": round(recorded_ns, 1),
+        "serving_dispatch_us": round(dispatch_us, 2),
+        "recorded_share_of_dispatch_pct": round(
+            recorded_ns / 1e3 / dispatch_us * 100.0, 3
+        ),
+        "disabled_share_of_dispatch_pct": round(
+            disabled_ns / 1e3 / dispatch_us * 100.0, 4
         ),
     }
 
@@ -1069,6 +1156,10 @@ def _tpu_suite_child_main() -> None:
         suite["_fleet"] = _fleet_probe()
     except Exception as exc:  # noqa: BLE001 — record, don't hide
         suite["_fleet"] = f"FAILED: {exc!r}"
+    try:
+        suite["_costs"] = _costs_probe()
+    except Exception as exc:  # noqa: BLE001 — record, don't hide
+        suite["_costs"] = f"FAILED: {exc!r}"
     print(json.dumps(suite))
 
 
@@ -1085,6 +1176,7 @@ def main() -> None:
         obs_probe = suite.pop("_obs", None)
         faults_probe = suite.pop("_faults", None)
         fleet_probe = suite.pop("_fleet", None)
+        costs_probe = suite.pop("_costs", None)
         throughput, extra = _assemble_tpu(suite)
         extra.update(flash)
         if cache_probe is not None:
@@ -1097,6 +1189,8 @@ def main() -> None:
             extra["faults"] = faults_probe
         if fleet_probe is not None:
             extra["fleet"] = fleet_probe
+        if costs_probe is not None:
+            extra["costs"] = costs_probe
     else:
         _force_cpu()  # record a CPU number rather than hang the driver
         import jax
@@ -1132,6 +1226,10 @@ def main() -> None:
             extra["fleet"] = _fleet_probe()
         except Exception as exc:  # noqa: BLE001 — record, don't hide
             extra["fleet"] = f"FAILED: {exc!r}"
+        try:
+            extra["costs"] = _costs_probe()
+        except Exception as exc:  # noqa: BLE001 — record, don't hide
+            extra["costs"] = f"FAILED: {exc!r}"
 
     metric = f"mnist_cnn_train_samples_per_sec_per_chip_{platform}"
     prior = _prior_best(metric, allow_cross_backend=platform == "tpu")

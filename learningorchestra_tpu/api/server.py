@@ -27,6 +27,11 @@ from learningorchestra_tpu.config import Config, get_config
 from learningorchestra_tpu.jobs.leases import LeaseTimeout
 from learningorchestra_tpu.obs import metrics as obs_metrics
 from learningorchestra_tpu.obs import tracing as obs_tracing
+from learningorchestra_tpu.obs.profiling import (
+    ProfilerConflict,
+    ProfilerError,
+    ProfilerNotFound,
+)
 from learningorchestra_tpu.services import (
     BuilderService,
     DatasetService,
@@ -208,6 +213,19 @@ class APIServer:
         # predict over device-pinned params, request-coalescing
         # micro-batches, shape-bucketed compiles.
         self.serving = ServingService(self.ctx, monitoring_root)
+        # On-demand profiler capture (obs/profiling.py): jax.profiler
+        # behind POST /observability/profile/start|stop — one capture
+        # at a time into a bounded dir, auto-stop deadline.
+        from learningorchestra_tpu.obs.profiling import ProfilerService
+
+        prof = self.config.profiling
+        self.profiler = ProfilerService(
+            prof.dir or _os.path.join(
+                self.config.store.volume_path(), "_profiles"
+            ),
+            max_seconds=prof.max_seconds,
+            max_captures=prof.max_captures,
+        )
         # Unified observability (obs/): push metrics for the HTTP
         # layer, pull collectors over every subsystem's existing stats,
         # rendered at GET /metrics.prom.  The legacy JSON endpoints
@@ -1539,6 +1557,77 @@ class APIServer:
 
         add("GET", rf"/observability/jobs/{NAME}/trace", job_trace)
 
+        # ---- On-demand profiler capture (obs/profiling.py) ----
+        # start/stop wrap jax.profiler around a LIVE process: capture
+        # a device trace while production traffic runs, list the
+        # retained captures, pull the .xplane.pb artifacts for
+        # offline TensorBoard analysis.  One capture at a time
+        # (double-start → 409), auto-stop deadline, bounded dir.
+        # NOTE: /start registered before /stop — the every-route-
+        # metered gate dispatches in registration order, so its sweep
+        # opens and then closes a capture instead of leaking one.
+        def profile_start(m, body, query):
+            body = body or {}
+            return 201, {
+                "capture": self.profiler.start(
+                    name=body.get("name"),
+                    max_seconds=body.get("maxSeconds"),
+                )
+            }
+
+        def profile_stop(m, body, query):
+            return 200, {"capture": self.profiler.stop()}
+
+        add("POST", r"/observability/profile/start", profile_start)
+        add("POST", r"/observability/profile/stop", profile_stop)
+        add(
+            "GET", r"/observability/profile",
+            lambda m, b, q: (200, self.profiler.status()),
+        )
+        add(
+            "GET", r"/observability/profile/captures",
+            lambda m, b, q: (
+                200, {"captures": self.profiler.list_captures()},
+            ),
+        )
+
+        def profile_capture(m, body, query):
+            name = m.group("name")
+            rel = query.get("file")
+            if rel:
+                # Retrieval: one capture artifact's bytes (path
+                # traversal is rejected inside read_file).
+                return 200, (
+                    "application/octet-stream",
+                    self.profiler.read_file(name, rel),
+                )
+            doc = self.profiler.capture(name)
+            if doc is None:
+                return 404, {"error": f"no capture {name!r}"}
+            return 200, doc
+
+        add("GET", rf"/observability/profile/captures/{NAME}",
+            profile_capture)
+        add(
+            "DELETE", rf"/observability/profile/captures/{NAME}",
+            lambda m, b, q: (
+                (200, {"result": "deleted"})
+                if self.profiler.delete(m.group("name"))
+                else (404, {"error": f"no capture {m.group('name')!r}"})
+            ),
+        )
+
+        # ---- Cost accounting (obs/costs.py): the JSON view over the
+        # per-program FLOPs/HBM ledger and the device-time ledgers
+        # (per job / per model / per bucket) — the same numbers the
+        # lo_program_* and lo_device_time_* Prometheus families carry.
+        def costs_view(m, body, query):
+            from learningorchestra_tpu.obs import costs as obs_costs
+
+            return 200, obs_costs.snapshot()
+
+        add("GET", r"/observability/costs", costs_view)
+
         # ---- Fault-injection plane (faults/plane.py) ----
         # The chaos drill's REST surface: inspect every registered
         # fault point, arm a seeded schedule against one, disarm one
@@ -1700,14 +1789,19 @@ class APIServer:
             # Chaos probe: an armed ``http.handler`` schedule can
             # delay or fail any admitted request — inside the try, so
             # an injected error exercises the real 500 path and an
-            # injected delay the real gateway-timeout path.
+            # injected delay the real gateway-timeout path.  For the
+            # profiler routes this also proves an injected failure
+            # fires BEFORE the handler claims the single-capture
+            # lock — a chaos drill must not wedge profiling.
             faults.hit("http.handler")
             return handler(m, body, query)
-        except (DuplicateArtifact, ConflictError) as exc:
+        except (DuplicateArtifact, ConflictError,
+                ProfilerConflict) as exc:
             return 409, {"error": str(exc)}
-        except NotFoundError as exc:
+        except (NotFoundError, ProfilerNotFound) as exc:
             return 404, {"error": str(exc)}
-        except (ValidationError, RegistryError, ServeError) as exc:
+        except (ValidationError, RegistryError, ServeError,
+                ProfilerError) as exc:
             return 406, {"error": str(exc)}
         except LeaseTimeout as exc:
             # No chip lease within the placement budget: the pool is
@@ -1874,6 +1968,25 @@ class APIServer:
                 "Estimated resident bytes of cached programs.",
             ).sample(stats["bytesEstimate"])
         )
+        fams.append(
+            Family(
+                "gauge", "lo_compile_cache_measured_entries",
+                "Cache entries charged at their MEASURED serialized "
+                "size (vs the flat fallback estimate).",
+            ).sample(stats.get("measuredEntries", 0))
+        )
+
+        # -- cost accounting: per-program FLOPs/HBM + device-time
+        # attribution (obs/costs.py).  Cardinality is bounded by
+        # construction: programs <= the cost ledger's cap (itself <=
+        # program diversity the compile cache admits), jobs ride a
+        # bounded freshest-N ring, buckets <= models x log2(max_batch).
+        try:
+            from learningorchestra_tpu.obs import costs as obs_costs
+
+            fams += self._collect_cost_families(obs_costs)
+        except Exception:  # noqa: BLE001 — cost families must never
+            pass  # take down the whole exposition
 
         # -- serving: registry residency + batcher aggregates (the
         # same roll-up the tfevents snapshot uses — ONE aggregation,
@@ -2006,6 +2119,132 @@ class APIServer:
                 "1 when a standby fenced this store, else 0.",
             ).sample(1 if is_fenced(root) is not None else 0)
         )
+        return fams
+
+    def _collect_cost_families(self, obs_costs) -> list:
+        """lo_program_* and lo_device_time_* / MFU families from the
+        cost-accounting plane (obs/costs.py) — what each compiled
+        program costs per execution, and who consumed the device."""
+        from learningorchestra_tpu.obs.metrics import Family
+
+        if not obs_costs.enabled():
+            return []
+        fams: list = []
+        ledger = obs_costs.get_ledger().snapshot()
+        programs = [p for p in ledger["programs"] if p["label"]]
+        if programs:
+            flops = Family(
+                "gauge", "lo_program_flops",
+                "XLA-reported FLOPs per execution of each compiled "
+                "program.",
+            )
+            accessed = Family(
+                "gauge", "lo_program_bytes_accessed",
+                "XLA-reported bytes accessed per execution.",
+            )
+            hbm = Family(
+                "gauge", "lo_program_hbm_bytes",
+                "Per-program HBM footprint by kind "
+                "(argument/output/temp/code).",
+            )
+            size = Family(
+                "gauge", "lo_program_serialized_bytes",
+                "Serialized executable size (what the compile cache's "
+                "byte cap charges).",
+            )
+            for p in programs:
+                # program + key: labels alone are NOT unique (two
+                # fits of one architecture at different shapes share
+                # a label string), and duplicate label sets would
+                # make Prometheus reject the ENTIRE scrape — the
+                # fingerprint prefix disambiguates.
+                labels = {"program": p["label"], "key": p["key"]}
+                if p["flops"] is not None:
+                    flops.sample(p["flops"], **labels)
+                if p["bytesAccessed"] is not None:
+                    accessed.sample(p["bytesAccessed"], **labels)
+                for kind, field in (
+                    ("argument", "argumentBytes"),
+                    ("output", "outputBytes"),
+                    ("temp", "tempBytes"),
+                    ("code", "generatedCodeBytes"),
+                ):
+                    if p[field] is not None:
+                        hbm.sample(p[field], kind=kind, **labels)
+                if p["serializedBytes"] is not None:
+                    size.sample(p["serializedBytes"], **labels)
+            fams += [f for f in (flops, accessed, hbm, size)
+                     if f.samples]
+        fams.append(
+            Family(
+                "counter", "lo_program_analyses_total",
+                "Cost/memory analyses run at program build time.",
+            )
+            .sample(ledger["analyses"], outcome="ok")
+            .sample(ledger["analysisFailures"], outcome="failed")
+        )
+        dt = obs_costs.devtime().snapshot(
+            peak_flops=obs_costs.peak_flops()
+        )
+        totals = dt["totals"]
+        fams.append(
+            Family(
+                "counter", "lo_device_time_seconds_total",
+                "Attributed device seconds (sampled; scaled to be "
+                "unbiased).",
+            ).sample(totals["deviceTimeS"])
+        )
+        fams.append(
+            Family(
+                "counter", "lo_device_flops_total",
+                "Attributed FLOPs across dispatches.",
+            ).sample(totals["flops"])
+        )
+        if dt["jobs"]:
+            jt = Family(
+                "gauge", "lo_job_device_seconds",
+                "Attributed device seconds per job (freshest-N ring).",
+            )
+            jmfu = Family(
+                "gauge", "lo_job_mfu",
+                "Model-FLOPs-utilization per job (needs "
+                "LO_TPU_COSTS_PEAK_FLOPS).",
+            )
+            for job, doc in dt["jobs"].items():
+                jt.sample(doc["deviceTimeS"], job=job)
+                if "mfu" in doc:
+                    jmfu.sample(doc["mfu"], job=job)
+            fams.append(jt)
+            if jmfu.samples:
+                fams.append(jmfu)
+        if dt["models"]:
+            mt = Family(
+                "gauge", "lo_model_device_seconds",
+                "Attributed device seconds per served model.",
+            )
+            for model, doc in dt["models"].items():
+                mt.sample(doc["deviceTimeS"], model=model)
+            fams.append(mt)
+        if dt["buckets"]:
+            bmfu = Family(
+                "gauge", "lo_serving_bucket_mfu",
+                "Model-FLOPs-utilization per (model, bucket) (needs "
+                "LO_TPU_COSTS_PEAK_FLOPS).",
+            )
+            bt = Family(
+                "gauge", "lo_serving_bucket_device_seconds",
+                "Attributed device seconds per (model, bucket).",
+            )
+            for key, doc in dt["buckets"].items():
+                model, _, bucket = key.rpartition(":")
+                bt.sample(doc["deviceTimeS"], model=model,
+                          bucket=bucket)
+                if "mfu" in doc:
+                    bmfu.sample(doc["mfu"], model=model,
+                                bucket=bucket)
+            fams.append(bt)
+            if bmfu.samples:
+                fams.append(bmfu)
         return fams
 
     def handle(self, verb: str, path: str, body: dict, query: dict,
@@ -2386,6 +2625,7 @@ class APIServer:
         if httpd is not None:
             httpd.shutdown()
             httpd.server_close()
+        self.profiler.close()
         self.serving.close()
         self.monitoring.close()
         self.ctx.close()

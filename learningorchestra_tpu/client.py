@@ -863,6 +863,55 @@ class _Observability:
             "GET", f"/observability/jobs/{name}/trace"
         )
 
+    def costs(self) -> dict:
+        """GET /observability/costs — the cost-accounting plane: per-
+        program FLOPs/HBM records and the device-time ledgers (per
+        job / per served model / per serving bucket, with MFU when
+        the server configured its chips' peak FLOP/s)."""
+        return self.ctx.request("GET", "/observability/costs")
+
+    # -- on-demand profiler capture -------------------------------------
+
+    def profile_start(self, name: str | None = None,
+                      max_seconds: float | None = None) -> dict:
+        """POST /observability/profile/start — begin a jax.profiler
+        capture on the LIVE server (one at a time; a second start
+        raises ClientError 409).  Auto-stops after ``max_seconds``
+        (clamped to the server's LO_TPU_PROF_MAX_S)."""
+        body: dict = {}
+        if name is not None:
+            body["name"] = name
+        if max_seconds is not None:
+            body["maxSeconds"] = max_seconds
+        return self.ctx.request(
+            "POST", "/observability/profile/start", body
+        )
+
+    def profile_stop(self) -> dict:
+        """POST /observability/profile/stop — end the active capture;
+        returns its file manifest."""
+        return self.ctx.request(
+            "POST", "/observability/profile/stop", {}
+        )
+
+    def profile_status(self) -> dict:
+        return self.ctx.request("GET", "/observability/profile")
+
+    def profile_captures(self) -> dict:
+        """GET /observability/profile/captures — every retained
+        capture with its file manifest."""
+        return self.ctx.request(
+            "GET", "/observability/profile/captures"
+        )
+
+    def profile_fetch(self, capture: str, path: str) -> bytes:
+        """One capture artifact's bytes (e.g. the ``.xplane.pb`` for
+        TensorBoard's profile plugin)."""
+        return self.ctx.request(
+            "GET", f"/observability/profile/captures/{capture}",
+            query={"file": path}, raw=True,
+        )
+
 
 class _Faults:
     """Fault-injection plane (server faults/): arm deterministic,

@@ -210,12 +210,71 @@ class ObsConfig:
     # not grow the ledger record without bound).
     # Env: LO_TPU_OBS_MAX_SPANS.
     max_spans: int = 512
+    # Span-ledger sampling (0.0-1.0): the fraction of jobs whose span
+    # trees persist, decided deterministically per requestId (a
+    # retried request samples the same way).  Sampled-out jobs keep
+    # every metric; only the span tree is skipped.
+    # Env: LO_TPU_OBS_TRACE_SAMPLE.
+    trace_sample: float = 1.0
     # Latency histogram bucket edges, milliseconds, ascending.
     # Env: LO_TPU_OBS_BUCKETS_MS (comma-separated).
     latency_buckets_ms: tuple = (
         1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
         250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0, 30000.0,
     )
+
+
+@dataclasses.dataclass
+class CostsConfig:
+    """Cost-accounting plane (obs/costs.py): per-program FLOPs/HBM
+    ledgers from XLA cost/memory analysis at compile-cache build time,
+    plus sampled per-dispatch device-time attribution (per job, per
+    served model, per serving bucket).  Env knobs: LO_TPU_COSTS_*."""
+
+    # Master switch: off, builders skip analysis and the per-dispatch
+    # hook is one config check.  Env: LO_TPU_COSTS_ENABLED.
+    enabled: bool = True
+    # Deep analysis: AOT-compile each analyzed program once at build
+    # time for Compiled.memory_analysis() (HBM footprint) and the
+    # serialized executable size the compile cache's byte cap charges.
+    # The extra XLA compile is per cache ENTRY (amortized over every
+    # job that hits it) and dedups against the persistent XLA disk
+    # cache; off, analysis stops at Lowered.cost_analysis() (flops /
+    # bytes, no backend compile) and the byte cap falls back to the
+    # flat estimate.  Env: LO_TPU_COSTS_DEEP.
+    deep: bool = True
+    # Per-dispatch attribution sampling (0.0-1.0): every k-th dispatch
+    # records, contributions scaled by k — deterministic and unbiased.
+    # QUANTIZED to 1/round(1/sample): only 1, 1/2, 1/3, ... thin —
+    # 0.7 still records every dispatch; use 0.5, 0.1, 0.01 etc.
+    # Env: LO_TPU_COSTS_SAMPLE.
+    sample: float = 1.0
+    # Ledger bounds: distinct program fingerprints / freshest jobs.
+    # Env: LO_TPU_COSTS_MAX_PROGRAMS / LO_TPU_COSTS_MAX_JOBS.
+    max_programs: int = 256
+    max_jobs: int = 64
+    # Per-chip peak FLOP/s for model-FLOPs-utilization gauges (e.g.
+    # 2.75e14 for TPU v4 bf16).  0 = unknown: MFU is omitted rather
+    # than fabricated.  Env: LO_TPU_COSTS_PEAK_FLOPS.
+    peak_flops: float = 0.0
+
+
+@dataclasses.dataclass
+class ProfilingConfig:
+    """On-demand profiler capture (obs/profiling.py): jax.profiler
+    behind POST /observability/profile/start|stop.  Env knobs:
+    LO_TPU_PROF_*."""
+
+    # Capture root; "" derives <volume_root>/_profiles at server
+    # construction.  Env: LO_TPU_PROF_DIR.
+    dir: str = ""
+    # Auto-stop deadline per capture (also the cap on a request's
+    # maxSeconds): a forgotten capture must not trace forever.
+    # Env: LO_TPU_PROF_MAX_S.
+    max_seconds: float = 60.0
+    # Retained captures; older ones are deleted on the next start.
+    # Env: LO_TPU_PROF_MAX_CAPTURES.
+    max_captures: int = 8
 
 
 @dataclasses.dataclass
@@ -336,6 +395,10 @@ class Config:
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
+    costs: CostsConfig = dataclasses.field(default_factory=CostsConfig)
+    profiling: ProfilingConfig = dataclasses.field(
+        default_factory=ProfilingConfig
+    )
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     dist: DistributedConfig = dataclasses.field(
         default_factory=DistributedConfig
@@ -470,6 +533,46 @@ class Config:
             cfg.obs.max_series = int(env["LO_TPU_OBS_MAX_SERIES"])
         if "LO_TPU_OBS_MAX_SPANS" in env:
             cfg.obs.max_spans = int(env["LO_TPU_OBS_MAX_SPANS"])
+        def _fraction_env(key: str) -> float:
+            # Sampling knobs: a typo'd rate silently clamping would
+            # either drop every trace or record everything — reject
+            # out-of-range values LOUDLY at boot.
+            value = float(env[key])
+            if not 0.0 <= value <= 1.0:
+                raise ValueError(
+                    f"{key}={env[key]!r} must be a fraction in "
+                    "[0.0, 1.0]"
+                )
+            return value
+
+        if "LO_TPU_OBS_TRACE_SAMPLE" in env:
+            cfg.obs.trace_sample = _fraction_env(
+                "LO_TPU_OBS_TRACE_SAMPLE"
+            )
+        if "LO_TPU_COSTS_ENABLED" in env:
+            cfg.costs.enabled = _bool_env("LO_TPU_COSTS_ENABLED")
+        if "LO_TPU_COSTS_DEEP" in env:
+            cfg.costs.deep = _bool_env("LO_TPU_COSTS_DEEP")
+        if "LO_TPU_COSTS_SAMPLE" in env:
+            cfg.costs.sample = _fraction_env("LO_TPU_COSTS_SAMPLE")
+        if "LO_TPU_COSTS_MAX_PROGRAMS" in env:
+            cfg.costs.max_programs = int(
+                env["LO_TPU_COSTS_MAX_PROGRAMS"]
+            )
+        if "LO_TPU_COSTS_MAX_JOBS" in env:
+            cfg.costs.max_jobs = int(env["LO_TPU_COSTS_MAX_JOBS"])
+        if "LO_TPU_COSTS_PEAK_FLOPS" in env:
+            cfg.costs.peak_flops = float(
+                env["LO_TPU_COSTS_PEAK_FLOPS"]
+            )
+        if "LO_TPU_PROF_DIR" in env:
+            cfg.profiling.dir = env["LO_TPU_PROF_DIR"]
+        if "LO_TPU_PROF_MAX_S" in env:
+            cfg.profiling.max_seconds = float(env["LO_TPU_PROF_MAX_S"])
+        if "LO_TPU_PROF_MAX_CAPTURES" in env:
+            cfg.profiling.max_captures = int(
+                env["LO_TPU_PROF_MAX_CAPTURES"]
+            )
         if "LO_TPU_OBS_BUCKETS_MS" in env:
             edges = tuple(
                 float(tok)

@@ -1,6 +1,7 @@
 """Unified observability layer: metrics registry, Prometheus
-exposition (obs/metrics.py) and end-to-end job tracing
-(obs/tracing.py).
+exposition (obs/metrics.py), end-to-end job tracing (obs/tracing.py),
+cost accounting / device-time attribution (obs/costs.py) and
+on-demand profiler capture (obs/profiling.py).
 
 One coherent surface over what previously lived on four disjoint JSON
 endpoints: ``GET /metrics.prom`` exposes every subsystem's counters

@@ -210,11 +210,16 @@ class MetricsRegistry:
     """Lock-protected registry of push metrics + pull collectors."""
 
     def __init__(self, enabled: bool = True, trace_enabled: bool = True,
-                 max_series: int = 1024, max_spans: int = 512):
+                 max_series: int = 1024, max_spans: int = 512,
+                 trace_sample: float = 1.0):
         self.enabled = bool(enabled)
         self.trace_enabled = bool(enabled) and bool(trace_enabled)
         self.max_series = max(1, int(max_series))
         self.max_spans = max(1, int(max_spans))
+        # Span-ledger sampling: the fraction of jobs whose span trees
+        # persist, decided deterministically per request id
+        # (obs/tracing.py new_trace).  Metrics are never sampled.
+        self.trace_sample = min(1.0, max(0.0, float(trace_sample)))
         self.lock = threading.Lock()
         self._metrics: dict[str, _Metric] = {}
         self._collectors: list[Callable[[], Iterable[Family]]] = []
@@ -408,6 +413,7 @@ def get_registry() -> MetricsRegistry:
                 trace_enabled=obs.trace,
                 max_series=obs.max_series,
                 max_spans=obs.max_spans,
+                trace_sample=getattr(obs, "trace_sample", 1.0),
             )
         return _registry
 

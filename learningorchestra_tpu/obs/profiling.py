@@ -1,0 +1,375 @@
+"""On-demand profiler capture: ``jax.profiler`` behind a REST surface.
+
+The monitoring service already wraps distributed train jobs in
+``jax.profiler.trace`` sessions, but nothing could capture a profile
+from a LIVE process — the "serving p99 regressed in production, what
+is the device doing right now?" workflow.  This module owns that:
+
+- ``start(...)`` opens ONE capture at a time (a second start answers
+  409 — jax's profiler is process-global) into a bounded capture
+  directory, with an auto-stop deadline so a forgotten capture cannot
+  trace forever and fill the disk;
+- ``stop()`` ends it and records the capture's file manifest;
+- ``list_captures()`` / ``read_file(...)`` serve listing + retrieval,
+  so an operator pulls the ``.xplane.pb`` artifacts over HTTP and
+  loads them into TensorBoard's profile plugin offline.
+
+Knobs (``LO_TPU_PROF_*``, config.py ProfilingConfig): capture dir,
+auto-stop seconds, retained-capture cap (oldest captures beyond it are
+deleted on the next start — bounded disk, newest evidence wins).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import threading
+import time
+
+__all__ = [
+    "ProfilerConflict",
+    "ProfilerError",
+    "ProfilerNotFound",
+    "ProfilerService",
+]
+
+_NAME_RE = re.compile(r"[A-Za-z0-9_][A-Za-z0-9_.\-]*")
+_META_FILE = "capture.json"
+
+
+class ProfilerError(Exception):
+    """Invalid profiler request (→ 406)."""
+
+
+class ProfilerNotFound(Exception):
+    """No such capture / capture file (→ 404)."""
+
+
+class ProfilerConflict(Exception):
+    """Capture state conflict: start while active, stop while idle
+    (→ 409)."""
+
+
+class ProfilerService:
+    """Single-flight ``jax.profiler`` capture manager."""
+
+    def __init__(self, root: str, *, max_seconds: float = 60.0,
+                 max_captures: int = 8):
+        self.root = str(root)
+        self.max_seconds = float(max_seconds)
+        self.max_captures = max(1, int(max_captures))
+        self._lock = threading.Lock()
+        self._active: dict | None = None
+        # True while a stop's (potentially multi-second) trace flush
+        # runs OUTSIDE the lock: a start arriving in that window
+        # conflicts instead of racing start_trace against the
+        # in-flight stop_trace.
+        self._stopping = False
+        self._deadline_timer: threading.Timer | None = None
+        self.captures_total = 0
+        self.auto_stops = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, name: str | None = None,
+              max_seconds: float | None = None) -> dict:
+        """Begin a capture.  ``name`` defaults to a timestamp;
+        ``max_seconds`` overrides the auto-stop deadline (clamped to
+        the configured cap — a REST caller must not disable the bound
+        that keeps a forgotten capture from tracing forever)."""
+        if name is None:
+            name = time.strftime("capture-%Y%m%d-%H%M%S")
+            # Same-second restarts (drills) must not collide.
+            with self._lock:
+                name = f"{name}-{self.captures_total}"
+        if not isinstance(name, str) or not _NAME_RE.fullmatch(name):
+            raise ProfilerError(
+                f"invalid capture name {name!r} (names become "
+                "directories under the capture root)"
+            )
+        budget = self.max_seconds
+        if max_seconds is not None:
+            try:
+                budget = float(max_seconds)
+            except (TypeError, ValueError):
+                raise ProfilerError(
+                    f"maxSeconds must be a number, got {max_seconds!r}"
+                ) from None
+            if budget <= 0:
+                raise ProfilerError("maxSeconds must be > 0")
+            budget = min(budget, self.max_seconds)
+        logdir = os.path.join(self.root, name)
+        # Claim + start_trace are atomic under the lock: jax's
+        # profiler is process-global, so two racing starts, or a
+        # start racing a stale deadline timer, must serialize here
+        # (start_trace only opens the session — milliseconds; the
+        # expensive flush happens at stop, which runs its jax call
+        # outside the lock behind the _stopping sentinel).  Prune
+        # VICTIMS are only chosen after this start is admitted —
+        # a refused start must have zero side effects — and the
+        # rmtree work runs after the lock releases.
+        with self._lock:
+            if self._active is not None or self._stopping:
+                raise ProfilerConflict(
+                    "a profiler capture is already active or "
+                    "stopping"
+                    + (f" ({self._active['name']!r})"
+                       if self._active else "")
+                    + "; stop it / retry shortly"
+                )
+            if os.path.isdir(logdir):
+                raise ProfilerConflict(
+                    f"capture {name!r} already exists; pick another "
+                    "name"
+                )
+            victims = self._prune_victims(keep=name)
+            os.makedirs(logdir, exist_ok=True)
+            try:
+                import jax
+
+                jax.profiler.start_trace(logdir)
+            except BaseException as exc:
+                # Another trace (a monitored train job's) may already
+                # hold the process-global profiler.  A failed start
+                # must never wedge the surface.
+                shutil.rmtree(logdir, ignore_errors=True)
+                raise ProfilerConflict(
+                    f"jax profiler could not start ({exc!r}); "
+                    "another trace may be active in this process"
+                ) from None
+            self._active = active = {
+                "name": name, "logdir": logdir,
+                "startedAt": time.time(), "deadlineS": budget,
+            }
+            timer = threading.Timer(
+                budget, self._auto_stop, args=(name,)
+            )
+            timer.daemon = True
+            self._deadline_timer = timer
+            self.captures_total += 1
+            active = dict(active)
+        timer.start()
+        for victim in victims:
+            shutil.rmtree(victim, ignore_errors=True)
+        return active
+
+    def stop(self) -> dict:
+        """End the active capture; returns its manifest (name, files,
+        total bytes).  No active capture → 409."""
+        return self._stop_expected(None)
+
+    def _stop_expected(self, expected: str | None) -> dict:
+        """Stop the active capture — only if it is still ``expected``
+        (None = whatever is active).  The check and the state clear
+        are atomic, so a stale deadline timer can never stop the
+        FRESH capture an operator started after its own ended; the
+        (potentially multi-second) ``stop_trace`` flush itself runs
+        OUTSIDE the lock behind the ``_stopping`` sentinel, so status
+        and listing requests never stack behind it."""
+        with self._lock:
+            active = self._active
+            if active is None or (
+                expected is not None and active["name"] != expected
+            ):
+                raise ProfilerConflict("no profiler capture is active")
+            self._active = None
+            self._stopping = True
+            timer, self._deadline_timer = self._deadline_timer, None
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except BaseException:  # noqa: BLE001 — files flushed
+            pass  # before the failure are still the evidence
+        finally:
+            with self._lock:
+                self._stopping = False
+        if timer is not None:
+            timer.cancel()
+        manifest = {
+            "name": active["name"],
+            "startedAt": active["startedAt"],
+            "stoppedAt": time.time(),
+            "durationS": round(time.time() - active["startedAt"], 3),
+            "files": _file_manifest(active["logdir"]),
+        }
+        manifest["totalBytes"] = sum(
+            f["bytes"] for f in manifest["files"]
+        )
+        try:
+            with open(
+                os.path.join(active["logdir"], _META_FILE), "w"
+            ) as fh:
+                json.dump(manifest, fh)
+        except OSError:
+            pass  # listing degrades to the bare directory walk
+        return manifest
+
+    def _auto_stop(self, name: str) -> None:
+        """Deadline expiry: stop the capture IFF it is still the one
+        this timer was armed for (atomic inside _stop_expected)."""
+        try:
+            self._stop_expected(name)
+        except ProfilerConflict:
+            return  # lost the race to an operator stop — fine
+        with self._lock:
+            self.auto_stops += 1
+
+    # -- listing + retrieval -------------------------------------------------
+
+    def status(self) -> dict:
+        with self._lock:
+            active = dict(self._active) if self._active else None
+            stopping = self._stopping
+        return {
+            "active": active,
+            "stopping": stopping,
+            "capturesTotal": self.captures_total,
+            "autoStops": self.auto_stops,
+            "root": self.root,
+            "maxSeconds": self.max_seconds,
+            "maxCaptures": self.max_captures,
+        }
+
+    def list_captures(self) -> list[dict]:
+        """Every retained capture, oldest first, with file manifests."""
+        if not os.path.isdir(self.root):
+            return []
+        with self._lock:
+            active_name = (
+                self._active["name"] if self._active else None
+            )
+        out = []
+        for entry in sorted(os.listdir(self.root)):
+            logdir = os.path.join(self.root, entry)
+            if not os.path.isdir(logdir):
+                continue
+            doc = None
+            meta = os.path.join(logdir, _META_FILE)
+            if os.path.isfile(meta):
+                try:
+                    with open(meta) as fh:
+                        doc = json.load(fh)
+                except (OSError, ValueError):
+                    doc = None
+            if doc is None:
+                doc = {"name": entry,
+                       "files": _file_manifest(logdir)}
+                doc["totalBytes"] = sum(
+                    f["bytes"] for f in doc["files"]
+                )
+            doc["active"] = entry == active_name
+            out.append(doc)
+        return out
+
+    def capture(self, name: str) -> dict | None:
+        for doc in self.list_captures():
+            if doc["name"] == name:
+                return doc
+        return None
+
+    def read_file(self, name: str, rel_path: str) -> bytes:
+        """One capture artifact's bytes (the retrieval half of the
+        REST surface).  The resolved path must stay inside the
+        capture's directory — ``rel_path`` comes off the wire."""
+        if not _NAME_RE.fullmatch(name):
+            raise ProfilerError(f"invalid capture name {name!r}")
+        logdir = os.path.realpath(os.path.join(self.root, name))
+        target = os.path.realpath(os.path.join(logdir, rel_path))
+        if not target.startswith(logdir + os.sep):
+            raise ProfilerError(
+                f"file path {rel_path!r} escapes the capture"
+            )
+        try:
+            with open(target, "rb") as fh:
+                return fh.read()
+        except OSError:
+            # Plain not-found (→ 404), distinct from the traversal
+            # rejection above (→ 406): clients retrying after a stop
+            # must be able to tell the two apart.
+            raise ProfilerNotFound(
+                f"no file {rel_path!r} in capture {name!r}"
+            ) from None
+
+    def delete(self, name: str) -> bool:
+        """Drop a retained capture (idempotent).  The active capture
+        refuses — stop it first."""
+        if not _NAME_RE.fullmatch(name):
+            raise ProfilerError(f"invalid capture name {name!r}")
+        with self._lock:
+            if self._active is not None and \
+                    self._active["name"] == name:
+                raise ProfilerConflict(
+                    f"capture {name!r} is active; stop it before "
+                    "deleting"
+                )
+            if self._stopping:
+                # A stop's trace flush is in flight (the active slot
+                # is already cleared): deleting now would race the
+                # flush re-creating the dir with partial files.
+                raise ProfilerConflict(
+                    "a capture is stopping; retry shortly"
+                )
+        logdir = os.path.join(self.root, name)
+        if not os.path.isdir(logdir):
+            return False
+        shutil.rmtree(logdir, ignore_errors=True)
+        return True
+
+    def _prune_victims(self, keep: str) -> list[str]:
+        """Bounded capture dir: beyond ``max_captures`` (counting the
+        ADMITTED capture about to start), the OLDEST capture dirs are
+        the victims — newest evidence wins.  Selection only (the
+        caller deletes outside the lock); the new capture — and, for
+        safety, any active one — is never a victim."""
+        if not os.path.isdir(self.root):
+            return []
+        active_name = (
+            self._active["name"] if self._active else None
+        )
+        entries = []
+        for entry in os.listdir(self.root):
+            logdir = os.path.join(self.root, entry)
+            if entry in (keep, active_name) or not os.path.isdir(
+                logdir
+            ):
+                continue
+            try:
+                entries.append((os.path.getmtime(logdir), logdir))
+            except OSError:
+                continue
+        entries.sort()
+        excess = len(entries) - (self.max_captures - 1)
+        return [logdir for _mtime, logdir in entries[:max(0, excess)]]
+
+    def close(self) -> None:
+        """Server shutdown: end any active capture so the profiler
+        does not outlive the process's surface."""
+        with self._lock:
+            active = self._active is not None
+        if active:
+            try:
+                self.stop()
+            except ProfilerConflict:
+                pass
+
+
+def _file_manifest(logdir: str) -> list[dict]:
+    files = []
+    for dirpath, _dirs, names in os.walk(logdir):
+        for fname in names:
+            if fname == _META_FILE:
+                continue
+            path = os.path.join(dirpath, fname)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            files.append({
+                "path": os.path.relpath(path, logdir),
+                "bytes": size,
+            })
+    files.sort(key=lambda f: f["path"])
+    return files

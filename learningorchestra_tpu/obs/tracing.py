@@ -42,6 +42,7 @@ __all__ = [
     "record_span",
     "set_request_id",
     "reset_request_id",
+    "sampled",
     "span",
     "span_tree",
     "activate",
@@ -178,13 +179,32 @@ class JobTrace:
         }
 
 
+def sampled(basis: str, fraction: float) -> bool:
+    """Deterministic sampling decision for ``basis`` (a request id, or
+    the job name when the submission carried none): a retried request
+    samples the SAME way, so a drill re-running one request id either
+    always has its span tree or never does — no flaky traces."""
+    if fraction >= 1.0:
+        return True
+    if fraction <= 0.0:
+        return False
+    import zlib
+
+    return (zlib.crc32(basis.encode()) % 10_000) < fraction * 10_000
+
+
 def new_trace(job: str, request_id: str | None = None) -> JobTrace | None:
-    """A JobTrace sized from config, or None when tracing is off —
-    callers guard every later touch on that None."""
+    """A JobTrace sized from config, or None when tracing is off or
+    the LO_TPU_OBS_TRACE_SAMPLE decision excluded this job — callers
+    guard every later touch on that None (a sampled-out job keeps all
+    its metrics; only the persisted span tree is skipped)."""
     from learningorchestra_tpu.obs.metrics import get_registry
 
     registry = get_registry()
     if not registry.trace_enabled:
+        return None
+    if not sampled(request_id or job,
+                   getattr(registry, "trace_sample", 1.0)):
         return None
     return JobTrace(job, request_id, max_spans=registry.max_spans)
 

@@ -57,6 +57,13 @@ class Autoscaler:
         self._state: dict[str, dict] = {}
         self.ticks = 0
         self.decisions: collections.deque = collections.deque(maxlen=64)
+        # Decision LEDGER: every per-model evaluation — scale, hold,
+        # blocked — with the signal values and sustain counters it
+        # read (queue-frac, shed, p99).  ``decisions`` above keeps
+        # only the scale events; drills could see THAT the fleet
+        # moved but never WHY it held, so the ledger records the
+        # holds too.  Bounded ring; served under GET /serve/fleet.
+        self.ledger: collections.deque = collections.deque(maxlen=256)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -148,6 +155,9 @@ class Autoscaler:
                     st["down"] = 0
                     st["up"] += 1
                     if st["up"] >= self.cfg.up_ticks and not blocked:
+                        # The ledger must show the streak that
+                        # TRIGGERED the move, not the post-reset 0.
+                        triggered = st["up"]
                         st["up"] = 0
                         target = n + 1
                         reason = (
@@ -159,13 +169,41 @@ class Autoscaler:
                     st["up"] = 0
                     st["down"] += 1
                     if st["down"] >= self.cfg.down_ticks:
+                        triggered = st["down"]
                         st["down"] = 0
                         target = n - 1
                         reason = "idle"
                 else:
                     st["up"] = st["up"] if up_sig else 0
                     st["down"] = st["down"] if down_sig else 0
+                up_streak, down_streak = st["up"], st["down"]
+                if target > n and reason != "min":
+                    up_streak = triggered
+                elif target < n:
+                    down_streak = triggered
+            # Ledger entry for EVERY evaluation — the holds included:
+            # a drill reading GET /serve/fleet can see exactly which
+            # signal values and sustain counters produced (or
+            # withheld) each move.
+            record = {
+                "t": time.time(),
+                "tick": self.ticks,
+                "model": name,
+                "replicas": n,
+                "queueFrac": round(sig["queue_frac"], 4),
+                "shed": shed,
+                "served": served,
+                "p99Ms": sig["p99_ms"],
+                "upStreak": up_streak,
+                "downStreak": down_streak,
+                "blocked": blocked,
+                "action": "hold" if target == n
+                else ("up" if target > n else "down"),
+                "reason": reason or "hold",
+            }
             if target == n:
+                with self._lock:
+                    self.ledger.append(record)
                 continue
             try:
                 result = self._manager.scale(
@@ -189,6 +227,11 @@ class Autoscaler:
                     event="scale_up_blocked", model=name,
                     wanted=target, reason="lease_timeout",
                 ))
+                record["action"] = "blocked"
+                record["reason"] = "lease_timeout"
+                record["wanted"] = target
+                with self._lock:
+                    self.ledger.append(record)
                 continue
             decision = {
                 "t": time.time(),
@@ -200,8 +243,10 @@ class Autoscaler:
                 "shed": shed,
                 "p99Ms": sig["p99_ms"],
             }
+            record["to"] = result
             with self._lock:
                 self.decisions.append(decision)
+                self.ledger.append(record)
             made.append(decision)
         return made
 
@@ -226,4 +271,7 @@ class Autoscaler:
                     for name, st in self._state.items()
                 },
                 "decisions": list(self.decisions),
+                # The full per-evaluation ledger (holds included) —
+                # why the fleet moved, or didn't, each tick.
+                "ledger": list(self.ledger),
             }

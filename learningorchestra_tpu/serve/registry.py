@@ -30,7 +30,7 @@ class ServeError(Exception):
 class _Resident:
     __slots__ = (
         "name", "estimator", "params", "nbytes", "loaded_at", "requests",
-        "apply_fns", "replica_devices",
+        "apply_fns", "apply_costs", "replica_devices",
     )
 
     def __init__(self, name, estimator, params, nbytes):
@@ -45,6 +45,10 @@ class _Resident:
         # serving hot path); dies with the entry, so invalidation can
         # never serve a stale architecture's program.
         self.apply_fns: dict = {}
+        # bucket → ProgramCost (obs/costs.py), memoized beside the
+        # apply so the per-dispatch attribution hook never re-derives
+        # a fingerprint on the hot path.
+        self.apply_costs: dict = {}
         # replica index → device id ("host" when unplaced), mirrored
         # in by the fleet manager after every scale event — residency
         # listings show WHERE each model serves, not just that it is

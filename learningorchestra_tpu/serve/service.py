@@ -174,6 +174,7 @@ class ServingService:
         import jax.numpy as jnp
 
         from learningorchestra_tpu import faults
+        from learningorchestra_tpu.obs import costs as obs_costs
         from learningorchestra_tpu.train import compile_cache as cc
 
         # Chaos probe at the batch boundary: one injected failure
@@ -182,19 +183,35 @@ class ServingService:
         # batcher worker and later dispatches healthy.
         faults.hit("serve.apply")
         entry = self.registry.get(name)
-        apply = entry.apply_fns.get(padded.shape[0])
+        rows = padded.shape[0]
+        apply = entry.apply_fns.get(rows)
         if apply is None:
-            apply = entry.apply_fns[padded.shape[0]] = (
-                cc.get_cache().get_or_build(
-                    cc.apply_program_key(
-                        entry.estimator.module, rows=padded.shape[0]
-                    ),
-                    lambda: jax.jit(entry.estimator.module.apply),
-                    label=(
-                        f"serve:{type(entry.estimator.module).__name__}"
-                        f":b{padded.shape[0]}"
-                    ),
+            key = cc.apply_program_key(
+                entry.estimator.module, rows=rows
+            )
+            label = (
+                f"serve:{type(entry.estimator.module).__name__}"
+                f":b{rows}"
+            )
+
+            def builder():
+                from learningorchestra_tpu.train.neural import (
+                    _probe_program_cost,
                 )
+
+                jitted = jax.jit(entry.estimator.module.apply)
+                # Cost probe on the build-once path (the one shared
+                # wrapper, train/neural.py): the bucket's flops/HBM
+                # land in the program ledger, so every later dispatch
+                # attributes with real numerators.
+                _probe_program_cost(
+                    key, label, jitted,
+                    lambda: (entry.params, padded),
+                )
+                return jitted
+
+            apply = entry.apply_fns[rows] = (
+                cc.get_cache().get_or_build(key, builder, label=label)
             )
         if replica is not None:
             # Hand place() the HOST array: one host→replica-device
@@ -202,7 +219,52 @@ class ServingService:
             params, x = replica.place(entry, padded)
         else:
             params, x = entry.params, jnp.asarray(padded)
-        return apply(params, x)
+        if not obs_costs.enabled():
+            return apply(params, x)
+        # Per-dispatch device-time attribution, sampled: only a
+        # dispatch the stride selects pays the sync (the consumer
+        # blocks on the result right after, so steady-state throughput
+        # is unmoved; sampled-out dispatches keep jax's async
+        # pipelining).  Books the interval against the model and shape
+        # bucket — the fleet's replica dispatches land here too, so
+        # per-model ledgers cover single-path and fleet serving alike.
+        led = obs_costs.devtime()
+        weight = led.will_record(name)
+        if not weight:
+            return apply(params, x)
+        cost = self._apply_cost(entry, rows)
+        t0 = time.perf_counter()
+        out = apply(params, x)
+        try:
+            jax.block_until_ready(out)
+        except Exception:  # noqa: BLE001
+            pass
+        led.record_model(
+            weight, time.perf_counter() - t0,
+            cost.flops if cost is not None else None,
+            cost.bytes_accessed if cost is not None else None,
+            name, rows,
+        )
+        return out
+
+    @staticmethod
+    def _apply_cost(entry, rows: int):
+        """The (arch, bucket) ProgramCost for attribution, memoized on
+        the registry entry next to the apply itself.  A ledger MISS
+        memoizes too (False sentinel): analysis happens at build time,
+        before any dispatch, so a missing record stays missing — and
+        re-deriving the fingerprint per dispatch is exactly the hot-
+        path cost the memo exists to avoid."""
+        cost = entry.apply_costs.get(rows)
+        if cost is None:
+            from learningorchestra_tpu.obs import costs as obs_costs
+            from learningorchestra_tpu.train import compile_cache as cc
+
+            cost = obs_costs.get_ledger().get(
+                cc.apply_program_key(entry.estimator.module, rows=rows)
+            )
+            entry.apply_costs[rows] = cost if cost is not None else False
+        return cost or None
 
     def replica_dispatch_factory(self, name: str):
         """Per-replica dispatch binder for the fleet manager: same
@@ -377,6 +439,18 @@ class ServingService:
             "serving_resident_models": a["resident_models"],
             "serving_resident_bytes": a["resident_bytes"],
         }
+        # Cost-accounting scalars (obs/costs.py): attributed device
+        # seconds across served models, and achieved-vs-peak MFU when
+        # the operator configured the chip's peak FLOP/s.
+        try:
+            from learningorchestra_tpu.obs import costs as obs_costs
+
+            totals = obs_costs.serving_totals()
+            agg["serving_device_time_s"] = totals["deviceTimeS"]
+            if "mfu" in totals:
+                agg["serving_mfu"] = totals["mfu"]
+        except Exception:  # noqa: BLE001 — scalars must never fail
+            pass  # the monitoring poll
         with self._scalar_lock:
             for key, val in agg.items():
                 steps = self._scalar_history.setdefault(key, [])

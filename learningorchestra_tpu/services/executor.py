@@ -168,6 +168,7 @@ class ExecutorService:
 
         def run():
             from learningorchestra_tpu.jobs import engine as engine_mod
+            from learningorchestra_tpu.obs import costs as obs_costs
             from learningorchestra_tpu.obs import tracing as obs_tracing
             from learningorchestra_tpu.train import compile_cache
 
@@ -212,17 +213,22 @@ class ExecutorService:
                     # still that fresh fit, continued.
                     params["resume"] = True
             t0 = time.perf_counter()
-            if isinstance(instance, NeuralEstimator):
-                # On-device work: take a chip lease so concurrent
-                # neural jobs get placed, not interleaved (jobs/leases.py).
-                with self.ctx.leaser.lease(1, label=name) as devs:
-                    if devs:
-                        self.ctx.artifacts.metadata.update(
-                            name, {"leasedDevices": devs}
-                        )
+            # Device-time attribution scope (obs/costs.py): dispatches
+            # the body makes (the fit epoch loop) book against THIS
+            # job's ledger entry.
+            with obs_costs.job_scope(name):
+                if isinstance(instance, NeuralEstimator):
+                    # On-device work: take a chip lease so concurrent
+                    # neural jobs get placed, not interleaved
+                    # (jobs/leases.py).
+                    with self.ctx.leaser.lease(1, label=name) as devs:
+                        if devs:
+                            self.ctx.artifacts.metadata.update(
+                                name, {"leasedDevices": devs}
+                            )
+                        result = getattr(instance, method)(**params)
+                else:
                     result = getattr(instance, method)(**params)
-            else:
-                result = getattr(instance, method)(**params)
             fit_time = time.perf_counter() - t0
             if isinstance(instance, NeuralEstimator) and \
                     compile_cache.enabled():
@@ -246,6 +252,12 @@ class ExecutorService:
                 self.ctx.notify_artifact_changed(name)
                 extra = {"fitTime": fit_time,
                          "compileCache": cache_delta}
+                device_time = obs_costs.job_summary(name)
+                if device_time is not None:
+                    # Attributed device seconds/flops (and MFU when a
+                    # peak is configured) — cost accounting observable
+                    # from the ordinary GET/poll path.
+                    extra["deviceTime"] = device_time
                 hist = getattr(instance, "history", None)
                 if hist:
                     # Re-runs re-store the full history; drop the old
@@ -368,6 +380,9 @@ class ExecutorService:
                 from learningorchestra_tpu.jobs.leases import (
                     jax_device_for,
                 )
+                from learningorchestra_tpu.obs import (
+                    costs as obs_costs,
+                )
 
                 candidate = factory(**kwargs)
                 if isinstance(candidate, NeuralEstimator):
@@ -390,7 +405,11 @@ class ExecutorService:
                     dev = jax_device_for(devs[0]) if devs else None
                     place = jax.default_device(dev) \
                         if dev is not None else contextlib.nullcontext()
-                    with place:
+                    # Re-bind the job scope: trials run on pool
+                    # threads, which do not inherit the engine
+                    # thread's context — every candidate's epochs
+                    # still book against THIS tune job.
+                    with place, obs_costs.job_scope(name):
                         t0 = time.perf_counter()
                         getattr(candidate, method)(**fit_params)
                         fit_time = time.perf_counter() - t0
@@ -454,11 +473,17 @@ class ExecutorService:
             # so for an N-candidate same-arch sweep expect hits ≈ N-1
             # per program kind.  Concurrent unrelated jobs can inflate
             # the delta (process-wide counters).
-            return {
+            out = {
                 "bestScore": best_score,
                 "bestParams": _json_safe(best_combo),
                 "compileCache": compile_cache.delta_since(cache_before),
             }
+            from learningorchestra_tpu.obs import costs as obs_costs
+
+            device_time = obs_costs.job_summary(name)
+            if device_time is not None:
+                out["deviceTime"] = device_time
+            return out
 
         self.ctx.engine.submit(
             name, run, description=description or f"grid search {parent_name}",

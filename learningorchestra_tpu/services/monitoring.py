@@ -217,10 +217,23 @@ class MonitoringService:
         GET /monitoring/<tool>/compileCache so cache effectiveness
         (hit/miss/eviction/trace-time) is observable without shell
         access, alongside the per-job deltas the executor stamps into
-        finished-job metadata."""
+        finished-job metadata.  Each resident entry's byte charge
+        (measured vs fallback) rides in ``entries_detail``; the
+        per-program FLOPs/HBM records join in under ``programCosts``
+        (obs/costs.py)."""
         from learningorchestra_tpu.train import compile_cache
 
-        return compile_cache.get_cache().stats()
+        stats = compile_cache.get_cache().stats()
+        try:
+            from learningorchestra_tpu.obs import costs as obs_costs
+
+            if obs_costs.enabled():
+                stats["programCosts"] = (
+                    obs_costs.get_ledger().snapshot()
+                )
+        except Exception:  # noqa: BLE001 — cost listing must never
+            pass  # fail the monitoring poll
+        return stats
 
     def stop(self, nickname: str) -> bool:
         with self._lock:

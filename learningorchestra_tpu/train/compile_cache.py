@@ -77,6 +77,16 @@ def _faults():
     return faults
 
 
+def _costs():
+    """Lazy cost-ledger handle (obs/costs.py), same discipline: every
+    build notes a ProgramCost entry, and inserts charge the MEASURED
+    serialized size against the byte cap when an analysis produced
+    one."""
+    from learningorchestra_tpu.obs import costs
+
+    return costs
+
+
 # -- canonical fingerprinting -------------------------------------------------
 
 
@@ -278,13 +288,17 @@ def _record_compile_span(built_s: float, label, key: str) -> None:
 
 
 class _Entry:
-    __slots__ = ("value", "nbytes", "label", "built_s")
+    __slots__ = ("value", "nbytes", "label", "built_s", "measured")
 
-    def __init__(self, value, nbytes, label, built_s):
+    def __init__(self, value, nbytes, label, built_s,
+                 measured=False):
         self.value = value
         self.nbytes = nbytes
         self.label = label
         self.built_s = built_s
+        # True when nbytes is a MEASURED serialized size (obs/costs)
+        # rather than the flat per-entry fallback estimate.
+        self.measured = measured
 
 
 class CompiledProgramCache:
@@ -381,9 +395,9 @@ class CompiledProgramCache:
             t0 = time.perf_counter()
             _faults().hit("compile.build")
             value = builder()
-            _record_compile_span(
-                time.perf_counter() - t0, label, key
-            )
+            built_s = time.perf_counter() - t0
+            _record_compile_span(built_s, label, key)
+            self._note_cost(key, label, built_s)
             return value
         while True:
             with self._lock:
@@ -424,6 +438,15 @@ class CompiledProgramCache:
             raise
         built_s = time.perf_counter() - t0
         _record_compile_span(built_s, label, key)
+        self._note_cost(key, label, built_s)
+        measured = False
+        if nbytes is None:
+            # Real serialized size when the builder's cost analysis
+            # measured one (ROADMAP item 3's carried debt: the byte
+            # cap charged a flat 32 MiB per entry); the flat estimate
+            # survives only as the fallback for unanalyzed programs.
+            nbytes = self._measured_bytes(key)
+            measured = nbytes is not None
         with self._lock:
             ev = self._building.pop(key, None)
             self.misses += 1
@@ -434,6 +457,7 @@ class CompiledProgramCache:
                     self.entry_bytes if nbytes is None else int(nbytes),
                     label,
                     built_s,
+                    measured=measured,
                 )
                 self._entries.move_to_end(key)
                 self._evict_locked()
@@ -444,6 +468,23 @@ class CompiledProgramCache:
         if ev is not None:
             ev.set()
         return value
+
+    @staticmethod
+    def _note_cost(key: str, label, built_s: float) -> None:
+        """Every build — cached or not, analyzed or not — lands a
+        ProgramCost ledger entry (obs/costs.py).  Never fails a
+        build."""
+        try:
+            _costs().note_build(key, label, built_s)
+        except Exception:  # noqa: BLE001
+            pass
+
+    @staticmethod
+    def _measured_bytes(key: str):
+        try:
+            return _costs().serialized_bytes(key)
+        except Exception:  # noqa: BLE001
+            return None
 
     def contains(self, key: str) -> bool:
         with self._lock:
@@ -482,8 +523,24 @@ class CompiledProgramCache:
                 "coalesced": self.coalesced,
                 "deviceInvalidations": self.invalidations,
                 "traceTimeS": round(self.trace_time_s, 4),
+                "measuredEntries": sum(
+                    1 for e in self._entries.values() if e.measured
+                ),
                 "programs": [
                     e.label for e in self._entries.values() if e.label
+                ],
+                # Per-entry accounting: what each resident program
+                # charges the byte cap, and whether that charge is a
+                # measured serialized size or the flat fallback.
+                "entries_detail": [
+                    {
+                        "key": key[:12],
+                        "label": e.label,
+                        "bytes": e.nbytes,
+                        "measured": e.measured,
+                        "builtS": round(e.built_s, 4),
+                    }
+                    for key, e in self._entries.items()
                 ],
             }
 

@@ -538,13 +538,19 @@ def _cast_for(dtype):
 
 def _cached_program(
     kind: str, est, loss_kind, *, shapes=None, mesh=None, donate=None,
-    builder,
+    builder, cost_args=None, want_cost=False,
 ):
     """Fetch (or build-once) a jitted program through the process-wide
     compiled-program cache (train/compile_cache.py), keyed by the
     estimator's architecture/optimizer/loss/dtype spec plus whatever
     the builder bakes into the trace.  Repeat REST jobs and
-    same-architecture tune candidates skip tracing entirely."""
+    same-architecture tune candidates skip tracing entirely.
+
+    ``cost_args`` (a thunk returning example arguments) lets the
+    build-once path run XLA cost/memory analysis on the freshly built
+    program (obs/costs.py) — shape avatars only, nothing touches real
+    buffers.  ``want_cost=True`` returns ``(fn, ProgramCost | None)``
+    so dispatch sites can attribute device time with flops attached."""
     from learningorchestra_tpu.train import compile_cache as cc
 
     key = cc.program_key(
@@ -557,9 +563,77 @@ def _cached_program(
         mesh=mesh,
         donate=donate,
     )
-    return cc.get_cache().get_or_build(
-        key, builder, label=f"{kind}:{type(est.module).__name__}"
+    label = f"{kind}:{type(est.module).__name__}"
+    building = builder
+    if cost_args is not None:
+        def building():
+            fn = builder()
+            _probe_program_cost(key, label, fn, cost_args)
+            return fn
+
+    fn = cc.get_cache().get_or_build(key, building, label=label)
+    if not want_cost:
+        return fn
+    from learningorchestra_tpu.obs import costs as obs_costs
+
+    return fn, (
+        obs_costs.get_ledger().get(key)
+        if obs_costs.enabled() else None
     )
+
+
+def _probe_program_cost(key, label, fn, cost_args) -> None:
+    """Best-effort XLA cost analysis for a just-built program; a
+    failed probe (opaque callable, exotic arg tree) must never fail
+    the build it rides."""
+    from learningorchestra_tpu.obs import costs as obs_costs
+
+    if not obs_costs.enabled():
+        return
+    try:
+        obs_costs.analyze_jitted(key, label, fn, tuple(cost_args()))
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _attribute_epoch_cost(est, epoch_s: float) -> None:
+    """One epoch's device interval into the per-job device-time ledger
+    (the job identity rides the executor's ``costs.job_scope``)."""
+    from learningorchestra_tpu.obs import costs as obs_costs
+
+    if not obs_costs.enabled():
+        return
+    try:
+        obs_costs.attribute(
+            epoch_s, cost=getattr(est, "_device_epoch_cost", None)
+        )
+    except Exception:  # noqa: BLE001 — accounting never fails a fit
+        pass
+
+
+def _epoch_cost_attrs(est, epoch_s: float) -> dict:
+    """flops/bytes/MFU span annotations for one epoch, empty when the
+    program was never analyzed (CPU fallback, costs disabled)."""
+    from learningorchestra_tpu.obs import costs as obs_costs
+
+    cost = getattr(est, "_device_epoch_cost", None)
+    if cost is None or not getattr(cost, "analyzed", False):
+        return {}
+    attrs: dict = {}
+    if cost.flops is not None:
+        attrs["flops"] = cost.flops
+    if cost.bytes_accessed is not None:
+        attrs["bytesAccessed"] = cost.bytes_accessed
+    try:
+        util = obs_costs.mfu(
+            cost.flops or 0.0, epoch_s,
+            peak_flops=obs_costs.peak_flops(),
+        )
+    except Exception:  # noqa: BLE001
+        util = None
+    if util is not None:
+        attrs["mfu"] = util
+    return attrs
 
 
 def cached_fused_epochs(
@@ -728,6 +802,7 @@ class NeuralEstimator(Estimator):
         self._apply_fn = None
         self._device_epoch = None
         self._device_epoch_key = None
+        self._device_epoch_cost = None
         self._eval_loss_kind = None
 
     # -- keras-compile parity -------------------------------------------------
@@ -743,6 +818,7 @@ class NeuralEstimator(Estimator):
         self._eval_fn = None
         self._device_epoch = None
         self._device_epoch_key = None
+        self._device_epoch_cost = None
         self._opt_version = getattr(self, "_opt_version", 0) + 1
 
     def compile(self, optimizer=None, loss: str | None = None,
@@ -1023,7 +1099,7 @@ class NeuralEstimator(Estimator):
         epoch_key = (len(x), batch_size, bool(shuffle), loss_kind)
         if self._device_epoch_key != epoch_key:
             dtype = jnp.bfloat16 if self.compute_dtype == "bfloat16" else None
-            self._device_epoch = _cached_program(
+            self._device_epoch, self._device_epoch_cost = _cached_program(
                 "device_epoch", self, loss_kind,
                 shapes=(len(x), batch_size, bool(shuffle)),
                 builder=lambda: build_device_epoch(
@@ -1035,6 +1111,13 @@ class NeuralEstimator(Estimator):
                     batch_size=batch_size,
                     shuffle=bool(shuffle),
                 ),
+                # Shape avatars for the cost probe: the whole-epoch
+                # program's flops/HBM, measured once per build.
+                cost_args=lambda: (
+                    self.params, self.opt_state, x, y_arr,
+                    jax.random.PRNGKey(self.seed),
+                ),
+                want_cost=True,
             )
             self._device_epoch_key = epoch_key
         xs = jnp.asarray(x)
@@ -1084,6 +1167,13 @@ class NeuralEstimator(Estimator):
                     k: float(v) for k, v in jax.device_get(metrics).items()
                 }
                 metrics["epoch_time"] = time.perf_counter() - t0
+                # Device-time attribution (obs/costs.py): the metrics
+                # device_get above synced the dispatch, so epoch_time
+                # IS the device interval; the program's measured flops
+                # ride along, giving the per-job ledger (and the MFU
+                # gauge) real numerators.  One config check when the
+                # costs plane is off.
+                _attribute_epoch_cost(self, metrics["epoch_time"])
                 if validation_data is not None:
                     vx, vy = validation_data
                     vy = np.asarray(vy)
@@ -1098,10 +1188,14 @@ class NeuralEstimator(Estimator):
                     metrics.update({f"val_{k}": v for k, v in vmetrics.items()})
                 self.history.append(metrics)
                 # Trace span per epoch (train step + validation): the
-                # job's span tree shows exactly where fit time went.
-                # Single contextvar read when no trace is active.
+                # job's span tree shows exactly where fit time went —
+                # now annotated with the program's measured flops/bytes
+                # and achieved-vs-peak utilization, so a trace answers
+                # "what was the hardware doing" per epoch.  Single
+                # contextvar read when no trace is active.
                 obs_tracing.record_span(
-                    "epoch", time.perf_counter() - t0, epoch=epoch_i
+                    "epoch", time.perf_counter() - t0, epoch=epoch_i,
+                    **_epoch_cost_attrs(self, metrics["epoch_time"]),
                 )
                 if verbose:
                     _train_logger().info(
@@ -1472,24 +1566,27 @@ class NeuralEstimator(Estimator):
             # bounded by the bucket set, never by tail diversity.  Same
             # helper and discipline as the serving path (serve/).
             bucket = bucket_for(k, batch_size)
+            padded = jnp.asarray(
+                pad_rows(xb, bucket) if k != bucket else xb
+            )
             out = np.asarray(
-                self._apply_for(bucket)(
-                    self.params,
-                    jnp.asarray(pad_rows(xb, bucket) if k != bucket
-                                else xb),
+                self._apply_for(bucket, example=padded)(
+                    self.params, padded,
                 )
             )
             outs.append(out[:k] if k != bucket else out)
         return np.concatenate(outs, axis=0)
 
-    def _apply_for(self, rows: int):
+    def _apply_for(self, rows: int, example=None):
         """Cache-resolved jitted ``apply`` for a ``rows``-row input.
 
         Keyed through :func:`compile_cache.apply_program_key` —
         optimizer/loss play no part in inference, and ``rows`` is the
         shape-bucket dimension, so every predict job AND the serving
         path share one executable per (architecture, bucket) and the
-        cache's miss counter counts buckets, not calls."""
+        cache's miss counter counts buckets, not calls.  ``example``
+        (a bucket-shaped input) lets a first build run the cost probe
+        — the same ProgramCost the serving path attributes against."""
         fns = getattr(self, "_apply_fns", None)
         if fns is None:
             fns = self._apply_fns = {}
@@ -1497,10 +1594,20 @@ class NeuralEstimator(Estimator):
         if fn is None:
             from learningorchestra_tpu.train import compile_cache as cc
 
+            key = cc.apply_program_key(self.module, rows=rows)
+            label = f"apply:{type(self.module).__name__}:b{rows}"
+
+            def builder():
+                jitted = jax.jit(self.module.apply)
+                if example is not None and self.params is not None:
+                    _probe_program_cost(
+                        key, label, jitted,
+                        lambda: (self.params, example),
+                    )
+                return jitted
+
             fn = fns[rows] = cc.get_cache().get_or_build(
-                cc.apply_program_key(self.module, rows=rows),
-                lambda: jax.jit(self.module.apply),
-                label=f"apply:{type(self.module).__name__}:b{rows}",
+                key, builder, label=label
             )
         return fn
 
@@ -1587,6 +1694,7 @@ class NeuralEstimator(Estimator):
         d.pop("_apply_fns", None)  # per-bucket jitted applies
         d["_device_epoch"] = None
         d["_device_epoch_key"] = None
+        d["_device_epoch_cost"] = None
         d["params"] = jax.device_get(d["params"]) if d["params"] is not None \
             else None
         d["opt_state"] = jax.device_get(d["opt_state"]) \

@@ -1,0 +1,566 @@
+"""Cost-accounting & profiling plane (obs/costs.py, obs/profiling.py):
+per-program FLOPs/HBM ledger coverage for every compile-cache build,
+measured-size LRU accounting, MFU sanity on a real fit, device-time
+attribution through the serving path, the trace-sampling knob, the
+autoscaler decision ledger, and the profiler-capture REST round-trip
+on CPU JAX.
+"""
+
+import time
+
+import numpy as np
+import pytest
+import requests
+
+from learningorchestra_tpu.api import APIServer
+from learningorchestra_tpu.config import (
+    Config,
+    CostsConfig,
+    FleetConfig,
+    ServeConfig,
+)
+from learningorchestra_tpu.obs import costs, metrics as obs_metrics
+from learningorchestra_tpu.obs import tracing as obs_tracing
+from learningorchestra_tpu.train import compile_cache as cc
+
+PREFIX = "/api/learningOrchestra/v1"
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ledgers():
+    costs.reset()
+    yield
+    costs.reset()
+    # The compile cache is process-wide: a program this module built
+    # must not turn another module's identical fit into a cache hit
+    # (test_obs asserts a compile span on ITS train job).
+    cc.reset_cache()
+
+
+def _mk_estimator(hidden=10, num_classes=2):
+    # hidden=10 is deliberately unlike other modules' [8]: two layers
+    # of isolation against cross-module program-fingerprint overlap.
+    from learningorchestra_tpu.models.mlp import MLPClassifier
+
+    return MLPClassifier(
+        hidden_layer_sizes=[hidden], num_classes=num_classes
+    )
+
+
+def _tiny_fit(est=None, n=64, epochs=2, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 4))
+    y = rng.integers(0, 2, (n,))
+    est = est or _mk_estimator()
+    est.fit(x, y, epochs=epochs, batch_size=16)
+    return est, x
+
+
+# -- ledger coverage: every build records a ProgramCost -----------------------
+
+
+class TestCostLedger:
+    def test_every_compile_cache_build_records_a_program_cost(self):
+        """The acceptance gate: a build through the cache — analyzed
+        (cost_args provided) or not — lands a ledger entry."""
+        cache = cc.reset_cache()
+        try:
+            _tiny_fit()
+            stats = cache.stats()
+            assert stats["misses"] >= 2  # epoch program + eval program
+            ledger = costs.get_ledger()
+            for detail in stats["entries_detail"]:
+                # stats truncates keys to 12 chars; match by prefix.
+                matches = [
+                    p for p in ledger.snapshot()["programs"]
+                    if p["key"] == detail["key"]
+                ]
+                assert matches, (
+                    f"build {detail['label']!r} has no ProgramCost "
+                    "ledger entry"
+                )
+            # The device-epoch program was actually ANALYZED on this
+            # CPU backend: real flops/bytes, measured serialized size.
+            analyzed = [
+                p for p in ledger.snapshot()["programs"]
+                if p["analyzed"] and "device_epoch" in p["label"]
+            ]
+            assert analyzed, "device epoch program was not analyzed"
+            prog = analyzed[0]
+            assert prog["flops"] and prog["flops"] > 0
+            assert prog["bytesAccessed"] and prog["bytesAccessed"] > 0
+            assert prog["serializedBytes"] and \
+                prog["serializedBytes"] > 0
+        finally:
+            cc.reset_cache()
+
+    def test_disabled_builds_still_work_without_entries(self):
+        costs.reset(CostsConfig(enabled=False))
+        cache = cc.reset_cache()
+        try:
+            _tiny_fit()
+            assert cache.stats()["misses"] >= 1
+            assert costs.snapshot()["ledger"] == {}
+            # Flat fallback accounting everywhere.
+            for detail in cache.stats()["entries_detail"]:
+                assert detail["measured"] is False
+        finally:
+            cc.reset_cache()
+
+    def test_uncached_mode_still_notes_builds(self):
+        """max_entries<=0 (cache disabled) builds every lookup — each
+        one still records its ProgramCost."""
+        cache = cc.CompiledProgramCache(max_entries=0)
+        cache.get_or_build("k-direct", lambda: object(), label="direct")
+        assert costs.get_ledger().get("k-direct") is not None
+        assert costs.get_ledger().get("k-direct").builds == 1
+
+
+# -- measured sizes drive the byte cap ----------------------------------------
+
+
+class TestMeasuredSizeAccounting:
+    def test_measured_sizes_replace_flat_estimate(self):
+        cache = cc.reset_cache()
+        try:
+            _tiny_fit()
+            stats = cache.stats()
+            measured = [
+                d for d in stats["entries_detail"] if d["measured"]
+            ]
+            assert measured, "no entry charged a measured size"
+            assert stats["measuredEntries"] == len(measured)
+            for d in measured:
+                assert 0 < d["bytes"] < cache.entry_bytes
+            # bytesEstimate is the SUM of real charges, not
+            # entries * flat.
+            assert stats["bytesEstimate"] == sum(
+                d["bytes"] for d in stats["entries_detail"]
+            )
+        finally:
+            cc.reset_cache()
+
+    def test_real_sizes_drive_lru_eviction_ordering(self):
+        """With measured sizes, a byte cap admits many small programs
+        or few big ones — the flat estimate would evict at a fixed
+        count regardless.  Sizes injected through the real ledger
+        path (record_analysis), builds through the real cache."""
+        ledger = costs.get_ledger()
+        cache = cc.CompiledProgramCache(
+            max_entries=100, max_bytes=10_000, entry_bytes=32 << 20
+        )
+        # 8 small programs (1 KiB each) fit comfortably...
+        for i in range(8):
+            key = f"small-{i}"
+            ledger.record_analysis(key, key, serialized=1000)
+            cache.get_or_build(key, lambda: object(), label=key)
+        assert cache.stats()["entries"] == 8
+        assert cache.evictions == 0
+        # ...then one big (9 KB) program forces the OLDEST smalls out
+        # until the measured total fits again.
+        ledger.record_analysis("big", "big", serialized=9000)
+        cache.get_or_build("big", lambda: object(), label="big")
+        stats = cache.stats()
+        assert cache.evictions > 0
+        assert stats["bytesEstimate"] <= 10_000
+        assert cache.contains("big")
+        # LRU order: the survivors are the NEWEST smalls.
+        assert not cache.contains("small-0")
+        assert cache.contains(f"small-7")
+        # Control: under flat accounting every entry would charge
+        # 32 MiB and the first insert would already exceed the cap —
+        # measured accounting is what admitted 8 + 1 programs.
+        assert 9 * (32 << 20) > 10_000
+
+    def test_unmeasured_entries_fall_back_to_flat_estimate(self):
+        cache = cc.CompiledProgramCache(
+            max_entries=10, max_bytes=1 << 30, entry_bytes=12345
+        )
+        cache.get_or_build("nope", lambda: object(), label="nope")
+        detail = cache.stats()["entries_detail"][0]
+        assert detail["bytes"] == 12345
+        assert detail["measured"] is False
+
+
+# -- device-time attribution + MFU --------------------------------------------
+
+
+class TestDeviceTimeAttribution:
+    def test_mfu_gauge_sanity_on_a_tiny_fit(self):
+        """With a configured per-chip peak, a real CPU fit's MFU is a
+        real number in (0, 1] — tiny models on generous peaks land
+        near 0, never above 1, never negative."""
+        costs.reset(CostsConfig(peak_flops=1e12))
+        cc.reset_cache()
+        try:
+            with costs.job_scope("fit-job"):
+                _tiny_fit(epochs=3)
+            summary = costs.job_summary("fit-job")
+            assert summary is not None
+            assert summary["dispatches"] == 3  # one per epoch
+            assert summary["deviceTimeS"] > 0
+            assert summary["flops"] > 0
+            assert 0 < summary["mfu"] <= 1.0
+        finally:
+            cc.reset_cache()
+
+    def test_unknown_peak_reports_no_mfu(self):
+        with costs.job_scope("nopeak"):
+            _tiny_fit()
+        summary = costs.job_summary("nopeak")
+        assert summary is not None and "mfu" not in summary
+
+    def test_sampling_stride_is_deterministic_and_unbiased(self):
+        led = costs.DeviceTimeLedger(max_jobs=8, sample=0.25)
+        for _ in range(100):
+            led.attribute(0.01, flops=100, job="j")
+        doc = led.job_summary("j")
+        # Every 4th dispatch records at weight 4: totals match the
+        # full stream exactly.
+        assert doc["dispatches"] == 100
+        assert doc["flops"] == pytest.approx(100 * 100)
+        assert doc["deviceTimeS"] == pytest.approx(1.0)
+        # sample=0 disables recording entirely.
+        led0 = costs.DeviceTimeLedger(sample=0.0)
+        assert led0.will_record() == 0
+        assert not led0.attribute(1.0, job="j")
+
+    def test_per_key_stride_avoids_cross_stream_aliasing(self):
+        """Strictly alternating dispatches from two models at stride
+        2: each stream thins on its OWN counter, so both models keep
+        their full (weight-scaled) share — a single global counter
+        would sample one model always and the other never."""
+        led = costs.DeviceTimeLedger(sample=0.5)
+        for _ in range(10):
+            led.attribute(0.01, flops=10, model="a", bucket=1)
+            led.attribute(0.01, flops=10, model="b", bucket=1)
+        snap = led.snapshot()
+        assert snap["models"]["a"]["dispatches"] == 10
+        assert snap["models"]["b"]["dispatches"] == 10
+        assert snap["models"]["a"]["flops"] == pytest.approx(100)
+        assert snap["models"]["b"]["flops"] == pytest.approx(100)
+
+    def test_model_ring_is_bounded_with_buckets(self):
+        led = costs.DeviceTimeLedger(sample=1.0, max_models=3)
+        for i in range(8):
+            led.attribute(0.001, model=f"m{i}", bucket=16)
+        snap = led.snapshot()
+        assert len(snap["models"]) == 3 and "m7" in snap["models"]
+        # An evicted model's bucket entries die with it.
+        assert set(snap["buckets"]) == {
+            "m5:16", "m6:16", "m7:16",
+        }
+
+    def test_job_ring_is_bounded(self):
+        led = costs.DeviceTimeLedger(max_jobs=4, sample=1.0)
+        for i in range(10):
+            led.attribute(0.001, job=f"job-{i}")
+        snap = led.snapshot()
+        assert len(snap["jobs"]) == 4
+        assert "job-9" in snap["jobs"] and "job-0" not in snap["jobs"]
+
+
+# -- trace sampling knob ------------------------------------------------------
+
+
+class TestTraceSampling:
+    def test_deterministic_per_request_id(self):
+        # The same basis always decides the same way at a given rate.
+        for rid in ("req-a", "req-b", "req-c"):
+            first = obs_tracing.sampled(rid, 0.5)
+            assert all(
+                obs_tracing.sampled(rid, 0.5) == first
+                for _ in range(5)
+            )
+        assert obs_tracing.sampled("anything", 1.0)
+        assert not obs_tracing.sampled("anything", 0.0)
+        # At 50%, a spread of ids lands on both sides.
+        decisions = {
+            obs_tracing.sampled(f"req-{i}", 0.5) for i in range(64)
+        }
+        assert decisions == {True, False}
+
+    def test_sampled_out_jobs_skip_span_trees_keep_metrics(self):
+        registry = obs_metrics.reset_registry(
+            enabled=True, trace_enabled=True, trace_sample=0.0
+        )
+        try:
+            assert obs_tracing.new_trace("j", "some-req") is None
+            # Metrics still record: sampling gates SPANS only.
+            counter = registry.counter("sampled_total", labels=("k",))
+            counter.inc(k="v")
+            snap = registry.snapshot()["sampled_total"]["series"]
+            assert snap and snap[0]["value"] == 1
+        finally:
+            obs_metrics.reset_registry()
+
+    def test_full_rate_still_traces(self):
+        obs_metrics.reset_registry(
+            enabled=True, trace_enabled=True, trace_sample=1.0
+        )
+        try:
+            assert obs_tracing.new_trace("j", "some-req") is not None
+        finally:
+            obs_metrics.reset_registry()
+
+
+# -- autoscaler decision ledger -----------------------------------------------
+
+
+class TestAutoscalerDecisionLedger:
+    def test_holds_and_scales_record_signals(self):
+        from learningorchestra_tpu.jobs.leases import DeviceLeaser
+        from learningorchestra_tpu.serve.fleet import (
+            Autoscaler,
+            ReplicaSet,
+        )
+
+        class _StubManager:
+            def __init__(self, rs):
+                self.rs = rs
+
+            def sets_snapshot(self):
+                return [(self.rs.name, self.rs)]
+
+            def scale(self, name, n, *, reason):
+                return self.rs.scale_to(n, reason=reason)
+
+        leaser = DeviceLeaser(["tpu:0", "tpu:1"])
+        rs = ReplicaSet(
+            "m", ServeConfig(max_batch=4, max_queue=16, flush_ms=0.5),
+            leaser, lambda replica: (lambda padded: padded),
+            min_replicas=1, max_replicas=2,
+        )
+        rs.scale_to(1, reason="ensure")
+        scaler = Autoscaler(
+            _StubManager(rs),
+            FleetConfig(interval_s=0.0, up_queue_frac=0.1,
+                        up_ticks=2, down_ticks=2),
+        )
+        try:
+            # Idle ticks: the ledger records HOLD decisions with the
+            # signal values read — the satellite's whole point (today
+            # only resulting counters were visible).
+            scaler.tick()
+            status = scaler.status()
+            assert status["ledger"], "no ledger entry for a hold tick"
+            hold = status["ledger"][-1]
+            assert hold["action"] == "hold"
+            assert hold["model"] == "m"
+            for field in ("queueFrac", "shed", "p99Ms", "upStreak",
+                          "downStreak", "replicas", "t", "tick"):
+                assert field in hold, f"ledger missing {field}"
+            # Sustained queue pressure: the scale decision lands in the
+            # ledger too, with action/reason/to.
+            rs.sheds += 1  # a shed this tick is an immediate up-signal
+            scaler.tick()
+            rs.sheds += 1
+            scaler.tick()
+            entries = scaler.status()["ledger"]
+            ups = [e for e in entries if e["action"] == "up"]
+            assert ups, f"no scale-up recorded: {entries}"
+            assert ups[-1]["reason"] == "shed"
+            assert ups[-1]["to"] == 2
+            # The record shows the streak that TRIGGERED the move
+            # (up_ticks=2), not the post-reset zero.
+            assert ups[-1]["upStreak"] == 2
+            # The ledger is served under GET /serve/fleet via
+            # Autoscaler.status() — shape-checked here; the REST
+            # passthrough is FleetManager.snapshot()["autoscaler"].
+            assert isinstance(status["ledger"], list)
+        finally:
+            rs.close()
+
+
+# -- REST: profiler capture + cost endpoint -----------------------------------
+
+
+@pytest.fixture(scope="class")
+def api(tmp_path_factory):
+    obs_metrics.reset_registry()
+    tmp = tmp_path_factory.mktemp("costs_api")
+    cfg = Config()
+    cfg.store.root = str(tmp / "store")
+    cfg.store.volume_root = str(tmp / "volumes")
+    cfg.profiling.max_captures = 3
+    server = APIServer(cfg)
+    port = server.start_background()
+    base = f"http://127.0.0.1:{port}{PREFIX}"
+    yield base, server
+    server.shutdown()
+    obs_metrics.reset_registry()
+
+
+def wait_finished(base, name, timeout=120):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        meta = requests.get(
+            f"{base}/observe/{name}", params={"timeout": 5},
+            timeout=30,
+        ).json()["metadata"]
+        if meta.get("finished"):
+            return meta
+        if meta.get("jobState") == "failed":
+            raise AssertionError(f"job failed: {meta.get('exception')}")
+    raise AssertionError(f"timeout waiting for {name}")
+
+
+class TestProfileRest:
+    def test_start_stop_roundtrip_produces_nonempty_capture(self, api):
+        base, _server = api
+        resp = requests.post(
+            f"{base}/observability/profile/start",
+            json={"name": "drill", "maxSeconds": 30},
+        )
+        assert resp.status_code == 201, resp.text
+        assert resp.json()["capture"]["name"] == "drill"
+        status = requests.get(
+            f"{base}/observability/profile"
+        ).json()
+        assert status["active"]["name"] == "drill"
+
+        # Device work under the capture so the trace has content.
+        import jax
+        import jax.numpy as jnp
+
+        jax.jit(lambda a: (a @ a.T).sum())(
+            jnp.ones((64, 64))
+        ).block_until_ready()
+
+        resp = requests.post(
+            f"{base}/observability/profile/stop", json={}
+        )
+        assert resp.status_code == 200, resp.text
+        manifest = resp.json()["capture"]
+        assert manifest["name"] == "drill"
+        assert manifest["files"], "capture produced no files on CPU"
+        assert manifest["totalBytes"] > 0
+
+        # Listed artifact...
+        captures = requests.get(
+            f"{base}/observability/profile/captures"
+        ).json()["captures"]
+        drill = next(c for c in captures if c["name"] == "drill")
+        assert drill["totalBytes"] > 0 and not drill["active"]
+        # ...and retrievable bytes.
+        path = drill["files"][0]["path"]
+        blob = requests.get(
+            f"{base}/observability/profile/captures/drill",
+            params={"file": path},
+        )
+        assert blob.status_code == 200
+        assert len(blob.content) == drill["files"][0]["bytes"]
+        # Path traversal rejected.
+        assert requests.get(
+            f"{base}/observability/profile/captures/drill",
+            params={"file": "../../etc/passwd"},
+        ).status_code == 406
+
+    def test_double_start_409_and_stop_idle_409(self, api):
+        base, _server = api
+        resp = requests.post(
+            f"{base}/observability/profile/start",
+            json={"name": "first"},
+        )
+        assert resp.status_code == 201, resp.text
+        dup = requests.post(
+            f"{base}/observability/profile/start",
+            json={"name": "second"},
+        )
+        assert dup.status_code == 409
+        assert "already active" in dup.json()["error"]
+        assert requests.post(
+            f"{base}/observability/profile/stop", json={}
+        ).status_code == 200
+        idle = requests.post(
+            f"{base}/observability/profile/stop", json={}
+        )
+        assert idle.status_code == 409
+
+    def test_capture_dir_is_bounded(self, api):
+        base, server = api
+        for i in range(5):  # max_captures=3
+            assert requests.post(
+                f"{base}/observability/profile/start",
+                json={"name": f"bound-{i}"},
+            ).status_code == 201
+            requests.post(
+                f"{base}/observability/profile/stop", json={}
+            )
+        names = [
+            c["name"] for c in requests.get(
+                f"{base}/observability/profile/captures"
+            ).json()["captures"]
+        ]
+        assert len(names) <= 3
+        assert "bound-4" in names  # newest evidence wins
+
+    def test_costs_endpoint_and_prom_families_after_serving(self, api):
+        """Train → serve → predict through REST: the costs endpoint
+        and /metrics.prom carry the lo_program_* and device-time
+        families (the acceptance criterion's exposition half)."""
+        base, _server = api
+        resp = requests.post(f"{base}/model/tensorflow", json={
+            "modelName": "costs_mlp",
+            "modulePath": "learningorchestra_tpu.models.mlp",
+            "class": "MLPClassifier",
+            "classParameters": {
+                "hidden_layer_sizes": [8], "num_classes": 2,
+            },
+        })
+        assert resp.status_code == 201, resp.text
+        wait_finished(base, "costs_mlp")
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((48, 4)).tolist()
+        y = rng.integers(0, 2, (48,)).tolist()
+        resp = requests.post(f"{base}/train/tensorflow", json={
+            "name": "costs_fit", "parentName": "costs_mlp",
+            "method": "fit",
+            "methodParameters": {
+                "x": x, "y": y, "epochs": 2, "batch_size": 16,
+            },
+        })
+        assert resp.status_code == 201, resp.text
+        meta = wait_finished(base, "costs_fit")
+        # Per-job device-time summary in the finished metadata.
+        assert meta["deviceTime"]["dispatches"] >= 2
+        assert meta["deviceTime"]["deviceTimeS"] > 0
+
+        assert requests.post(
+            f"{base}/serve/costs_fit/load", json={}
+        ).status_code == 200
+        resp = requests.post(
+            f"{base}/serve/costs_fit/predict",
+            json={"instances": x[:4]},
+        )
+        assert resp.status_code == 200, resp.text
+
+        doc = requests.get(f"{base}/observability/costs").json()
+        assert doc["enabled"]
+        labels = [p["label"] for p in doc["ledger"]["programs"]]
+        assert any("device_epoch" in lab for lab in labels)
+        assert any(lab.startswith("serve:") for lab in labels)
+        assert doc["deviceTime"]["jobs"]["costs_fit"]["flops"] > 0
+        assert doc["deviceTime"]["models"]["costs_fit"][
+            "dispatches"] >= 1
+        assert doc["deviceTime"]["buckets"], "no per-bucket entry"
+
+        text = requests.get(f"{base}/metrics.prom").text
+        for family in (
+            "lo_program_flops",
+            "lo_program_bytes_accessed",
+            "lo_program_serialized_bytes",
+            "lo_program_analyses_total",
+            "lo_device_time_seconds_total",
+            "lo_job_device_seconds",
+            "lo_model_device_seconds",
+            "lo_serving_bucket_device_seconds",
+            "lo_compile_cache_measured_entries",
+        ):
+            assert family in text, f"missing family {family}"
+        # The monitoring endpoint's per-entry cost listing.
+        cc_stats = requests.get(
+            f"{base}/monitoring/tensorflow/compileCache"
+        ).json()
+        assert cc_stats["entries_detail"]
+        assert cc_stats["programCosts"]["programs"]

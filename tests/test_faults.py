@@ -570,6 +570,38 @@ class TestHttpChaos:
         assert requests.delete(f"{base}/faults").status_code == 200
         assert not requests.get(f"{base}/faults").json()["enabled"]
 
+    def test_profile_start_under_injected_error_leaks_no_lock(
+            self, chaos_api):
+        """Profile-capture chaos drill: an injected http.handler error
+        on POST /observability/profile/start fires BEFORE the handler
+        claims the single-capture lock, so the failed request must
+        not leave a phantom active capture behind — the retry starts
+        cleanly, and stop round-trips."""
+        _, base, _ = chaos_api
+        faults.arm("http.handler", "error", max_triggers=1)
+        resp = requests.post(
+            f"{base}/observability/profile/start",
+            json={"name": "chaos_prof"},
+        )
+        assert resp.status_code == 500
+        assert "injected fault" in resp.json()["error"]
+        # No leaked lock: the capture never started.
+        status = requests.get(
+            f"{base}/observability/profile"
+        ).json()
+        assert status["active"] is None
+        # The very next start succeeds and the round-trip completes.
+        resp = requests.post(
+            f"{base}/observability/profile/start",
+            json={"name": "chaos_prof"},
+        )
+        assert resp.status_code == 201, resp.text
+        resp = requests.post(
+            f"{base}/observability/profile/stop", json={}
+        )
+        assert resp.status_code == 200, resp.text
+        assert resp.json()["capture"]["name"] == "chaos_prof"
+
     def test_trigger_counters_export_to_prometheus(self, chaos_api):
         _, base, _ = chaos_api
         faults.arm("http.handler", "delay", delay_ms=1, max_triggers=1)
