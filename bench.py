@@ -892,6 +892,130 @@ def _costs_probe() -> dict:
     }
 
 
+def _slo_probe() -> dict:
+    """Rollup/SLO-plane probe: what the time dimension costs, as
+    tight-loop best-of SUBSYSTEM numbers (the ROADMAP bench caveat).
+
+    The plane touches the serving hot path at exactly ONE point — the
+    per-model predict-latency histogram observation in
+    ``ServingService.predict`` — so that is the per-dispatch number
+    the <1% acceptance bound applies to.  The rollup tick and the
+    alert evaluation run on the daemon's own clock (every
+    ``LO_TPU_ROLLUP_TICK_S``, default 10 s), never per request; their
+    cost is banked raw plus amortized against the tick interval (the
+    fraction of one core the daemon consumes).
+
+    The registry is populated to a realistic working set first (HTTP
+    routes, job classes, serving series) — an empty-registry tick
+    would flatter every number.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from learningorchestra_tpu.config import RollupConfig, SLOConfig
+    from learningorchestra_tpu.obs import metrics as obs_metrics
+    from learningorchestra_tpu.obs import rollup as obs_rollup
+    from learningorchestra_tpu.obs import slo as obs_slo
+    from learningorchestra_tpu.serve.batcher import MicroBatcher
+
+    tight = _tight_best_of
+
+    try:
+        reg = obs_metrics.reset_registry()
+        # Representative registry: 12 routes x 2 status classes with
+        # latency histograms, 4 job classes, 2 served models.
+        http_total = reg.counter(
+            "lo_http_requests_total", "b", labels=("route", "status")
+        )
+        http_hist = reg.histogram(
+            "lo_http_request_duration_seconds", "b", labels=("route",)
+        )
+        for i in range(12):
+            http_total.inc(500, route=f"GET /r{i}", status="2xx")
+            http_total.inc(3, route=f"GET /r{i}", status="5xx")
+            for v in (0.002, 0.02, 0.2):
+                http_hist.observe(v, route=f"GET /r{i}")
+        jobs_total = reg.counter(
+            "lo_jobs_total", "b", labels=("job_class", "state")
+        )
+        for cls in ("train", "tune", "predict", "default"):
+            jobs_total.inc(40, job_class=cls, state="finished")
+            jobs_total.inc(1, job_class=cls, state="failed")
+        predict_hist = reg.histogram(
+            "lo_serving_predict_duration_seconds", "b",
+            labels=("model",),
+        )
+        for model in ("m0", "m1"):
+            for v in (0.001, 0.004, 0.05):
+                predict_hist.observe(v, model=model)
+
+        tick_s_default = RollupConfig().tick_s
+        engine = obs_rollup.reset_engine(
+            RollupConfig(tick_s=0.0)  # manual tick; thread off
+        )
+        service = obs_slo.reset_service(SLOConfig())
+        engine.tick()  # warm: series created, SLO instances minted
+
+        # One full tick = snapshot ingest + SLO evaluation riding it.
+        tick_us = tight(engine.tick, m=300, reps=5) * 1e6
+        # Alert evaluation alone (every objective x instance).
+        eval_us = tight(
+            lambda: service.evaluate(engine), m=500, reps=5
+        ) * 1e6
+        # The ONLY per-dispatch hook this plane adds — measured in
+        # its real call shape (serve.service._predict_hist: registry
+        # identity check + observe).
+        from learningorchestra_tpu.serve.service import _PredictHist
+
+        hook = _PredictHist()
+        hook.observe(0.004, "m0")  # warm the handle
+        observe_ns = tight(lambda: hook.observe(0.004, "m0")) * 1e9
+
+        # Denominator: the same real single-row serving dispatch the
+        # costs probe uses.
+        from learningorchestra_tpu.models.mlp import MLPClassifier
+
+        est = MLPClassifier(hidden_layer_sizes=[128], num_classes=4)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((64, 64)).astype(np.float32)
+        est.fit(x, rng.integers(0, 4, (64,)), epochs=1, batch_size=64)
+        apply = jax.jit(est.module.apply)
+        batcher = MicroBatcher(
+            lambda padded: apply(est.params, jnp.asarray(padded)),
+            max_batch=64, max_queue=256, flush_ms=0.0, name="bench",
+        )
+        row = x[:1]
+        try:
+            batcher.submit(row)  # warm the bucket-1 executable
+            dispatch_us = tight(
+                lambda: batcher.submit(row), m=300, reps=5
+            ) * 1e6
+        finally:
+            batcher.close()
+    finally:
+        obs_rollup.reset_engine()
+        obs_slo.reset_service()
+        obs_metrics.reset_registry()
+
+    return {
+        "rollup_tick_us": round(tick_us, 2),
+        "slo_eval_us": round(eval_us, 2),
+        "predict_observe_ns": round(observe_ns, 1),
+        "serving_dispatch_us": round(dispatch_us, 2),
+        # The per-dispatch acceptance bound: the predict histogram
+        # observation is the plane's only hot-path addition.
+        "per_dispatch_share_pct": round(
+            observe_ns / 1e3 / dispatch_us * 100.0, 3
+        ),
+        # Daemon duty cycle at the default tick interval: the
+        # fraction of one core the rollup+SLO clock consumes.
+        "tick_duty_cycle_pct": round(
+            tick_us / (tick_s_default * 1e6) * 100.0, 4
+        ),
+    }
+
+
 def _fleet_probe(
     n_requests: int = 384,
     concurrency: int = 16,
@@ -1160,6 +1284,10 @@ def _tpu_suite_child_main() -> None:
         suite["_costs"] = _costs_probe()
     except Exception as exc:  # noqa: BLE001 — record, don't hide
         suite["_costs"] = f"FAILED: {exc!r}"
+    try:
+        suite["_slo"] = _slo_probe()
+    except Exception as exc:  # noqa: BLE001 — record, don't hide
+        suite["_slo"] = f"FAILED: {exc!r}"
     print(json.dumps(suite))
 
 
@@ -1177,6 +1305,7 @@ def main() -> None:
         faults_probe = suite.pop("_faults", None)
         fleet_probe = suite.pop("_fleet", None)
         costs_probe = suite.pop("_costs", None)
+        slo_probe = suite.pop("_slo", None)
         throughput, extra = _assemble_tpu(suite)
         extra.update(flash)
         if cache_probe is not None:
@@ -1191,6 +1320,8 @@ def main() -> None:
             extra["fleet"] = fleet_probe
         if costs_probe is not None:
             extra["costs"] = costs_probe
+        if slo_probe is not None:
+            extra["slo"] = slo_probe
     else:
         _force_cpu()  # record a CPU number rather than hang the driver
         import jax
@@ -1230,6 +1361,10 @@ def main() -> None:
             extra["costs"] = _costs_probe()
         except Exception as exc:  # noqa: BLE001 — record, don't hide
             extra["costs"] = f"FAILED: {exc!r}"
+        try:
+            extra["slo"] = _slo_probe()
+        except Exception as exc:  # noqa: BLE001 — record, don't hide
+            extra["slo"] = f"FAILED: {exc!r}"
 
     metric = f"mnist_cnn_train_samples_per_sec_per_chip_{platform}"
     prior = _prior_best(metric, allow_cross_backend=platform == "tpu")

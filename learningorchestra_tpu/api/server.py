@@ -226,6 +226,18 @@ class APIServer:
             max_seconds=prof.max_seconds,
             max_captures=prof.max_captures,
         )
+        # Windowed rollups + SLO burn-rate alerting (obs/rollup.py,
+        # obs/slo.py): process-wide singletons sized from THIS
+        # server's config when it is the first to construct them
+        # (mirroring the registry); the engine daemon snapshots
+        # selected registry families each tick and the SLO service
+        # evaluates its objectives on the same clock.
+        from learningorchestra_tpu.obs import rollup as obs_rollup
+        from learningorchestra_tpu.obs import slo as obs_slo
+
+        self.rollup = obs_rollup.ensure_engine(self.config.rollup)
+        self.slo = obs_slo.ensure_service(self.config.slo)
+        self.rollup.start()
         # Unified observability (obs/): push metrics for the HTTP
         # layer, pull collectors over every subsystem's existing stats,
         # rendered at GET /metrics.prom.  The legacy JSON endpoints
@@ -1557,6 +1569,47 @@ class APIServer:
 
         add("GET", rf"/observability/jobs/{NAME}/trace", job_trace)
 
+        # ---- Windowed time-series rollups (obs/rollup.py) ----
+        # The in-process time dimension: counter rates, gauge
+        # min/avg/max and histogram-delta quantiles over the rollup
+        # rings.  Query: ?name=<family>&windowS=<s>&points=<n> plus
+        # any other key as a label filter (e.g. &model=mnist,
+        # &route=POST+/serve/...); no name lists the tracked
+        # families.
+        def timeseries_view(m, body, query):
+            name = query.get("name")
+            try:
+                window_s = float(query.get("windowS", 300.0))
+                max_points = int(query.get("points", 0))
+            except (TypeError, ValueError):
+                raise ValidationError(
+                    "windowS/points must be numeric"
+                ) from None
+            labels = {
+                k: v for k, v in query.items()
+                if k not in ("name", "windowS", "points")
+            }
+            return 200, self.rollup.timeseries(
+                name, labels or None, window_s=window_s,
+                max_points=max_points,
+            )
+
+        add("GET", r"/observability/timeseries", timeseries_view)
+
+        # ---- SLO objectives + burn-rate alerts (obs/slo.py) ----
+        # /alerts is the drill surface: pending/firing/resolved state
+        # per (objective, instance) with the burn rates that produced
+        # it; /slo is the objective/budget view.  Both mirror onto
+        # /metrics.prom (lo_alert_active, lo_slo_burn_rate).
+        add(
+            "GET", r"/observability/alerts",
+            lambda m, b, q: (200, self.slo.alerts()),
+        )
+        add(
+            "GET", r"/observability/slo",
+            lambda m, b, q: (200, self.slo.status()),
+        )
+
         # ---- On-demand profiler capture (obs/profiling.py) ----
         # start/stop wrap jax.profiler around a LIVE process: capture
         # a device trace while production traffic runs, list the
@@ -1991,7 +2044,8 @@ class APIServer:
         # -- serving: registry residency + batcher aggregates (the
         # same roll-up the tfevents snapshot uses — ONE aggregation,
         # serve/service.py aggregate()) ------------------------------
-        agg = self.serving.aggregate()
+        sstats = self.serving.stats()
+        agg = self.serving.aggregate(sstats)
         fams.append(
             Family(
                 "gauge", "lo_serving_resident_models",
@@ -2031,6 +2085,18 @@ class APIServer:
         for q, val in agg["quantiles"].items():
             slat.sample(val, quantile=q)
         fams.append(slat)
+        if sstats["models"]:
+            # Per-model queue depth (fleet replicas summed): the
+            # series the rollup engine tracks and the autoscaler's
+            # growth-slope trigger fits against.  Cardinality <= the
+            # registry's max_models cap.
+            mdepth = Family(
+                "gauge", "lo_serving_model_queue_depth",
+                "Rows queued per served model (replicas summed).",
+            )
+            for model, mstats in sstats["models"].items():
+                mdepth.sample(mstats["queueDepth"], model=model)
+            fams.append(mdepth)
 
         # -- fleet: per-replica attribution.  Cardinality is bounded
         # by construction (models <= registry max_models, replicas <=
@@ -2119,6 +2185,13 @@ class APIServer:
                 "1 when a standby fenced this store, else 0.",
             ).sample(1 if is_fenced(root) is not None else 0)
         )
+
+        # -- rollup engine health + SLO burn/alert mirror -------------
+        try:
+            fams += self.rollup.prom_families()
+            fams += self.slo.prom_families()
+        except Exception:  # noqa: BLE001 — the mirror must never
+            pass  # take down the whole exposition
         return fams
 
     def _collect_cost_families(self, obs_costs) -> list:
@@ -2621,6 +2694,11 @@ class APIServer:
         # collector so scrapes never touch a closed context.
         if self._obs_registry is not None:
             self._obs_registry.remove_collector(self._collect_families)
+        # Stop the rollup/SLO clock: a demoted or stopped node must
+        # not keep evaluating objectives over frozen windows (or
+        # paging a webhook).  The singleton survives — a later
+        # APIServer's construction re-arms the daemon.
+        self.rollup.stop()
         httpd, self._httpd = self._httpd, None
         if httpd is not None:
             httpd.shutdown()

@@ -870,6 +870,42 @@ class _Observability:
         the server configured its chips' peak FLOP/s)."""
         return self.ctx.request("GET", "/observability/costs")
 
+    # -- windowed rollups + SLO alerting --------------------------------
+
+    def timeseries(self, name: str | None = None,
+                   window_s: float | None = None,
+                   points: int | None = None,
+                   **labels) -> dict:
+        """GET /observability/timeseries — the rollup engine's
+        windowed view of one registry family: raw ring points plus
+        the derived rate (counters), min/avg/max + slope (gauges) or
+        bucket-delta quantiles (histograms).  Label kwargs filter
+        series (``timeseries("lo_serving_model_queue_depth",
+        model="mnist")``); no ``name`` lists the tracked families."""
+        query: dict = dict(labels)
+        if name is not None:
+            query["name"] = name
+        if window_s is not None:
+            query["windowS"] = window_s
+        if points is not None:
+            query["points"] = points
+        return self.ctx.request(
+            "GET", "/observability/timeseries", query=query
+        )
+
+    def alerts(self) -> dict:
+        """GET /observability/alerts — live SLO alert states
+        (pending/firing/resolved) with the burn rates that produced
+        them, plus the bounded transition history and the evaluation
+        config."""
+        return self.ctx.request("GET", "/observability/alerts")
+
+    def slo(self) -> dict:
+        """GET /observability/slo — the declarative objectives with
+        their targets, error budgets, live fast/slow burn rates and
+        budget remaining per instance."""
+        return self.ctx.request("GET", "/observability/slo")
+
     # -- on-demand profiler capture -------------------------------------
 
     def profile_start(self, name: str | None = None,

@@ -179,6 +179,14 @@ class FleetConfig:
     # Scale-down after this many consecutive empty-queue ticks.
     # Env: LO_TPU_FLEET_DOWN_TICKS.
     down_ticks: int = 5
+    # Queue-depth GROWTH-SLOPE scale-up trigger (rows/second), fitted
+    # by least squares over the shared rollup series
+    # (lo_serving_model_queue_depth, obs/rollup.py) — reacts to a ramp
+    # before the level crosses up_queue_frac.  0 = off; needs the
+    # rollup engine enabled and ticking.  Env: LO_TPU_FLEET_UP_SLOPE /
+    # LO_TPU_FLEET_SLOPE_WINDOW_S.
+    up_slope: float = 0.0
+    slope_window_s: float = 30.0
     # Chip-lease budget when placing a new replica; on timeout the
     # scale-up is skipped and retried next tick.
     # Env: LO_TPU_FLEET_LEASE_TIMEOUT_S.
@@ -222,6 +230,82 @@ class ObsConfig:
         1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
         250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0, 30000.0,
     )
+
+
+@dataclasses.dataclass
+class RollupConfig:
+    """Windowed time-series rollups (obs/rollup.py): a daemon that
+    snapshots selected registry families on a fixed tick into bounded
+    ring buffers and derives windowed views — counter rates, gauge
+    min/avg/max, histogram-delta quantiles — served at
+    ``GET /observability/timeseries``.  Env knobs: LO_TPU_ROLLUP_*."""
+
+    # Master switch: off, no snapshots are taken, the timeseries
+    # endpoint answers empty, and SLO evaluation (which reads rollup
+    # windows) is implicitly off too.  Env: LO_TPU_ROLLUP_ENABLED.
+    enabled: bool = True
+    # Snapshot cadence; <= 0 disables the daemon thread (tick() stays
+    # callable — tests drive the schedule deterministically).
+    # Env: LO_TPU_ROLLUP_TICK_S.
+    tick_s: float = 10.0
+    # Ring length per series: points * tick_s is the retention window
+    # (defaults: 360 x 10 s = 1 h, covering the SLO slow window).
+    # Env: LO_TPU_ROLLUP_POINTS.
+    points: int = 360
+    # Total tracked series across families; past it, NEW series are
+    # dropped (counted, surfaced) instead of growing memory unbounded.
+    # Env: LO_TPU_ROLLUP_MAX_SERIES.
+    max_series: int = 2048
+    # Extra family names to track on top of the built-in core set
+    # (HTTP counters/latency, job states, queue depths, predict
+    # latency).  Env: LO_TPU_ROLLUP_FAMILIES (comma-separated).
+    families: tuple = ()
+
+
+@dataclasses.dataclass
+class SLOConfig:
+    """Declarative SLO objectives + multi-window burn-rate alerting
+    over the rollup series (obs/slo.py): route availability, per-model
+    predict latency, job success rate — each with an error budget, a
+    pending → firing → resolved alert state machine
+    (``GET /observability/alerts``), ``lo_alert_active`` /
+    ``lo_slo_burn_rate`` Prometheus families, and a pluggable sink
+    (structured log line always; webhook POST when ``webhook`` is
+    set).  Env knobs: LO_TPU_SLO_*."""
+
+    # Master switch for evaluation; the rollup engine keeps ticking
+    # when off (timeseries remain queryable).  Env: LO_TPU_SLO_ENABLED.
+    enabled: bool = True
+    # Route availability objective: 1 - target is the 5xx error
+    # budget over the slow window.  Env: LO_TPU_SLO_AVAILABILITY.
+    availability_target: float = 0.999
+    # Per-model predict latency objective: at least predict_target of
+    # predicts complete under predict_p99_ms.  0 ms disables the
+    # objective.  Env: LO_TPU_SLO_PREDICT_P99_MS /
+    # LO_TPU_SLO_PREDICT_TARGET.
+    predict_p99_ms: float = 250.0
+    predict_target: float = 0.99
+    # Job success objective: finished / (finished + failed + deadline)
+    # over the window.  Env: LO_TPU_SLO_JOB_SUCCESS.
+    job_success_target: float = 0.99
+    # Multi-window burn-rate evaluation: an alert needs the burn rate
+    # over BOTH windows above ``burn_threshold`` (fast catches the
+    # page-now spike, slow stops a brief blip from paging).  Scaled
+    # down by tests so drills run in seconds.
+    # Env: LO_TPU_SLO_FAST_S / LO_TPU_SLO_SLOW_S / LO_TPU_SLO_BURN.
+    fast_window_s: float = 300.0
+    slow_window_s: float = 3600.0
+    burn_threshold: float = 14.4
+    # Alert state machine dwell times: a breach is ``pending`` until
+    # it holds for ``for_s``, then ``firing``; a firing alert resolves
+    # after ``resolve_s`` breach-free seconds.
+    # Env: LO_TPU_SLO_FOR_S / LO_TPU_SLO_RESOLVE_S.
+    for_s: float = 60.0
+    resolve_s: float = 300.0
+    # Webhook sink URL (POSTed JSON on firing/resolved transitions).
+    # Empty = webhook delivery off (the default — the structured log
+    # sink still records every transition).  Env: LO_TPU_SLO_WEBHOOK.
+    webhook: str = ""
 
 
 @dataclasses.dataclass
@@ -395,6 +479,10 @@ class Config:
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
+    rollup: RollupConfig = dataclasses.field(
+        default_factory=RollupConfig
+    )
+    slo: SLOConfig = dataclasses.field(default_factory=SLOConfig)
     costs: CostsConfig = dataclasses.field(default_factory=CostsConfig)
     profiling: ProfilingConfig = dataclasses.field(
         default_factory=ProfilingConfig
@@ -512,6 +600,12 @@ class Config:
             cfg.fleet.down_ticks = int(env["LO_TPU_FLEET_DOWN_TICKS"])
         if "LO_TPU_FLEET_UP_P99_MS" in env:
             cfg.fleet.up_p99_ms = float(env["LO_TPU_FLEET_UP_P99_MS"])
+        if "LO_TPU_FLEET_UP_SLOPE" in env:
+            cfg.fleet.up_slope = float(env["LO_TPU_FLEET_UP_SLOPE"])
+        if "LO_TPU_FLEET_SLOPE_WINDOW_S" in env:
+            cfg.fleet.slope_window_s = float(
+                env["LO_TPU_FLEET_SLOPE_WINDOW_S"]
+            )
         if "LO_TPU_FLEET_LEASE_TIMEOUT_S" in env:
             cfg.fleet.lease_timeout_s = float(
                 env["LO_TPU_FLEET_LEASE_TIMEOUT_S"]
@@ -549,6 +643,65 @@ class Config:
             cfg.obs.trace_sample = _fraction_env(
                 "LO_TPU_OBS_TRACE_SAMPLE"
             )
+        if "LO_TPU_ROLLUP_ENABLED" in env:
+            cfg.rollup.enabled = _bool_env("LO_TPU_ROLLUP_ENABLED")
+        if "LO_TPU_ROLLUP_TICK_S" in env:
+            cfg.rollup.tick_s = float(env["LO_TPU_ROLLUP_TICK_S"])
+        if "LO_TPU_ROLLUP_POINTS" in env:
+            cfg.rollup.points = int(env["LO_TPU_ROLLUP_POINTS"])
+        if "LO_TPU_ROLLUP_MAX_SERIES" in env:
+            cfg.rollup.max_series = int(
+                env["LO_TPU_ROLLUP_MAX_SERIES"]
+            )
+        if "LO_TPU_ROLLUP_FAMILIES" in env:
+            cfg.rollup.families = tuple(
+                tok.strip()
+                for tok in env["LO_TPU_ROLLUP_FAMILIES"].split(",")
+                if tok.strip()
+            )
+        if "LO_TPU_SLO_ENABLED" in env:
+            cfg.slo.enabled = _bool_env("LO_TPU_SLO_ENABLED")
+        if "LO_TPU_SLO_AVAILABILITY" in env:
+            cfg.slo.availability_target = _fraction_env(
+                "LO_TPU_SLO_AVAILABILITY"
+            )
+        if "LO_TPU_SLO_PREDICT_P99_MS" in env:
+            cfg.slo.predict_p99_ms = float(
+                env["LO_TPU_SLO_PREDICT_P99_MS"]
+            )
+        if "LO_TPU_SLO_PREDICT_TARGET" in env:
+            cfg.slo.predict_target = _fraction_env(
+                "LO_TPU_SLO_PREDICT_TARGET"
+            )
+        if "LO_TPU_SLO_JOB_SUCCESS" in env:
+            cfg.slo.job_success_target = _fraction_env(
+                "LO_TPU_SLO_JOB_SUCCESS"
+            )
+        if "LO_TPU_SLO_FAST_S" in env:
+            cfg.slo.fast_window_s = float(env["LO_TPU_SLO_FAST_S"])
+        if "LO_TPU_SLO_SLOW_S" in env:
+            cfg.slo.slow_window_s = float(env["LO_TPU_SLO_SLOW_S"])
+        if "LO_TPU_SLO_BURN" in env:
+            cfg.slo.burn_threshold = float(env["LO_TPU_SLO_BURN"])
+        if "LO_TPU_SLO_FOR_S" in env:
+            cfg.slo.for_s = float(env["LO_TPU_SLO_FOR_S"])
+        if "LO_TPU_SLO_RESOLVE_S" in env:
+            cfg.slo.resolve_s = float(env["LO_TPU_SLO_RESOLVE_S"])
+        if "LO_TPU_SLO_WEBHOOK" in env:
+            cfg.slo.webhook = env["LO_TPU_SLO_WEBHOOK"].strip()
+        # A target of 1.0 has a ZERO error budget — burn rate would
+        # divide by zero on the first bad event.  Reject loudly at
+        # boot, like the fleet bounds.
+        for knob, value in (
+            ("LO_TPU_SLO_AVAILABILITY", cfg.slo.availability_target),
+            ("LO_TPU_SLO_PREDICT_TARGET", cfg.slo.predict_target),
+            ("LO_TPU_SLO_JOB_SUCCESS", cfg.slo.job_success_target),
+        ):
+            if value >= 1.0:
+                raise ValueError(
+                    f"{knob}={value!r} leaves no error budget — SLO "
+                    "targets must be < 1.0"
+                )
         if "LO_TPU_COSTS_ENABLED" in env:
             cfg.costs.enabled = _bool_env("LO_TPU_COSTS_ENABLED")
         if "LO_TPU_COSTS_DEEP" in env:

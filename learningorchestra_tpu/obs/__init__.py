@@ -1,7 +1,8 @@
 """Unified observability layer: metrics registry, Prometheus
 exposition (obs/metrics.py), end-to-end job tracing (obs/tracing.py),
-cost accounting / device-time attribution (obs/costs.py) and
-on-demand profiler capture (obs/profiling.py).
+cost accounting / device-time attribution (obs/costs.py), on-demand
+profiler capture (obs/profiling.py), windowed time-series rollups
+(obs/rollup.py) and SLO burn-rate alerting (obs/slo.py).
 
 One coherent surface over what previously lived on four disjoint JSON
 endpoints: ``GET /metrics.prom`` exposes every subsystem's counters

@@ -35,6 +35,7 @@ Knobs (config.py ObsConfig, env ``LO_TPU_OBS_*``):
 
 from __future__ import annotations
 
+import bisect
 import math
 import threading
 from typing import Callable, Iterable, Sequence
@@ -103,8 +104,14 @@ class _Metric:
     def _key(self, labels: dict):
         """Label dict → series key, collapsing into the overflow
         series past the registry's cardinality cap.  Caller holds the
-        registry lock."""
-        key = tuple(str(labels.get(n, "")) for n in self.labelnames)
+        registry lock.  Hand-rolled loop, no genexpr, type-checked
+        str() skip: this runs on every observation of every hot-path
+        metric (HTTP dispatch, predict latency)."""
+        vals = []
+        for n in self.labelnames:
+            v = labels.get(n, "")
+            vals.append(v if type(v) is str else str(v))
+        key = tuple(vals)
         if key in self._series:
             return key
         if len(self._series) >= self.registry.max_series:
@@ -151,6 +158,26 @@ class Gauge(_Metric):
                 self._series[key] = float(value)
 
 
+class _BoundHistogram:
+    """One pre-resolved histogram series: label → key resolution paid
+    ONCE at bind time, so a hot path (one predict = one observe) pays
+    lock + dict-get + bisect and nothing else."""
+
+    __slots__ = ("metric", "key")
+
+    def __init__(self, metric: "Histogram", key):
+        self.metric = metric
+        self.key = key
+
+    def observe(self, value: float) -> None:
+        metric = self.metric
+        reg = metric.registry
+        if not reg.enabled:
+            return
+        with reg.lock:
+            metric._observe_key(self.key, value)
+
+
 class Histogram(_Metric):
     """Cumulative-bucket histogram (Prometheus semantics): per series
     stores per-bucket counts plus sum/count; render emits cumulative
@@ -171,20 +198,33 @@ class Histogram(_Metric):
         if not reg.enabled:
             return
         with reg.lock:
-            key = self._key(labels)
-            state = self._series.get(key)
-            if state is None:
-                state = self._series[key] = {
-                    "counts": [0] * len(self.buckets),
-                    "sum": 0.0,
-                    "count": 0,
-                }
-            for i, edge in enumerate(self.buckets):
-                if value <= edge:
-                    state["counts"][i] += 1
-                    break
-            state["sum"] += value
-            state["count"] += 1
+            self._observe_key(self._key(labels), value)
+
+    def _observe_key(self, key, value: float) -> None:
+        """The ONE series-update body (observe() and every bound
+        handle share it).  Caller holds the registry lock."""
+        state = self._series.get(key)
+        if state is None:
+            state = self._series[key] = {
+                "counts": [0] * len(self.buckets),
+                "sum": 0.0,
+                "count": 0,
+            }
+        # First edge >= value, binary-searched: this sits on the
+        # predict hot path (one call per request).
+        i = bisect.bisect_left(self.buckets, value)
+        if i < len(self.buckets):
+            state["counts"][i] += 1
+        state["sum"] += value
+        state["count"] += 1
+
+    def bind(self, **labels) -> _BoundHistogram:
+        """Resolve one series' key now and return a
+        :class:`_BoundHistogram` that observes without per-call label
+        resolution.  The cardinality cap applies at bind time (a
+        bound overflow series stays collapsed)."""
+        with self.registry.lock:
+            return _BoundHistogram(self, self._key(labels))
 
 
 class Family:
@@ -296,6 +336,70 @@ class MetricsRegistry:
                 out[name] = {"kind": metric.kind, "series": series}
         return out
 
+    def collect_all(self, names=None) -> list:
+        """Unified sample view over push metrics AND pull collectors —
+        the surface the rollup engine (obs/rollup.py) snapshots each
+        tick.  Returns one dict per series::
+
+            {"name", "kind", "labels": {...}, "value": float}        # scalar
+            {"name", "kind": "histogram", "labels": {...},
+             "edges": (...), "cum": (...), "sum": s, "count": n}     # cum
+                                                                     # incl +Inf
+
+        ``names`` (a set/sequence) filters to those families —
+        collectors still all run (they emit whole family groups), but
+        only matching samples return.  Histogram bucket counts come
+        back CUMULATIVE (Prometheus ``le`` semantics) so windowed
+        quantiles derive from plain point-to-point deltas."""
+        if not self.enabled:
+            return []
+        wanted = set(names) if names is not None else None
+        out: list = []
+        with self.lock:
+            metrics = list(self._metrics.values())
+            collectors = list(self._collectors)
+            for metric in metrics:
+                if wanted is not None and metric.name not in wanted:
+                    continue
+                for key, state in metric._series.items():
+                    labels = metric._labels_of(key)
+                    if metric.kind == "histogram":
+                        cum, total = [], 0
+                        for n in state["counts"]:
+                            total += n
+                            cum.append(total)
+                        cum.append(state["count"])  # +Inf bucket
+                        out.append({
+                            "name": metric.name, "kind": "histogram",
+                            "labels": labels,
+                            "edges": metric.buckets,
+                            "cum": tuple(cum),
+                            "sum": state["sum"],
+                            "count": state["count"],
+                        })
+                    else:
+                        out.append({
+                            "name": metric.name, "kind": metric.kind,
+                            "labels": labels, "value": float(state),
+                        })
+        # Collectors run OUTSIDE the lock (same contract as
+        # render_prometheus: exposition cost must never stall a
+        # hot-path observe, and a collector may itself take locks).
+        for collector in collectors:
+            try:
+                families = list(collector())
+            except Exception:  # noqa: BLE001 — one bad collector must
+                continue  # not take down the snapshot
+            for fam in families:
+                if wanted is not None and fam.name not in wanted:
+                    continue
+                for labels, value in fam.samples:
+                    out.append({
+                        "name": fam.name, "kind": fam.kind,
+                        "labels": dict(labels), "value": float(value),
+                    })
+        return out
+
     # -- exposition -----------------------------------------------------------
 
     def _render_family(self, lines, kind, name, help_text, samples):
@@ -401,8 +505,16 @@ _registry_lock = threading.Lock()
 
 
 def get_registry() -> MetricsRegistry:
-    """The process-wide registry, sized from config (LO_TPU_OBS_*)."""
+    """The process-wide registry, sized from config (LO_TPU_OBS_*).
+
+    Lock-free fast path: the singleton read is a single atomic load
+    (hot-path instrumentation — HTTP dispatch, predict latency —
+    resolves the registry per call), with the lock taken only to
+    build it."""
     global _registry
+    reg = _registry
+    if reg is not None:
+        return reg
     with _registry_lock:
         if _registry is None:
             from learningorchestra_tpu.config import get_config

@@ -193,7 +193,7 @@ class DistributedTrainer:
 
     # -- step construction --------------------------------------------------
 
-    def _build(self, loss_kind: str, shuffle: bool):
+    def _build(self, loss_kind: str, shuffle: bool, cost_args=None):
         est = self.estimator
         dtype = jnp.bfloat16 if est.compute_dtype == "bfloat16" else None
         # Same jitted loss/grad/update math as the single-device path
@@ -212,7 +212,13 @@ class DistributedTrainer:
         # module field, so their fingerprint shifts with the binding.
         from learningorchestra_tpu.train.neural import _cached_program
 
-        return _cached_program(
+        # ``cost_args`` (a shape-avatar thunk, see _cost_args below)
+        # rides the build-once path into the cost plane (obs/costs.py)
+        # so mesh programs land ANALYZED FLOPs/HBM ledger entries like
+        # the single-device epoch programs, instead of the un-analyzed
+        # fallback rows get_or_build notes on its own; ``want_cost``
+        # hands the entry back for per-epoch device-time attribution.
+        fns, cost = _cached_program(
             "resident_epoch_fns", est, loss_kind,
             shapes=(bool(shuffle),),
             mesh=(
@@ -228,16 +234,61 @@ class DistributedTrainer:
                 shuffle=shuffle,
                 donate=True,
             ),
+            cost_args=cost_args,
+            want_cost=True,
         )
+        # Same attribute the single-device fit uses, so the shared
+        # span/ledger helpers (_attribute_epoch_cost,
+        # _epoch_cost_attrs) see mesh fits identically.  Kept on the
+        # trainer too: the fit loop re-stamps the estimator each
+        # epoch, so an interleaved single-device fit can't leave its
+        # own program's entry attributed to mesh epochs.
+        self._epoch_cost = est._device_epoch_cost = cost
+        return fns
 
-    def _ensure_fns(self, loss_kind: str, shuffle: bool) -> None:
+    def _cost_args(self, x, y_arr, batch_size: int):
+        """Shape-avatar thunk for the epoch program's cost probe:
+        epoch(params, opt_state, xs, ys, ms, key) argument shapes,
+        computed WITHOUT batching or placing anything (eval_shape for
+        the moments, _batch_data's shape math for the epoch arrays).
+        Lowering is global/unsharded — the ledger entry carries the
+        whole mesh's per-epoch FLOPs, cross-shard collectives
+        excluded."""
+        import math as _math
+
+        def thunk():
+            est = self.estimator
+            n = x.shape[0]
+            nb = max(1, _math.ceil(n / batch_size))
+            xs = jax.ShapeDtypeStruct(
+                (nb, batch_size) + tuple(x.shape[1:]), x.dtype
+            )
+            ys = jax.ShapeDtypeStruct(
+                (nb, batch_size) + tuple(y_arr.shape[1:]), y_arr.dtype
+            )
+            ms = jax.ShapeDtypeStruct((nb, batch_size), np.float32)
+            opt_state = est.opt_state
+            if opt_state is None:
+                # Avatars only — nothing allocates.
+                opt_state = jax.eval_shape(
+                    est.optimizer.init, est.params
+                )
+            return (
+                est.params, opt_state, xs, ys, ms,
+                jax.random.PRNGKey(est.seed),
+            )
+
+        return thunk
+
+    def _ensure_fns(self, loss_kind: str, shuffle: bool,
+                    cost_args=None) -> None:
         # _opt_version (not id(optimizer)): object ids can be reused
         # after GC, which would silently serve a stale compiled step.
         key = (loss_kind, bool(shuffle),
                getattr(self.estimator, "_opt_version", 0))
         if self._epoch_fn is None or self._fn_key != key:
             self._epoch_fn, self._eval_fn = self._build(
-                loss_kind, bool(shuffle)
+                loss_kind, bool(shuffle), cost_args=cost_args
             )
             self._fn_key = key
             self._loss_kind = loss_kind
@@ -366,7 +417,10 @@ class DistributedTrainer:
             with self._mesh_bound():
                 if est.params is None:
                     est._init_params(jnp.asarray(x[:1]))
-                self._ensure_fns(loss_kind, shuffle)
+                self._ensure_fns(
+                    loss_kind, shuffle,
+                    cost_args=self._cost_args(x, y_arr, batch_size),
+                )
 
                 params, opt_state = self._place_state()
                 if checkpoint_dir and resume:
@@ -416,6 +470,20 @@ class DistributedTrainer:
                     dt = time.perf_counter() - t0
                     metrics["epoch_time"] = dt
                     metrics["samples_per_sec"] = n_samples / dt
+                    # Device-time attribution + flops/MFU span attrs
+                    # through the SAME helpers as the single-device
+                    # fit (the cost probe above stamped the mesh
+                    # program's ledger entry on the estimator).
+                    from learningorchestra_tpu.train.neural import (
+                        _attribute_epoch_cost,
+                        _epoch_cost_attrs,
+                    )
+
+                    est._device_epoch_cost = getattr(
+                        self, "_epoch_cost", None
+                    )
+                    _attribute_epoch_cost(est, dt)
+                    epoch_cost_attrs = _epoch_cost_attrs(est, dt)
                     if validation_data is not None:
                         vx, vy = validation_data
                         metrics.update(
@@ -437,6 +505,7 @@ class DistributedTrainer:
                     obs_tracing.record_span(
                         "epoch", time.perf_counter() - t0,
                         epoch=epoch_i, distributed=True,
+                        **epoch_cost_attrs,
                     )
                     # Callbacks run before the checkpoint decision so an
                     # early stop still gets its "final epoch" save —

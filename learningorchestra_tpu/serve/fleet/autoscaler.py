@@ -13,8 +13,12 @@ idle — see ``ReplicaSet.signals``.)  The decisions:
 - **scale up** when the fleet-wide queue fraction holds above
   ``LO_TPU_FLEET_UP_QUEUE_FRAC`` for ``LO_TPU_FLEET_UP_TICKS``
   consecutive ticks, when requests were SHED (any new 429 overflow is
-  by definition saturation), or — optionally — when p99 latency
-  crosses ``LO_TPU_FLEET_UP_P99_MS``;
+  by definition saturation), when p99 latency crosses
+  ``LO_TPU_FLEET_UP_P99_MS`` (optional), or — optionally — when the
+  model's queue depth GROWS faster than ``LO_TPU_FLEET_UP_SLOPE``
+  rows/second, least-squares-fitted over the shared rollup series
+  (``lo_serving_model_queue_depth``, obs/rollup.py) so a ramp scales
+  BEFORE the level crosses the queue-frac threshold;
 - **scale down** after ``LO_TPU_FLEET_DOWN_TICKS`` consecutive
   empty-queue ticks, draining the victim's batcher before its chip
   lease returns to the pool (training jobs queued on the leaser get
@@ -101,6 +105,7 @@ class Autoscaler:
             self.ticks += 1
         for name, rs in self._manager.sets_snapshot():
             sig = rs.signals()
+            slope = self._queue_slope(name)
             with self._lock:
                 st = self._state.setdefault(
                     name, {"up": 0, "down": 0,
@@ -113,6 +118,17 @@ class Autoscaler:
                     "requests", sig["requests"]
                 )
                 st["requests"] = sig["requests"]
+                # Growth-slope trigger: the queue is RAMPING even if
+                # its level is still under the frac threshold — the
+                # rate-of-change controller the decision ledger's
+                # signal history was recorded to justify.  Gated on
+                # traffic this tick like p99 (a stale rollup window
+                # must not scale an idle fleet).
+                slope_sig = (
+                    self.cfg.up_slope > 0 and slope is not None
+                    and served > 0
+                    and slope >= self.cfg.up_slope
+                )
                 up_sig = (
                     sig["queue_frac"] >= self.cfg.up_queue_frac
                     or shed > 0
@@ -122,6 +138,7 @@ class Autoscaler:
                     # would hold an idle fleet at max forever.
                     or (self.cfg.up_p99_ms > 0 and served > 0
                         and sig["p99_ms"] >= self.cfg.up_p99_ms)
+                    or slope_sig
                 )
                 # "Idle" means NO traffic since the last tick, not an
                 # instantaneously empty queue: under steady load the
@@ -163,7 +180,12 @@ class Autoscaler:
                         reason = (
                             "shed" if shed > 0 else
                             "queue" if sig["queue_frac"]
-                            >= self.cfg.up_queue_frac else "p99"
+                            >= self.cfg.up_queue_frac else
+                            "p99" if (
+                                self.cfg.up_p99_ms > 0
+                                and sig["p99_ms"]
+                                >= self.cfg.up_p99_ms
+                            ) else "slope"
                         )
                 elif down_sig and n > rs.min_replicas:
                     st["up"] = 0
@@ -194,6 +216,12 @@ class Autoscaler:
                 "shed": shed,
                 "served": served,
                 "p99Ms": sig["p99_ms"],
+                # Queue-depth growth rate (rows/s) from the shared
+                # rollup series; None while the rollup engine has too
+                # few points (or is disabled) to fit one.
+                "queueSlope": (
+                    round(slope, 4) if slope is not None else None
+                ),
                 "upStreak": up_streak,
                 "downStreak": down_streak,
                 "blocked": blocked,
@@ -250,6 +278,22 @@ class Autoscaler:
             made.append(decision)
         return made
 
+    def _queue_slope(self, name: str) -> float | None:
+        """This model's queue-depth growth rate (rows/second) from the
+        SHARED rollup series — the same windowed view the timeseries
+        endpoint serves, not a private re-sample.  ``None`` when the
+        rollup engine is disabled, hasn't two points yet, or the
+        query fails (the autoscaler must never die on an obs hiccup)."""
+        try:
+            from learningorchestra_tpu.obs.rollup import get_engine
+
+            return get_engine().slope(
+                "lo_serving_model_queue_depth", {"model": name},
+                self.cfg.slope_window_s,
+            )
+        except Exception:  # noqa: BLE001
+            return None
+
     def forget(self, name: str) -> None:
         """Drop a dissolved model's streak state (manager drop path)."""
         with self._lock:
@@ -265,6 +309,8 @@ class Autoscaler:
                 "upTicks": self.cfg.up_ticks,
                 "downTicks": self.cfg.down_ticks,
                 "upP99Ms": self.cfg.up_p99_ms,
+                "upSlope": self.cfg.up_slope,
+                "slopeWindowS": self.cfg.slope_window_s,
                 "ticks": self.ticks,
                 "streaks": {
                     name: {"up": st["up"], "down": st["down"]}

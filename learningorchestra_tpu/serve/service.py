@@ -23,12 +23,58 @@ import time
 import numpy as np
 
 from learningorchestra_tpu.jobs.leases import LeaseTimeout
+from learningorchestra_tpu.obs.metrics import get_registry
 from learningorchestra_tpu.serve.batcher import MicroBatcher
 from learningorchestra_tpu.serve.fleet.manager import FleetManager
 from learningorchestra_tpu.serve.registry import ModelRegistry, ServeError
 
 #: Steps of serving_* scalar history kept (and rewritten per snapshot).
 _SCALAR_WINDOW = 512
+
+
+class _PredictHist:
+    """Identity-cached handle on the current registry's per-model
+    predict latency histogram — the API server's ``_obs_handles``
+    rebind idiom, so a ``reset_registry()`` mid-life re-homes the
+    series while the steady state pays one identity check instead of
+    a name lookup per predict.  The rollup engine derives windowed
+    per-model quantiles from this family's bucket deltas and the
+    predict-latency SLO reads good/bad fractions off the same series.
+    Cardinality is bounded by the serving registry's max_models cap;
+    no-op when LO_TPU_OBS_ENABLED=0."""
+
+    __slots__ = ("_reg", "_hist", "_bound")
+
+    def __init__(self):
+        self._reg = None
+        self._hist = None
+        self._bound: dict = {}
+
+    def observe(self, dt_s: float, model: str) -> None:
+        reg = get_registry()
+        if reg is not self._reg:
+            self._hist = reg.histogram(
+                "lo_serving_predict_duration_seconds",
+                "End-to-end predict latency per served model "
+                "(queue wait + coalesce + jitted apply + handoff).",
+                labels=("model",),
+            )
+            self._bound = {}
+            self._reg = reg
+        # Per-model bound series (<= max_models entries): the steady
+        # state is one dict hit + Histogram series update.
+        bound = self._bound.get(model)
+        if bound is None:
+            if len(self._bound) >= 256:
+                # Lifetime guard: max_models bounds CONCURRENT models,
+                # not every name ever served — a churny deployment
+                # must not grow this cache forever.
+                self._bound.clear()
+            bound = self._bound[model] = self._hist.bind(model=model)
+        bound.observe(dt_s)
+
+
+_predict_hist = _PredictHist()
 
 
 class ServingService:
@@ -342,21 +388,23 @@ class ServingService:
         if rs is not None:
             out, replica = rs.submit(x)
             entry.requests += 1
+            dt = time.perf_counter() - t0
+            _predict_hist.observe(dt, model=name)
             return {
                 "model": name,
                 "predictions": out.tolist(),
-                "latencyMs": round(
-                    (time.perf_counter() - t0) * 1e3, 3
-                ),
+                "latencyMs": round(dt * 1e3, 3),
                 "replica": replica.idx,
                 "device": replica.device_id or "host",
             }
         out = self._batcher_for(name).submit(x)
         entry.requests += 1
+        dt = time.perf_counter() - t0
+        _predict_hist.observe(dt, model=name)
         return {
             "model": name,
             "predictions": out.tolist(),
-            "latencyMs": round((time.perf_counter() - t0) * 1e3, 3),
+            "latencyMs": round(dt * 1e3, 3),
         }
 
     # -- observability -------------------------------------------------------

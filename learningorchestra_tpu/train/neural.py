@@ -549,7 +549,10 @@ def _cached_program(
     ``cost_args`` (a thunk returning example arguments) lets the
     build-once path run XLA cost/memory analysis on the freshly built
     program (obs/costs.py) — shape avatars only, nothing touches real
-    buffers.  ``want_cost=True`` returns ``(fn, ProgramCost | None)``
+    buffers.  Builders returning a TUPLE of jitted callables (the
+    (epoch, evaluate) pairs the mesh-sharded paths build) probe their
+    FIRST element — the epoch program, the one that dominates device
+    time.  ``want_cost=True`` returns ``(fn, ProgramCost | None)``
     so dispatch sites can attribute device time with flops attached."""
     from learningorchestra_tpu.train import compile_cache as cc
 
@@ -568,7 +571,8 @@ def _cached_program(
     if cost_args is not None:
         def building():
             fn = builder()
-            _probe_program_cost(key, label, fn, cost_args)
+            target = fn[0] if isinstance(fn, tuple) else fn
+            _probe_program_cost(key, label, target, cost_args)
             return fn
 
     fn = cc.get_cache().get_or_build(key, building, label=label)

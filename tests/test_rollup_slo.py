@@ -1,0 +1,754 @@
+"""Windowed rollups (obs/rollup.py) + SLO burn-rate alerting
+(obs/slo.py): quantile-from-bucket-deltas correctness vs a brute-force
+reference, ring-buffer bounds, burn-rate math goldens, the alert
+pending → firing → resolved lifecycle, the autoscaler's rollup-backed
+queue-slope trigger, and the end-to-end REST drill from the issue's
+acceptance criteria (fault-injected 5xx burst → availability alert
+fires → disarm → alert resolves).
+
+Rollup/SLO state is process-wide (like the metrics registry), so every
+test builds its own engine/service via reset_* and the module-scoped
+fixtures restore the defaults on exit.  Schedules are driven through
+``tick(now=...)`` / ``evaluate(now=...)`` with synthetic monotonic
+times — no sleeps outside the REST drill.
+"""
+
+import time
+
+import numpy as np
+import pytest
+import requests
+
+from learningorchestra_tpu import faults
+from learningorchestra_tpu.api import APIServer
+from learningorchestra_tpu.config import (
+    Config,
+    FleetConfig,
+    RollupConfig,
+    SLOConfig,
+)
+from learningorchestra_tpu.obs import metrics as obs_metrics
+from learningorchestra_tpu.obs import rollup as obs_rollup
+from learningorchestra_tpu.obs import slo as obs_slo
+from learningorchestra_tpu.obs.rollup import quantile_from_deltas
+
+PREFIX = "/api/learningOrchestra/v1"
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plane():
+    """Every test owns fresh singletons; defaults restored after."""
+    obs_metrics.reset_registry()
+    yield
+    obs_rollup.reset_engine()
+    obs_slo.reset_service()
+    obs_metrics.reset_registry()
+    faults.reset()
+
+
+def _engine(**kw):
+    kw.setdefault("tick_s", 0.0)  # manual tick()
+    return obs_rollup.reset_engine(RollupConfig(**kw))
+
+
+def _service(**kw):
+    kw.setdefault("for_s", 0.0)
+    kw.setdefault("resolve_s", 5.0)
+    kw.setdefault("fast_window_s", 30.0)
+    kw.setdefault("slow_window_s", 60.0)
+    kw.setdefault("burn_threshold", 10.0)
+    return obs_slo.reset_service(SLOConfig(**kw))
+
+
+# -- histogram-delta quantiles -----------------------------------------------
+
+
+class TestQuantiles:
+    def test_quantile_interpolates_within_bucket(self):
+        # 10 obs in (0.001, 0.01]: p50 = 5th of 10 → 45% into bucket.
+        edges = (0.001, 0.01, 0.1)
+        assert quantile_from_deltas(edges, (0, 10, 0, 0), 0.5) == (
+            pytest.approx(0.001 + 0.009 * 0.5)
+        )
+        # Rank in the +Inf bucket clamps to the top finite edge.
+        assert quantile_from_deltas(edges, (0, 0, 0, 5), 0.99) == 0.1
+        # Empty window → None, never a fabricated number.
+        assert quantile_from_deltas(edges, (0, 0, 0, 0), 0.5) is None
+
+    def test_windowed_quantiles_vs_brute_force(self):
+        """The acceptance check: quantiles derived from bucket DELTAS
+        must bracket the true (brute-force) quantile of exactly the
+        observations inside the window — the pre-window prefix must
+        drop out entirely."""
+        engine = _engine()
+        reg = obs_metrics.get_registry()
+        edges = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                 0.5, 1.0)
+        hist = reg.histogram(
+            "lo_serving_predict_duration_seconds", "t",
+            labels=("model",), buckets=edges,
+        )
+        rng = np.random.default_rng(7)
+        # Pre-window noise the deltas must cancel out.
+        for v in rng.uniform(0.2, 0.9, 50):
+            hist.observe(float(v), model="m")
+        engine.tick(now=0.0)
+        in_window = rng.lognormal(-4.5, 1.0, 400).clip(1e-4, 0.9)
+        for v in in_window:
+            hist.observe(float(v), model="m")
+        engine.tick(now=10.0)
+
+        # Window cutting between the two snapshots: the t=0 snapshot
+        # (holding all the pre-window noise) is the baseline and its
+        # counts cancel out of the deltas.
+        view = engine.hist_window(
+            "lo_serving_predict_duration_seconds", {"model": "m"},
+            window_s=8.0, qs=(0.5, 0.9, 0.99), now=10.0,
+        )
+        assert view["count"] == len(in_window)
+        full = [0.0] + list(edges)
+        for q_name, q in (("p50", 0.5), ("p90", 0.9), ("p99", 0.99)):
+            true = float(np.quantile(in_window, q))
+            est = view["quantiles"][q_name]
+            # The estimate must land in the bucket holding the true
+            # quantile (linear interpolation cannot do better than
+            # bucket resolution).
+            bi = next(
+                i for i in range(1, len(full))
+                if true <= full[i]
+            )
+            assert full[bi - 1] <= est <= full[bi], (
+                f"{q_name}: est {est} outside true bucket "
+                f"({full[bi-1]}, {full[bi]}] for true {true}"
+            )
+
+    def test_fraction_below_threshold(self):
+        engine = _engine()
+        reg = obs_metrics.get_registry()
+        hist = reg.histogram(
+            "lo_serving_predict_duration_seconds", "t",
+            labels=("model",), buckets=(0.01, 0.1, 1.0),
+        )
+        engine.tick(now=0.0)
+        for v in (0.005, 0.005, 0.05, 0.5):
+            hist.observe(v, model="m")
+        hist.observe(5.0, model="m")  # +Inf bucket
+        engine.tick(now=1.0)
+        good, total = engine.fraction_below(
+            "lo_serving_predict_duration_seconds", {"model": "m"},
+            0.1, window_s=10.0, now=1.0,
+        )
+        assert (good, total) == (3.0, 5.0)
+        # Threshold above every finite edge: +Inf-bucket observations
+        # are of unknown magnitude and must count BAD, or the latency
+        # SLO could never fire for large thresholds.
+        good, total = engine.fraction_below(
+            "lo_serving_predict_duration_seconds", {"model": "m"},
+            2.0, window_s=10.0, now=1.0,
+        )
+        assert (good, total) == (4.0, 5.0)
+
+
+# -- ring bounds + counter semantics -----------------------------------------
+
+
+class TestRollupBounds:
+    def test_ring_length_bounded(self):
+        engine = _engine(points=4)
+        reg = obs_metrics.get_registry()
+        g = reg.gauge("lo_serving_queue_depth", "t")
+        for i in range(12):
+            g.set(float(i))
+            engine.tick(now=float(i))
+        series = engine._match("lo_serving_queue_depth", None)
+        assert len(series) == 1
+        assert series[0].ring.maxlen == 4
+        assert len(series[0].ring) == 4
+        # Oldest points aged out: the window only sees the tail.
+        win = engine.gauge_window(
+            "lo_serving_queue_depth", None, 100.0, now=11.0
+        )
+        assert win["min"] == 8.0 and win["last"] == 11.0
+
+    def test_series_cap_drops_new_series_counted(self):
+        engine = _engine(max_series=2)
+        reg = obs_metrics.get_registry()
+        c = reg.counter("lo_jobs_total", "t", labels=("state",))
+        for state in ("finished", "failed", "deadline", "preempted"):
+            c.inc(state=state)
+        engine.tick(now=0.0)
+        st = engine.status()
+        assert st["series"] == 2
+        assert st["droppedSeries"] == 2
+        # The cap holds across ticks (drops counted per observation).
+        engine.tick(now=1.0)
+        assert engine.status()["series"] == 2
+
+    def test_counter_birth_and_reset(self):
+        """A series born mid-stream gets its full increment (synthetic
+        zero birth point); a registry reset reads as the post-reset
+        value, never a negative delta."""
+        engine = _engine()
+        reg = obs_metrics.get_registry()
+        reg.counter("lo_jobs_total", "t", labels=("state",)).inc(
+            7, state="failed"
+        )
+        engine.tick(now=0.0)
+        assert engine.counter_delta(
+            "lo_jobs_total", {"state": "failed"}, 30.0, now=0.0
+        ) == 7.0
+        # Reset: same series name reborn at a smaller value.
+        reg = obs_metrics.reset_registry()
+        reg.counter("lo_jobs_total", "t", labels=("state",)).inc(
+            2, state="failed"
+        )
+        engine.tick(now=5.0)
+        assert engine.counter_delta(
+            "lo_jobs_total", {"state": "failed"}, 30.0, now=5.0
+        ) == 2.0
+
+    def test_stale_gauge_series_reads_no_data_not_old_level(self):
+        """Gauges must not surface the pre-window baseline point a
+        counter delta needs: a dissolved model's frozen queue depth
+        reads as no data, never as its hour-old value."""
+        engine = _engine()
+        obs_metrics.get_registry().gauge(
+            "lo_serving_model_queue_depth", "t", labels=("model",)
+        ).set(50.0, model="dead")
+        engine.tick(now=100.0)
+        assert engine.gauge_window(
+            "lo_serving_model_queue_depth", {"model": "dead"},
+            window_s=10.0, now=5000.0,
+        ) is None
+        # Live window still reports it.
+        assert engine.gauge_window(
+            "lo_serving_model_queue_depth", {"model": "dead"},
+            window_s=10.0, now=105.0,
+        )["last"] == 50.0
+
+    def test_gauge_slope_least_squares(self):
+        engine = _engine()
+        reg = obs_metrics.get_registry()
+        g = reg.gauge(
+            "lo_serving_model_queue_depth", "t", labels=("model",)
+        )
+        for t, depth in ((0.0, 0.0), (1.0, 2.0), (2.0, 4.0),
+                         (3.0, 6.0)):
+            g.set(depth, model="m")
+            engine.tick(now=t)
+        slope = engine.slope(
+            "lo_serving_model_queue_depth", {"model": "m"},
+            window_s=10.0, now=3.0,
+        )
+        assert slope == pytest.approx(2.0)
+        # A single-snapshot series has nothing to fit.
+        engine2 = _engine()
+        obs_metrics.get_registry().gauge(
+            "lo_serving_model_queue_depth", "t", labels=("model",)
+        ).set(1.0, model="m")
+        engine2.tick(now=0.0)
+        assert engine2.slope(
+            "lo_serving_model_queue_depth", {"model": "m"},
+            window_s=10.0, now=0.0,
+        ) is None
+
+
+# -- burn-rate math -----------------------------------------------------------
+
+
+class TestBurnRate:
+    def test_goldens(self):
+        # 5 bad of 1000 against a 99.9% target: 0.5% bad / 0.1%
+        # budget = burning 5x too fast.
+        assert obs_slo.burn_rate(5, 1000, 0.999) == pytest.approx(5.0)
+        # Full outage burns at 1/budget.
+        assert obs_slo.burn_rate(10, 10, 0.999) == (
+            pytest.approx(1000.0)
+        )
+        assert obs_slo.burn_rate(0, 500, 0.99) == 0.0
+        # No traffic is NOT healthy-zero — it is no data.
+        assert obs_slo.burn_rate(0, 0, 0.999) is None
+
+    def test_availability_objective_reads_status_classes(self):
+        engine = _engine()
+        service = _service()
+        reg = obs_metrics.get_registry()
+        c = reg.counter(
+            "lo_http_requests_total", "t", labels=("route", "status")
+        )
+        c.inc(990, route="GET /x", status="2xx")
+        c.inc(10, route="GET /x", status="5xx")
+        engine.tick(now=0.0)
+        service.evaluate(engine, now=0.0)
+        doc = service.status()
+        avail = next(
+            o for o in doc["objectives"]
+            if o["name"] == "route-availability"
+        )
+        inst = avail["instances"][0]
+        # 1% bad / 0.1% budget = 10x burn, both windows.
+        assert inst["burnFast"] == pytest.approx(10.0)
+        assert inst["burnSlow"] == pytest.approx(10.0)
+        assert inst["budgetRemaining"] == pytest.approx(-9.0)
+
+
+# -- alert lifecycle ----------------------------------------------------------
+
+
+class TestAlertLifecycle:
+    def _breach(self, reg, n_bad=50, n_good=50):
+        c = reg.counter(
+            "lo_http_requests_total", "t", labels=("route", "status")
+        )
+        c.inc(n_good, route="GET /x", status="2xx")
+        if n_bad:
+            c.inc(n_bad, route="GET /x", status="5xx")
+
+    def test_pending_firing_resolved(self):
+        engine = _engine()
+        service = _service(for_s=5.0, resolve_s=8.0)
+        seen = []
+        service.add_sink(seen.append)
+        reg = obs_metrics.get_registry()
+
+        self._breach(reg)
+        engine.tick(now=0.0)  # evaluation rides the tick
+        state = service.alerts()["alerts"][0]
+        assert state["slo"] == "route-availability"
+        assert state["state"] == "pending"  # breach < for_s
+        assert not seen
+
+        self._breach(reg)
+        engine.tick(now=6.0)  # held past for_s → firing + delivery
+        state = service.alerts()["alerts"][0]
+        assert state["state"] == "firing"
+        assert [e["state"] for e in seen] == ["firing"]
+        assert service.alerts()["firing"]
+
+        # Recovery traffic; the breach window ages out.
+        reg.counter(
+            "lo_http_requests_total", "t", labels=("route", "status")
+        ).inc(5000, route="GET /x", status="2xx")
+        engine.tick(now=70.0)  # burn back under threshold → ok clock
+        assert service.alerts()["alerts"][0]["state"] == "firing"
+        engine.tick(now=79.0)  # ok held past resolve_s → resolved
+        state = service.alerts()["alerts"][0]
+        assert state["state"] == "resolved"
+        assert [e["state"] for e in seen] == ["firing", "resolved"]
+        assert seen[1]["firedForS"] > 0
+
+    def test_pending_collapses_without_paging(self):
+        """A blip shorter than for_s must never reach a sink."""
+        engine = _engine()
+        service = _service(for_s=5.0)
+        seen = []
+        service.add_sink(seen.append)
+        reg = obs_metrics.get_registry()
+        self._breach(reg)
+        engine.tick(now=0.0)
+        assert service.alerts()["alerts"][0]["state"] == "pending"
+        reg.counter(
+            "lo_http_requests_total", "t", labels=("route", "status")
+        ).inc(100000, route="GET /x", status="2xx")
+        engine.tick(now=2.0)
+        assert service.alerts()["alerts"][0]["state"] == "inactive"
+        assert not seen
+
+    def test_resolved_decays_and_stale_instances_prune(self):
+        """A resolved alert decays to inactive after one more resolve
+        window, and a per-model instance whose model left the rollup
+        series is dropped — the alerts view and the Prometheus mirror
+        must not grow stale rows forever."""
+        engine = _engine()
+        service = _service(resolve_s=8.0)
+        reg = obs_metrics.get_registry()
+        self._breach(reg)
+        engine.tick(now=0.0)  # firing (for_s=0)
+        reg.counter(
+            "lo_http_requests_total", "t", labels=("route", "status")
+        ).inc(100000, route="GET /x", status="2xx")
+        engine.tick(now=70.0)  # ok clock starts
+        engine.tick(now=79.0)  # resolved
+        assert service.alerts()["alerts"][0]["state"] == "resolved"
+        engine.tick(now=90.0)  # resolved + resolve_s elapsed
+        states = [
+            st["state"] for st in service.alerts()["alerts"]
+            if st["slo"] == "route-availability"
+        ]
+        assert states == ["inactive"]
+        # Stale per-model latency instance: manufacture one, then
+        # evaluate with an engine that no longer knows the model.
+        with service._lock:
+            service._alerts[("predict-latency", "gone")] = {
+                "slo": "predict-latency", "instance": "gone",
+                "state": "inactive", "pendingSince": None,
+                "firingSince": None, "okSince": None,
+            }
+        service.evaluate(engine, now=95.0)
+        assert ("predict-latency", "gone") not in service._alerts
+
+    def test_prom_mirror_families(self):
+        engine = _engine()
+        service = _service()  # for_s=0: straight to firing
+        reg = obs_metrics.get_registry()
+        self._breach(reg)
+        engine.tick(now=0.0)
+        fams = {f.name: f for f in service.prom_families()}
+        active = {
+            tuple(sorted(labels.items())): v
+            for labels, v in fams["lo_alert_active"].samples
+        }
+        key = (("instance", "all"), ("slo", "route-availability"))
+        assert active[key] == 1
+        burns = [
+            (labels["window"], v)
+            for labels, v in fams["lo_slo_burn_rate"].samples
+            if labels["slo"] == "route-availability"
+        ]
+        assert dict(burns)["fast"] >= 10.0
+
+    def test_latency_objective_per_model_instances(self):
+        engine = _engine()
+        # 90% target → 0.1 budget: an all-over-threshold model burns
+        # at exactly 10x, meeting the threshold; the healthy model
+        # burns 0.
+        service = _service(
+            predict_p99_ms=10.0, predict_target=0.9,
+            burn_threshold=5.0,
+        )
+        reg = obs_metrics.get_registry()
+        hist = reg.histogram(
+            "lo_serving_predict_duration_seconds", "t",
+            labels=("model",),
+        )
+        engine.tick(now=0.0)
+        for _ in range(20):
+            hist.observe(0.5, model="slow")   # all over threshold
+            hist.observe(0.001, model="fast")  # all under
+        engine.tick(now=1.0)
+        states = {
+            (st["slo"], st["instance"]): st["state"]
+            for st in service.alerts()["alerts"]
+        }
+        assert states[("predict-latency", "slow")] == "firing"
+        assert states[("predict-latency", "fast")] == "inactive"
+
+
+# -- autoscaler queue-slope trigger ------------------------------------------
+
+
+class TestAutoscalerSlope:
+    def test_slope_scales_up_and_ledger_records_it(self):
+        """A ramping queue (depth still under the frac threshold)
+        scales on the rollup-fitted slope, and EVERY ledger entry
+        carries the slope it read."""
+        from learningorchestra_tpu.serve.fleet.autoscaler import (
+            Autoscaler,
+        )
+
+        engine = _engine()
+        reg = obs_metrics.get_registry()
+        g = reg.gauge(
+            "lo_serving_model_queue_depth", "t", labels=("model",)
+        )
+        # Ramp: 0 → 6 rows over 3s in a 64-row queue (frac < 0.1).
+        # Anchored to REAL monotonic time: the autoscaler queries the
+        # slope with the live clock, not a synthetic one.
+        base = time.monotonic() - 3.0
+        for t, depth in ((0.0, 0.0), (1.0, 2.0), (2.0, 4.0),
+                         (3.0, 6.0)):
+            g.set(depth, model="m")
+            engine.tick(now=base + t)
+
+        class _Sig:
+            name = "m"
+            min_replicas, max_replicas = 1, 3
+            size = 1
+            calls = 0
+
+            def signals(self):
+                # Traffic advances every tick (the slope trigger is
+                # gated on served > 0, like p99).
+                self.calls += 1
+                return {
+                    "replicas": self.size, "queue_depth": 6,
+                    "queue_frac": 6 / 64.0, "p99_ms": 1.0,
+                    "sheds": 0, "requests": 10 * self.calls,
+                }
+
+        class _Mgr:
+            def __init__(self, rs):
+                self.rs = rs
+
+            def sets_snapshot(self):
+                return [(self.rs.name, self.rs)]
+
+            def scale(self, name, n, *, reason):
+                self.rs.size = n
+                return n
+
+        rs = _Sig()
+        cfg = FleetConfig(
+            interval_s=0.0, up_queue_frac=0.5, up_ticks=2,
+            down_ticks=5, up_slope=1.0, slope_window_s=30.0,
+        )
+        scaler = Autoscaler(_Mgr(rs), cfg)
+        # Tick 1 primes the served-delta state; ticks 2 and 3 are the
+        # slope-sustain window.
+        assert scaler.tick() == []
+        entry = scaler.status()["ledger"][-1]
+        assert entry["queueSlope"] == pytest.approx(2.0)
+        assert entry["action"] == "hold"
+        assert scaler.tick() == []  # streak 1 of 2
+        made = scaler.tick()  # streak 2 → scale
+        assert made and made[0]["signal"] == "slope"
+        assert rs.size == 2
+        entry = scaler.status()["ledger"][-1]
+        assert entry["action"] == "up"
+        assert entry["reason"] == "slope"
+        assert entry["queueSlope"] == pytest.approx(2.0)
+
+    def test_no_engine_data_means_no_slope_signal(self):
+        from learningorchestra_tpu.serve.fleet.autoscaler import (
+            Autoscaler,
+        )
+
+        _engine()  # fresh, empty
+
+        class _Sig:
+            name = "m"
+            min_replicas, max_replicas = 1, 3
+            size = 1
+
+            def signals(self):
+                return {
+                    "replicas": 1, "queue_depth": 0,
+                    "queue_frac": 0.0, "p99_ms": 0.0,
+                    "sheds": 0, "requests": 0,
+                }
+
+        class _Mgr:
+            def __init__(self, rs):
+                self.rs = rs
+
+            def sets_snapshot(self):
+                return [(self.rs.name, self.rs)]
+
+            def scale(self, name, n, *, reason):
+                raise AssertionError("must not scale")
+
+        scaler = Autoscaler(
+            _Mgr(_Sig()),
+            FleetConfig(interval_s=0.0, up_slope=1.0),
+        )
+        scaler.tick()
+        assert scaler.status()["ledger"][-1]["queueSlope"] is None
+
+
+# -- the REST drill (acceptance criteria) ------------------------------------
+
+
+class TestRESTDrill:
+    def test_fault_breaches_slo_alert_fires_then_resolves(
+        self, tmp_path
+    ):
+        """End to end over live HTTP: arm an error-injecting
+        ``http.handler`` fault via /faults → the 5xx burst breaches
+        route availability → the alert transitions to firing (visible
+        at GET /observability/alerts and as lo_alert_active=1 on
+        /metrics.prom) → disarm → the alert resolves within the
+        configured resolve window."""
+        cfg = Config()
+        cfg.store.root = str(tmp_path / "store")
+        cfg.store.volume_root = str(tmp_path / "volumes")
+        # Seconds-scale SLO clock: 100 ms ticks, windows a few
+        # seconds wide, fire after 0.2 s of breach, resolve after
+        # 0.5 s clean.
+        cfg.rollup = RollupConfig(tick_s=0.1, points=256)
+        cfg.slo = SLOConfig(
+            fast_window_s=2.0, slow_window_s=4.0,
+            burn_threshold=5.0, for_s=0.2, resolve_s=0.5,
+            predict_p99_ms=0.0, job_success_target=0.0,
+        )
+        obs_rollup.reset_engine(cfg.rollup)
+        obs_slo.reset_service(cfg.slo)
+        server = APIServer(cfg)
+        port = server.start_background()
+        base = f"http://127.0.0.1:{port}{PREFIX}"
+        try:
+            assert server.rollup.status()["running"]
+
+            def alert_state():
+                doc = requests.get(
+                    f"{base}/observability/alerts", timeout=10
+                ).json()
+                for st in doc["alerts"]:
+                    if st["slo"] == "route-availability":
+                        return st
+                return None
+
+            # Arm a BOUNDED error schedule so the drill's own alert
+            # polls succeed once the burst is spent.
+            resp = requests.post(
+                f"{base}/faults/http.handler",
+                json={"mode": "error", "maxTriggers": 30},
+                timeout=10,
+            )
+            assert resp.status_code == 201, resp.text
+            for _ in range(30):
+                assert requests.get(
+                    f"{base}/health", timeout=10
+                ).status_code == 500
+
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                st = alert_state()
+                if st is not None and st["state"] == "firing":
+                    break
+                time.sleep(0.1)
+            else:
+                raise AssertionError(
+                    f"alert never fired: {alert_state()}"
+                )
+            prom = requests.get(
+                f"{base}/metrics.prom", timeout=10
+            ).text
+            assert (
+                'lo_alert_active{instance="all",'
+                'slo="route-availability"} 1' in prom
+            )
+
+            # Disarm; healthy traffic ages the burst out of the
+            # windows and the resolve clock runs down.
+            assert requests.delete(
+                f"{base}/faults", timeout=10
+            ).status_code == 200
+            def resolved_in_history():
+                doc = requests.get(
+                    f"{base}/observability/alerts", timeout=10
+                ).json()
+                return any(
+                    e["state"] == "resolved"
+                    and e["slo"] == "route-availability"
+                    for e in doc["history"]
+                )
+
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                assert requests.get(
+                    f"{base}/health", timeout=10
+                ).status_code == 200
+                # The live state shows "resolved" for one resolve
+                # window then decays to inactive — the history entry
+                # is the non-racy witness of the transition.
+                st = alert_state()
+                if st["state"] == "resolved" or resolved_in_history():
+                    break
+                time.sleep(0.1)
+            else:
+                raise AssertionError(
+                    f"alert never resolved: {alert_state()}"
+                )
+            history = requests.get(
+                f"{base}/observability/alerts", timeout=10
+            ).json()["history"]
+            assert [
+                e["state"] for e in history
+                if e["slo"] == "route-availability"
+            ] == ["firing", "resolved"]
+            prom = requests.get(
+                f"{base}/metrics.prom", timeout=10
+            ).text
+            assert (
+                'lo_alert_active{instance="all",'
+                'slo="route-availability"} 0' in prom
+            )
+
+            # The timeseries surface saw the same story the SLO read.
+            ts = requests.get(
+                f"{base}/observability/timeseries",
+                params={
+                    "name": "lo_http_requests_total",
+                    "windowS": 60, "status": "5xx",
+                },
+                timeout=10,
+            ).json()
+            assert ts["series"], "no 5xx series tracked"
+        finally:
+            server.shutdown()
+
+
+# -- REST odds and ends -------------------------------------------------------
+
+
+def test_timeseries_directory_and_client_bindings(tmp_path):
+    from learningorchestra_tpu.client import Context
+
+    cfg = Config()
+    cfg.store.root = str(tmp_path / "store")
+    cfg.store.volume_root = str(tmp_path / "volumes")
+    obs_rollup.reset_engine(RollupConfig(tick_s=0.0))
+    obs_slo.reset_service(SLOConfig())
+    server = APIServer(cfg)
+    port = server.start_background()
+    try:
+        ctx = Context("127.0.0.1", port=port)
+        doc = ctx.observability.timeseries()
+        names = {f["name"] for f in doc["families"]}
+        assert "lo_http_requests_total" in names
+        assert "lo_serving_model_queue_depth" in names
+        server.rollup.tick()
+        doc = ctx.observability.timeseries(
+            "lo_http_requests_total", window_s=60
+        )
+        assert doc["series"]
+        assert all(
+            "ratePerS" in s for s in doc["series"]
+        )
+        alerts = ctx.observability.alerts()
+        assert "history" in alerts and "config" in alerts
+        slo_doc = ctx.observability.slo()
+        assert {o["name"] for o in slo_doc["objectives"]} == {
+            "route-availability", "predict-latency", "job-success",
+        }
+    finally:
+        server.shutdown()
+
+
+def test_shutdown_stops_rollup_daemon_next_server_rearms(tmp_path):
+    """A stopped node must not keep evaluating SLOs (or paging a
+    webhook); the singleton daemon re-arms when a new server boots."""
+    cfg = Config()
+    cfg.store.root = str(tmp_path / "store")
+    cfg.store.volume_root = str(tmp_path / "volumes")
+    cfg.rollup = RollupConfig(tick_s=30.0)
+    obs_rollup.reset_engine(cfg.rollup)
+    obs_slo.reset_service(cfg.slo)
+    server = APIServer(cfg)
+    assert server.rollup.status()["running"]
+    server.shutdown()
+    assert not server.rollup.status()["running"]
+    cfg2 = Config()
+    cfg2.store.root = str(tmp_path / "store2")
+    cfg2.store.volume_root = str(tmp_path / "volumes2")
+    server2 = APIServer(cfg2)
+    try:
+        assert server2.rollup is server.rollup  # the singleton
+        assert server2.rollup.status()["running"]
+    finally:
+        server2.shutdown()
+
+
+def test_timeseries_rejects_bad_window(tmp_path):
+    cfg = Config()
+    cfg.store.root = str(tmp_path / "store")
+    cfg.store.volume_root = str(tmp_path / "volumes")
+    server = APIServer(cfg)
+    try:
+        status, payload = server.handle(
+            "GET", f"{PREFIX}/observability/timeseries",
+            {}, {"name": "x", "windowS": "bogus"},
+        )
+        assert status == 406
+    finally:
+        server.shutdown()
