@@ -1,0 +1,37 @@
+"""First-party static-analysis suite (``lochecks``).
+
+Three analyzer families over the package's own invariants:
+
+- **Concurrency** (:mod:`.concurrency`): lock-acquisition order
+  cycles, self-deadlocks, and inconsistently-locked shared state,
+  modeled on the repo's idioms (``with self._lock:``, daemon threads,
+  module-level registry locks, ``*_locked`` caller-holds-lock
+  helpers).
+- **JAX hazards** (:mod:`.jaxlint`): host-sync constructs, mutable-
+  global capture, and shape-branching inside jit/pjit-compiled
+  bodies; plus the cooperative-cancellation worklist rule
+  (:mod:`.cancellation`).
+- **Drift gates** (:mod:`.drift`): every ``LO_TPU_*`` knob, REST
+  route, metric family, and fault point cross-checked against
+  config.py, the deploy manifests, the README tables, client.py and
+  faults/plane.py.
+
+Run via ``python scripts/lo_check.py learningorchestra_tpu/`` or
+:func:`run_checks`; the tier-1 gate is
+``tests/test_lochecks.py::test_package_is_clean``.
+"""
+
+from .drift import DriftPaths, analyze_drift
+from .findings import ERROR, WARN, Finding
+from .runner import RULES, Report, run_checks
+
+__all__ = [
+    "DriftPaths",
+    "ERROR",
+    "Finding",
+    "RULES",
+    "Report",
+    "WARN",
+    "analyze_drift",
+    "run_checks",
+]

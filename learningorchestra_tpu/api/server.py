@@ -2585,12 +2585,32 @@ class APIServer:
 
         host = host or self.config.api.host
         port = self.config.api.port if port is None else port
-        self._httpd = _BoundedThreadingHTTPServer(
+        httpd = _BoundedThreadingHTTPServer(
             (host, port), Handler,
             max_connections=self.config.api.max_connections,
         )
+        # Publish under the shutdown lock: serve_forever runs on a
+        # daemon thread (start_background), so a shutdown() racing
+        # this construction window would otherwise read _httpd as
+        # None, "stop" nothing, and leak a live accept loop — the
+        # exact stale-primary window the fence demotion closes.
+        with self._shutdown_lock:
+            if self._shut_down:
+                httpd.server_close()
+                return
+            self._httpd = httpd
         self._start_fence_watch()
-        self._httpd.serve_forever()
+        try:
+            httpd.serve_forever()
+        except Exception:
+            # shutdown() can claim and close the listener between the
+            # publish above and serve_forever() entering its poll loop
+            # — the serve call then trips on the closed socket.  That
+            # interleaving is a clean stop, not an error.
+            with self._shutdown_lock:
+                if self._shut_down:
+                    return
+            raise
 
     #: Seconds between fence checks (tests shrink it).
     FENCE_CHECK_INTERVAL_S = 5.0
@@ -2689,6 +2709,12 @@ class APIServer:
             if self._shut_down:
                 return
             self._shut_down = True
+            # Claim the listener under the same lock serve_forever
+            # publishes it with: a shutdown racing the daemon-thread
+            # construction either sees the httpd (and stops it) or
+            # flips _shut_down first (and serve_forever refuses to
+            # serve) — never a leaked accept loop.
+            httpd, self._httpd = self._httpd, None
         self._shutting_down.set()
         # The registry outlives this server (process-global): drop the
         # collector so scrapes never touch a closed context.
@@ -2699,7 +2725,6 @@ class APIServer:
         # paging a webhook).  The singleton survives — a later
         # APIServer's construction re-arms the daemon.
         self.rollup.stop()
-        httpd, self._httpd = self._httpd, None
         if httpd is not None:
             httpd.shutdown()
             httpd.server_close()

@@ -772,6 +772,21 @@ class Config:
         return cfg
 
 
+#: Knobs read straight from the environment at their use site instead
+#: of through :meth:`Config.from_env` — each for a reason: the log
+#: level must apply before any config is built (config errors
+#: themselves need a logger), and the flash-attention interpret
+#: override is re-read per call so tests can flip it mid-process.
+#: They are registered HERE because config.py is the canonical knob
+#: index: the static drift gate (analysis/drift.py) fails any
+#: ``LO_TPU_*`` reference that this file doesn't know about.
+DIRECT_ENV_KNOBS = (
+    "LO_TPU_LOG_LEVEL",        # log.py: root level, default INFO
+    "LO_TPU_FLASH_INTERPRET",  # ops/attention.py: "1" forces the
+                               # Pallas interpreter, "0" forces
+                               # compiled kernels
+)
+
 _lock = threading.Lock()
 _config: Config | None = None
 

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""lochecks CLI — the repo's first-party static-analysis suite.
+
+Usage::
+
+    python scripts/lo_check.py learningorchestra_tpu/
+    python scripts/lo_check.py learningorchestra_tpu/ --no-drift
+    python scripts/lo_check.py --rules          # rule catalog
+
+Exit code 0 = no unsuppressed error findings (warn findings never
+fail the run — they are worklists).  Suppress a finding inline with
+``# lo-check: disable=<rule>`` on (or directly above) its line, or
+``# lo-check: disable-file=<rule>`` for a whole file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from learningorchestra_tpu.analysis.runner import (  # noqa: E402
+    RULES,
+    run_checks,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="learningorchestra_tpu static-analysis suite"
+    )
+    parser.add_argument(
+        "package", nargs="?", default="learningorchestra_tpu",
+        help="package root to analyze",
+    )
+    parser.add_argument(
+        "--repo-root", default=None,
+        help="repo root for cross-artifact drift gates "
+        "(default: parent of the package root)",
+    )
+    parser.add_argument(
+        "--no-drift", action="store_true",
+        help="skip the cross-artifact drift gates",
+    )
+    parser.add_argument(
+        "--rules", action="store_true", help="print the rule catalog",
+    )
+    parser.add_argument(
+        "--show-suppressed", action="store_true",
+        help="also list suppressed findings",
+    )
+    args = parser.parse_args(argv)
+
+    if args.rules:
+        width = max(len(r) for r in RULES)
+        for rule, (severity, desc) in sorted(RULES.items()):
+            print(f"{rule:<{width}}  {severity:<5}  {desc}")
+        return 0
+
+    report = run_checks(
+        args.package,
+        repo_root=args.repo_root,
+        drift=not args.no_drift,
+    )
+    for path, message in report.parse_errors:
+        print(f"{path}: PARSE ERROR: {message}")
+    for finding in report.findings:
+        print(finding.render())
+    if args.show_suppressed:
+        for finding in report.suppressed:
+            print(f"[suppressed] {finding.render()}")
+    print(
+        f"lo_check: {report.files_scanned} files, "
+        f"{len(report.errors)} error(s), "
+        f"{len(report.warnings)} warning(s), "
+        f"{len(report.suppressed)} suppressed"
+    )
+    return report.exit_code()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

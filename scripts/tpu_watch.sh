@@ -19,6 +19,17 @@ export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=3
 LOGDIR="$(pwd)/tpu_chain_logs"
 mkdir -p "$LOGDIR"
 
+# Static-analysis gate FIRST: it needs no tunnel, costs ~2 s, and a
+# tree failing its own lock/JAX/drift contracts should not spend
+# tunnel windows banking evidence for code that can't merge.
+if ! timeout 120 python -u scripts/lo_check.py learningorchestra_tpu/ \
+        > "$LOGDIR/lo_check.log" 2>&1; then
+    echo "$(date -u +%H:%M:%S) lo_check FAILED — fix findings before \
+watching (see $LOGDIR/lo_check.log)" | tee -a "$LOGDIR/watch.log"
+    exit 1
+fi
+echo "$(date -u +%H:%M:%S) lo_check clean" >> "$LOGDIR/watch.log"
+
 probe() {
     # 40 s: an UP tunnel answers this in ~5 s (init + tiny matmul);
     # 90 s only stretched the down-state retry cycle to 135 s —

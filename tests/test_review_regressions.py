@@ -249,3 +249,45 @@ def test_auto_rejoin_env_accepts_truthy_spellings(monkeypatch):
     monkeypatch.setenv("LO_HA_AUTO_REJOIN", "maybe")
     with pytest.raises(ValueError, match="LO_HA_AUTO_REJOIN"):
         Config.from_env()
+
+
+def test_shutdown_racing_serve_never_leaks_listener(tmp_path):
+    """lochecks unlocked-shared-write finding (this PR): serve_forever
+    runs on start_background's daemon thread and published
+    ``self._httpd`` with no lock, while shutdown() swapped it out with
+    no lock — a shutdown landing inside the construction window read
+    None, "stopped" nothing, and leaked a live accept loop (the exact
+    stale-primary window the fence demotion exists to close).  Both
+    sides now hand the listener off under ``_shutdown_lock``: after
+    shutdown() wins the race, serve_forever must refuse to serve."""
+    import socket
+    import threading
+
+    from learningorchestra_tpu.api import APIServer
+    from learningorchestra_tpu.config import Config
+
+    cfg = Config()
+    cfg.store.root = str(tmp_path / "store")
+    cfg.store.volume_root = str(tmp_path / "volumes")
+    server = APIServer(cfg)
+    server.shutdown()
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+
+    t = threading.Thread(
+        target=lambda: server.serve_forever(
+            host="127.0.0.1", port=port
+        ),
+        daemon=True,
+    )
+    t.start()
+    t.join(5.0)
+    assert not t.is_alive(), (
+        "serve_forever kept serving after shutdown — leaked listener"
+    )
+    assert server._httpd is None
+    with pytest.raises(OSError):
+        socket.create_connection(("127.0.0.1", port), timeout=0.5)
