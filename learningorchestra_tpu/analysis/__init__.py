@@ -15,23 +15,36 @@ Three analyzer families over the package's own invariants:
   route, metric family, and fault point cross-checked against
   config.py, the deploy manifests, the README tables, client.py and
   faults/plane.py.
+- **Whole-program** (:mod:`.wholeprogram`): the per-module lock
+  models composed into one global lock-order graph across modules —
+  cross-module inversion cycles, blocking-call-under-lock, and
+  ``make_lock`` name congruence.
+- **Witness cross-check** (:mod:`.witness`): runtime-observed lock
+  orders (``concurrency_rt``, ``LO_TPU_WITNESS=1``) validated
+  against the static graph; a witnessed edge the model lacks is a
+  build-failing static false negative.
 
-Run via ``python scripts/lo_check.py learningorchestra_tpu/`` or
-:func:`run_checks`; the tier-1 gate is
+Run via ``python scripts/lo_check.py learningorchestra_tpu/
+--whole-program`` or :func:`run_checks`; the tier-1 gate is
 ``tests/test_lochecks.py::test_package_is_clean``.
 """
 
 from .drift import DriftPaths, analyze_drift
 from .findings import ERROR, WARN, Finding
 from .runner import RULES, Report, run_checks
+from .witness import cross_check
+from .wholeprogram import GlobalLockGraph, global_graph
 
 __all__ = [
     "DriftPaths",
     "ERROR",
     "Finding",
+    "GlobalLockGraph",
     "RULES",
     "Report",
     "WARN",
     "analyze_drift",
+    "cross_check",
+    "global_graph",
     "run_checks",
 ]

@@ -1,18 +1,21 @@
-"""Cooperative-cancellation worklist rule (warn-level).
+"""Cooperative-cancellation rule (error-level).
 
 The job engine's deadline watchdog (jobs/engine.py) fails overdue jobs
 and reclaims their worker slot and chip leases — but the job BODY
 keeps running until it finishes on its own: Python threads cannot be
-killed.  True cancellation needs the body to poll a cancel token.
+killed.  True cancellation needs the body to poll a cancel token, and
+since the cancellation PR landed one (``jobs/cancel.py`` —
+``cancel_requested()`` bound per dispatch, flipped by the watchdog and
+the bounded shutdown drain), consulting it is the CONTRACT, not a
+worklist item.
 
 ``loop-no-cancel-check`` flags long-running loop shapes inside the
 job-execution and serving planes that never consult a stop/deadline
-signal: ``while True:`` loops, unbounded ``while`` loops, and
-epoch-style ``for`` loops whose body neither touches an ``Event`` /
-deadline / cancel construct nor raises out.  It is deliberately
-``warn`` severity: today's offenders are the agreed worklist for the
-cancellation PR (see ROADMAP), not bugs in this one — the rule exists
-so the list can't silently grow.
+signal: ``while True:`` loops and epoch-style ``for`` loops whose body
+neither touches an ``Event`` / deadline / cancel construct nor raises
+out.  Error severity: the shutdown-drain hang this rule originally
+named (the pre-cancellation ``JobEngine.shutdown``) is exactly what an
+unchecked loop costs; suppress deliberate cases inline.
 """
 
 from __future__ import annotations
@@ -20,7 +23,7 @@ from __future__ import annotations
 import ast
 import re
 
-from .findings import WARN, Finding
+from .findings import ERROR, Finding
 
 #: Only the planes where a runaway body holds real resources.
 SCOPE_RE = re.compile(
@@ -77,7 +80,8 @@ def analyze_cancellation(path: str, tree: ast.Module,
             path, node.lineno, "loop-no-cancel-check",
             f"{shape} never consults a cancel token / watchdog "
             "deadline — the engine can fail the job but this body "
-            "runs to completion (cancellation-PR worklist)",
-            severity=WARN,
+            "runs to completion (poll jobs/cancel.py's "
+            "cancel_requested() between units of work)",
+            severity=ERROR,
         ))
     return findings

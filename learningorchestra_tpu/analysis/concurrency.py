@@ -37,6 +37,14 @@ import dataclasses
 from .findings import Finding
 
 _LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+#: The witnessed factories (concurrency_rt): first-party locks are
+#: constructed through these, carrying their static identity as the
+#: name argument (the whole-program pass checks the congruence).
+_RT_FACTORIES = {
+    "make_lock": "Lock",
+    "make_rlock": "RLock",
+    "make_condition": "Condition",
+}
 _REENTRANT = {"RLock"}
 _INIT_EXEMPT = {
     "__init__", "__new__", "__post_init__", "__init_subclass__",
@@ -45,15 +53,48 @@ _INIT_EXEMPT = {
 
 
 def _lock_factory_name(node: ast.expr) -> str | None:
-    """``threading.Lock()`` / ``Lock()`` → ``"Lock"`` (else None)."""
-    if not isinstance(node, ast.Call) or node.args or node.keywords:
+    """``threading.Lock()`` / ``Lock()`` / ``make_lock("...")`` →
+    ``"Lock"`` (else None)."""
+    if not isinstance(node, ast.Call):
         return None
     fn = node.func
-    if isinstance(fn, ast.Attribute) and fn.attr in _LOCK_FACTORIES:
-        return fn.attr
-    if isinstance(fn, ast.Name) and fn.id in _LOCK_FACTORIES:
-        return fn.id
+    name = (
+        fn.attr if isinstance(fn, ast.Attribute)
+        else fn.id if isinstance(fn, ast.Name) else None
+    )
+    if name in _RT_FACTORIES and node.args:
+        return _RT_FACTORIES[name]
+    if node.args or node.keywords:
+        return None
+    if name in _LOCK_FACTORIES:
+        return name
     return None
+
+
+def _foreign_key(expr: ast.expr):
+    """``coll.lock`` / ``self.registry.lock`` → a foreign-lock key
+    (("<foreign>", "var.attr") / ("<foreignself>", "attr2.attr")), or
+    None.  Per-module rules treat these as opaque held context; the
+    whole-program pass resolves the receiver's type."""
+    if not isinstance(expr, ast.Attribute):
+        return None
+    attr = expr.attr
+    if not ("lock" in attr.lower() or attr in ("_cv", "_cond")):
+        return None
+    base = expr.value
+    if isinstance(base, ast.Name) and base.id != "self":
+        return ("<foreign>", f"{base.id}.{attr}")
+    if (
+        isinstance(base, ast.Attribute)
+        and isinstance(base.value, ast.Name)
+        and base.value.id == "self"
+    ):
+        return ("<foreignself>", f"{base.attr}.{attr}")
+    return None
+
+
+def _is_foreign(key) -> bool:
+    return key[0] in ("<foreign>", "<foreignself>")
 
 
 @dataclasses.dataclass
@@ -71,6 +112,15 @@ class _Unit:
     writes: list = dataclasses.field(default_factory=list)
     # lock_key -> [(line, held_before)]
     acq_sites: list = dataclasses.field(default_factory=list)
+    # Cross-object calls, for the whole-program pass
+    # (analysis/wholeprogram.py):
+    # (held_tuple, kind, ref, method, line) with kind in
+    # {"selfattr", "name", "callresult", "bare"}.
+    ext_calls: list = dataclasses.field(default_factory=list)
+    # Potentially-blocking calls made while holding locks:
+    # (held_tuple, fn_name, n_args, kw_names, receiver_lock_key,
+    #  receiver_name, line).
+    blocking_calls: list = dataclasses.field(default_factory=list)
 
 
 class _ClassInfo:
@@ -163,6 +213,10 @@ class _ModuleScan:
 
     def is_reentrant(self, key) -> bool:
         owner, name = key
+        if _is_foreign(key):
+            # Unknown type → unknown reentrancy: treat as reentrant so
+            # no per-module self-deadlock fires on a foreign key.
+            return True
         if owner == "<module>":
             return self.module_locks.get(name) in _REENTRANT
         cls = self.classes.get(owner)
@@ -205,6 +259,12 @@ class _BodyWalker:
             acquired_here = []
             for item in stmt.items:
                 key = self.scan.lock_key(self.cls, item.context_expr)
+                if key is None:
+                    # ``with coll.lock:`` / ``with self.registry.lock:``
+                    # — ANOTHER object's lock.  Identity needs cross-
+                    # module typing, so the per-module rules skip these
+                    # keys; the whole-program pass resolves them.
+                    key = _foreign_key(item.context_expr)
                 if key is not None:
                     # ``with self._a, self._b:`` acquires in item
                     # order — earlier items count as held for later
@@ -271,6 +331,31 @@ class _BodyWalker:
                         (tgt.attr, node.lineno, tuple(held))
                     )
 
+    def _note_blocking(self, node: ast.Call, held: list) -> None:
+        """Record a possibly-indefinitely-blocking call made while
+        holding locks; the whole-program pass decides which shapes
+        (no timeout argument, receiver kind) are findings."""
+        fn = node.func
+        name = (
+            fn.attr if isinstance(fn, ast.Attribute)
+            else fn.id if isinstance(fn, ast.Name) else None
+        )
+        if name not in self._BLOCKING_NAMES:
+            return
+        receiver_key = receiver_name = None
+        if isinstance(fn, ast.Attribute):
+            receiver_key = self.scan.lock_key(self.cls, fn.value)
+            base = fn.value
+            if isinstance(base, ast.Name):
+                receiver_name = base.id
+            elif isinstance(base, ast.Attribute):
+                receiver_name = base.attr
+        self.unit.blocking_calls.append((
+            tuple(held), name, len(node.args),
+            tuple(kw.arg for kw in node.keywords if kw.arg),
+            receiver_key, receiver_name, node.lineno,
+        ))
+
     @staticmethod
     def _flatten_targets(targets):
         """Unpack tuple/list/starred assignment targets —
@@ -287,6 +372,15 @@ class _BodyWalker:
                 out.append(tgt)
         return out
 
+    #: Callable names whose no-timeout forms can block indefinitely —
+    #: recorded (with the held set) for ``blocking-call-under-lock``
+    #: (analysis/wholeprogram.py evaluates the shapes).
+    _BLOCKING_NAMES = frozenset({
+        "join", "sleep", "wait", "get", "result", "urlopen",
+        "recv", "accept", "connect", "check_output", "check_call",
+        "communicate",
+    })
+
     def _visit_call(self, node: ast.Call, held: list) -> None:
         fn = node.func
         # self.method(...) while holding locks.
@@ -298,6 +392,46 @@ class _BodyWalker:
             self.unit.self_calls.append(
                 (tuple(held), fn.attr, node.lineno)
             )
+        # Cross-object calls, for the whole-program pass: what this
+        # unit invokes on OTHER objects/modules (and with which locks
+        # held) is the raw material for cross-module lock-order
+        # composition.
+        elif isinstance(fn, ast.Attribute):
+            base = fn.value
+            if (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"
+            ):
+                self.unit.ext_calls.append(
+                    (tuple(held), "selfattr", base.attr, fn.attr,
+                     node.lineno)
+                )
+            elif isinstance(base, ast.Name) and base.id != "self":
+                self.unit.ext_calls.append(
+                    (tuple(held), "name", base.id, fn.attr,
+                     node.lineno)
+                )
+            elif isinstance(base, ast.Call):
+                inner = base.func
+                ref = (
+                    inner.attr if isinstance(inner, ast.Attribute)
+                    else inner.id if isinstance(inner, ast.Name)
+                    else None
+                )
+                if ref:
+                    self.unit.ext_calls.append(
+                        (tuple(held), "callresult", ref, fn.attr,
+                         node.lineno)
+                    )
+        elif isinstance(fn, ast.Name):
+            self.unit.ext_calls.append(
+                (tuple(held), "bare", fn.id, None, node.lineno)
+            )
+        # Recorded even with nothing held: a ``*_locked`` helper runs
+        # under its CALLER's lock (the whole-program pass applies the
+        # ambient-lock context when evaluating shapes).
+        self._note_blocking(node, held)
         # threading.Thread(target=self.m) / Thread(target=fn)
         is_thread = (
             isinstance(fn, ast.Attribute) and fn.attr == "Thread"
@@ -383,7 +517,10 @@ def _find_cycle(edges: dict) -> list | None:
 
 def _key_str(key) -> str:
     owner, name = key
-    return name if owner == "<module>" else f"{owner}.{name}"
+    return (
+        name if owner == "<module>" or _is_foreign(key)
+        else f"{owner}.{name}"
+    )
 
 
 def analyze_concurrency(path: str, tree: ast.Module) -> list[Finding]:
@@ -404,8 +541,14 @@ def analyze_concurrency(path: str, tree: ast.Module) -> list[Finding]:
         acq_closure = _closure_acquires(units)
         for unit in units.values():
             # Direct nesting: acquiring `key` while holding `held`.
+            # Foreign keys (another object's lock) are opaque here —
+            # the whole-program pass resolves and orders them.
             for key, line, held in unit.acq_sites:
+                if _is_foreign(key):
+                    continue
                 for h in held:
+                    if _is_foreign(h):
+                        continue
                     if h == key:
                         if not scan.is_reentrant(key):
                             findings.append(Finding(
@@ -423,7 +566,11 @@ def analyze_concurrency(path: str, tree: ast.Module) -> list[Finding]:
                     continue
                 callee_locks = acq_closure.get(callee) or set()
                 for key in callee_locks:
+                    if _is_foreign(key):
+                        continue
                     for h in held:
+                        if _is_foreign(h):
+                            continue
                         if h == key:
                             if not scan.is_reentrant(key):
                                 findings.append(Finding(

@@ -52,9 +52,29 @@ RULES = {
         "body (retraces per shape class)",
     ),
     "loop-no-cancel-check": (
-        WARN,
+        ERROR,
         "long-running loop never consults a cancel token / watchdog "
-        "deadline (cancellation-PR worklist)",
+        "deadline (cooperative cancellation is the contract now)",
+    ),
+    "lock-order-global": (
+        ERROR,
+        "cross-module lock-order cycle in the composed whole-program "
+        "graph (each module individually consistent)",
+    ),
+    "blocking-call-under-lock": (
+        ERROR,
+        "indefinitely-blocking call (join/wait/get/result/sleep/"
+        "urlopen/subprocess without timeout) while holding a lock",
+    ),
+    "lock-name-mismatch": (
+        ERROR,
+        "concurrency_rt.make_lock name differs from the lock's "
+        "static identity (witness edges would not line up)",
+    ),
+    "witness-unmatched-edge": (
+        ERROR,
+        "runtime-witnessed lock order missing from the static "
+        "whole-program graph (static false negative)",
     ),
     "knob-missing-config": (
         ERROR, "LO_TPU_* knob absent from config.py",
@@ -122,6 +142,8 @@ def run_checks(
     *,
     repo_root: str | Path | None = None,
     drift: bool = True,
+    whole_program: bool = False,
+    witness_dump: str | Path | None = None,
 ) -> Report:
     """Run every analyzer family over ``package_root``.
 
@@ -129,6 +151,11 @@ def run_checks(
     manifests, README, tests); default: the package root's parent.
     ``drift=False`` runs only the per-module analyzers — what the
     golden tests use on synthetic fixture trees.
+    ``whole_program=True`` additionally composes the per-module lock
+    models into the global graph (cross-module inversions,
+    blocking-call-under-lock, make_lock name congruence), and
+    ``witness_dump`` cross-checks a runtime witness snapshot
+    (``LO_TPU_WITNESS_DUMP`` JSON) against that graph.
     """
     package_root = Path(package_root)
     repo_root = Path(
@@ -136,6 +163,7 @@ def run_checks(
     )
     findings: list[Finding] = []
     texts: dict[str, str] = {}
+    trees: dict[str, ast.Module] = {}
     parse_errors: list = []
     files = [
         p for p in sorted(package_root.rglob("*.py"))
@@ -149,9 +177,21 @@ def run_checks(
         except SyntaxError as exc:
             parse_errors.append((str(path), str(exc)))
             continue
+        trees[str(path)] = tree
         findings += analyze_concurrency(str(path), tree)
         findings += analyze_jax(str(path), tree)
         findings += analyze_cancellation(str(path), tree, text)
+    if whole_program:
+        from .wholeprogram import analyze_wholeprogram
+
+        wp_findings, graph = analyze_wholeprogram(
+            package_root, trees
+        )
+        findings += wp_findings
+        if witness_dump is not None:
+            from .witness import cross_check, load_dump
+
+            findings += cross_check(load_dump(witness_dump), graph)
     if drift:
         paths = DriftPaths.for_repo(repo_root)
         drift_findings = analyze_drift(paths)

@@ -23,6 +23,7 @@ from typing import Callable
 from urllib.parse import parse_qs, urlparse
 
 from learningorchestra_tpu import faults
+from learningorchestra_tpu.concurrency_rt import make_lock
 from learningorchestra_tpu.config import Config, get_config
 from learningorchestra_tpu.jobs.leases import LeaseTimeout
 from learningorchestra_tpu.obs import metrics as obs_metrics
@@ -157,7 +158,7 @@ class _Slot:
 
     def __init__(self, sem):
         self._sem = sem
-        self._lock = threading.Lock()
+        self._lock = make_lock("_Slot._lock")
         self._owners = 1
 
     def share(self) -> None:
@@ -247,7 +248,7 @@ class APIServer:
         # reset_registry() mid-life re-homes both the push metrics and
         # the collector instead of splitting them across registries.
         self._obs_registry = None
-        self._obs_rebind_lock = threading.Lock()
+        self._obs_rebind_lock = make_lock("APIServer._obs_rebind_lock")
         self._obs_handles()
         self.router = Router(self.config.api.api_prefix)
         self._register_routes()
@@ -255,9 +256,9 @@ class APIServer:
         # Gateway budget (reference: krakend.json global timeout /
         # cache_ttl / metrics exporter on :8090 — SURVEY §5.1, §6).
         self._cache: dict[tuple, tuple] = {}
-        self._cache_lock = threading.Lock()
+        self._cache_lock = make_lock("APIServer._cache_lock")
         self._metrics: dict[str, dict] = {}
-        self._metrics_lock = threading.Lock()
+        self._metrics_lock = make_lock("APIServer._metrics_lock")
         n_inflight = self.config.api.max_inflight
         self._inflight = (
             threading.BoundedSemaphore(n_inflight)
@@ -270,14 +271,14 @@ class APIServer:
         # path (kept-alive connections get 503+close) and ends the
         # fence watch; the lock+flag make shutdown() idempotent.
         self._shutting_down = threading.Event()
-        self._shutdown_lock = threading.Lock()
+        self._shutdown_lock = make_lock("APIServer._shutdown_lock")
         self._shut_down = False
         # Idempotency ledger (mongo's retryable-writes txnNumber,
         # reference: docker-compose.yml:42-90 replica set + driver
         # retry).  Lives in the DOCUMENT STORE so records WAL-ship to
         # the standby: a mutation retried across a failover replays
         # its recorded response instead of executing twice.
-        self._idem_lock = threading.Lock()
+        self._idem_lock = make_lock("APIServer._idem_lock")
         self._idem_writes = 0
         # Without shared storage, a primary revived DURING a standby's
         # promotion can serve until its fence watch first polls the
@@ -1680,6 +1681,20 @@ class APIServer:
             return 200, obs_costs.snapshot()
 
         add("GET", r"/observability/costs", costs_view)
+
+        # ---- Runtime lock witness (concurrency_rt.py) ----
+        # The deadlock-diagnosis surface: witnessed acquisition-order
+        # edges, held-while-blocking contention events, and every
+        # currently held/contended lock with its holder, waiters and
+        # their live thread stacks.  Meaningful under LO_TPU_WITNESS=1
+        # (otherwise answers enabled=false with empty data — the
+        # endpoint stays probeable either way).
+        def locks_view(m, body, query):
+            from learningorchestra_tpu import concurrency_rt
+
+            return 200, concurrency_rt.snapshot(include_stacks=True)
+
+        add("GET", r"/observability/locks", locks_view)
 
         # ---- Fault-injection plane (faults/plane.py) ----
         # The chaos drill's REST surface: inspect every registered

@@ -870,6 +870,14 @@ class _Observability:
         the server configured its chips' peak FLOP/s)."""
         return self.ctx.request("GET", "/observability/costs")
 
+    def locks(self) -> dict:
+        """GET /observability/locks — the runtime lock witness's
+        deadlock-diagnosis dump (LO_TPU_WITNESS=1): witnessed
+        acquisition-order edges, held-while-blocking events, and
+        every held/contended lock with holder, waiters and live
+        thread stacks."""
+        return self.ctx.request("GET", "/observability/locks")
+
     # -- windowed rollups + SLO alerting --------------------------------
 
     def timeseries(self, name: str | None = None,

@@ -12,8 +12,9 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import threading
 from pathlib import Path
+
+from learningorchestra_tpu.concurrency_rt import make_lock
 
 
 @dataclasses.dataclass
@@ -100,6 +101,13 @@ class JobConfig:
     # and reclaims its worker and chip leases.  <= 0 disables;
     # per-submit ``deadlineS`` overrides.  Env: LO_TPU_JOB_DEADLINE_S.
     deadline_s: float = 0.0
+    # Graceful-shutdown drain budget: shutdown(wait=True) waits at
+    # most this long for accepted work, then flips every outstanding
+    # body's cancel token (jobs/cancel.py), cancels still-queued
+    # futures and abandons non-cooperating threads after a short
+    # grace — a deadline-failed zombie can no longer hang shutdown.
+    # <= 0 keeps the legacy unbounded drain.  Env: LO_TPU_JOB_DRAIN_S.
+    shutdown_drain_s: float = 0.0
 
 
 @dataclasses.dataclass
@@ -536,6 +544,10 @@ class Config:
             )
         if "LO_TPU_JOB_DEADLINE_S" in env:
             cfg.jobs.deadline_s = float(env["LO_TPU_JOB_DEADLINE_S"])
+        if "LO_TPU_JOB_DRAIN_S" in env:
+            cfg.jobs.shutdown_drain_s = float(
+                env["LO_TPU_JOB_DRAIN_S"]
+            )
         # Fault-injection schedules: every LO_TPU_FAULT_<POINT> var is
         # carried verbatim; the API server arms them via faults.load_env
         # (bad specs are rejected LOUDLY there — a typo'd chaos knob
@@ -785,9 +797,17 @@ DIRECT_ENV_KNOBS = (
     "LO_TPU_FLASH_INTERPRET",  # ops/attention.py: "1" forces the
                                # Pallas interpreter, "0" forces
                                # compiled kernels
+    # Runtime lock witness (concurrency_rt.py) — read at lock-
+    # construction time, which happens while THIS module is still
+    # importing (config's own singleton lock), so they cannot ride
+    # Config.from_env.
+    "LO_TPU_WITNESS",          # "1" instruments make_lock/make_rlock
+    "LO_TPU_WITNESS_STALL_S",  # stall-watchdog threshold (default 30)
+    "LO_TPU_WITNESS_DUMP",     # path: dump the witnessed-order graph
+                               # JSON at exit for lo_check --witness
 )
 
-_lock = threading.Lock()
+_lock = make_lock("config._lock")
 _config: Config | None = None
 
 

@@ -54,10 +54,10 @@ bench.py's ``_faults_probe`` pins the number.
 
 from __future__ import annotations
 
-import threading
 import time
 import zlib
 
+from learningorchestra_tpu.concurrency_rt import make_lock
 from learningorchestra_tpu.log import get_logger, kv
 
 logger = get_logger("faults")
@@ -164,7 +164,7 @@ def _random():
     return random
 
 
-_LOCK = threading.Lock()
+_LOCK = make_lock("plane._LOCK")
 #: point -> FaultSchedule.  THE fast-path gate: empty means the whole
 #: plane is disabled and :func:`hit` returns after one truthiness check.
 _ARMED: dict[str, FaultSchedule] = {}

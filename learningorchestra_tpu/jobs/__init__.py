@@ -2,6 +2,11 @@
 ``ThreadPoolExecutor.submit(__pipeline)`` pattern (e.g. reference:
 microservices/binary_executor_image/binary_execution.py:139,155-186)."""
 
+from learningorchestra_tpu.jobs.cancel import (
+    CancelToken,
+    cancel_requested,
+    current_cancel_token,
+)
 from learningorchestra_tpu.jobs.engine import (
     JobDeadlineExceeded,
     JobEngine,
@@ -11,9 +16,12 @@ from learningorchestra_tpu.jobs.engine import (
 )
 
 __all__ = [
+    "CancelToken",
     "JobDeadlineExceeded",
     "JobEngine",
     "JobState",
     "Preempted",
+    "cancel_requested",
     "current_attempt",
+    "current_cancel_token",
 ]

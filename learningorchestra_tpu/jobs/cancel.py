@@ -1,0 +1,86 @@
+"""Cooperative cancellation tokens for job bodies.
+
+Python threads cannot be killed: the engine's deadline watchdog can
+fail an overdue job and reclaim its worker slot and chip leases, but
+the BODY keeps running as a zombie until it finishes on its own.  The
+token closes that gap cooperatively — the engine binds one per
+dispatched job (a contextvar, so it is readable anywhere down the job
+body's call stack without threading a parameter through every layer),
+flips it when the watchdog expires the job or a bounded shutdown drain
+runs out of budget, and long-running bodies poll it between units of
+work (the fit surfaces check it at every epoch boundary and wind down
+exactly like an early stop).
+
+The static rule ``loop-no-cancel-check`` (analysis/cancellation.py,
+error severity) enforces the other half of the contract: a
+long-running loop in the job-execution or serving planes that never
+consults a cancel/stop/deadline signal fails the build.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+
+
+class CancelToken:
+    """One job's cancellation flag: set-once, thread-safe, poll-cheap.
+
+    ``cancel()`` is idempotent and keeps the FIRST reason (the earliest
+    cause — a watchdog deadline — is the one worth reporting, not the
+    shutdown sweep that followed it)."""
+
+    __slots__ = ("_event", "_reason")
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self._reason = ""
+
+    def cancel(self, reason: str = "") -> None:
+        if reason and not self._reason:
+            self._reason = reason
+        self._event.set()
+
+    def cancelled(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def reason(self) -> str:
+        return self._reason
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until cancelled (or ``timeout``); → cancelled state.
+        Lets a body sleep interruptibly instead of ``time.sleep``."""
+        return self._event.wait(timeout)
+
+
+#: The calling job body's token (None outside a dispatched job).
+_TOKEN: contextvars.ContextVar = contextvars.ContextVar(
+    "lo_cancel_token", default=None
+)
+
+
+def current_cancel_token() -> CancelToken | None:
+    """The token bound around the current job dispatch, or None when
+    not running under the engine (direct library use, tests)."""
+    return _TOKEN.get()
+
+
+def cancel_requested() -> bool:
+    """True when the engine asked the current job body to wind down
+    (watchdog deadline expiry or a bounded shutdown drain).  One
+    contextvar read + one Event check — cheap enough per epoch/batch."""
+    token = _TOKEN.get()
+    return token is not None and token.cancelled()
+
+
+@contextlib.contextmanager
+def bind(token: CancelToken | None):
+    """Bind ``token`` as the current job body's cancel token (the
+    engine wraps each dispatch; tests wrap bodies directly)."""
+    handle = _TOKEN.set(token)
+    try:
+        yield token
+    finally:
+        _TOKEN.reset(handle)

@@ -37,6 +37,9 @@ from concurrent.futures import Future, InvalidStateError
 from typing import Any, Callable
 
 from learningorchestra_tpu import faults
+from learningorchestra_tpu.concurrency_rt import make_lock
+from learningorchestra_tpu.jobs import cancel as jobs_cancel
+from learningorchestra_tpu.jobs.cancel import CancelToken
 from learningorchestra_tpu.log import capture_thread_stdout, get_logger, kv
 from learningorchestra_tpu.obs import tracing
 from learningorchestra_tpu.store import ArtifactStore
@@ -117,6 +120,7 @@ class JobEngine:
         retry_backoff_s: float = 0.05,
         retry_backoff_max_s: float = 5.0,
         deadline_s: float = 0.0,
+        shutdown_drain_s: float = 0.0,
     ):
         self.artifacts = artifacts
         self.max_workers = max_workers
@@ -141,6 +145,13 @@ class JobEngine:
         # retries included); <= 0 disables.  Per-submit deadline_s
         # overrides.
         self.default_deadline_s = float(deadline_s)
+        # Graceful-shutdown drain budget: shutdown(wait=True) waits at
+        # most this long for running/queued work, then flips every
+        # outstanding body's cancel token and joins with a short grace
+        # before abandoning stragglers.  <= 0 keeps the legacy
+        # unbounded drain (cooperating bodies still exit early when
+        # the watchdog cancels them).  Env: LO_TPU_JOB_DRAIN_S.
+        self.shutdown_drain_s = float(shutdown_drain_s)
         # Chip-lease pool (set by the service context): the deadline
         # watchdog revokes an expired job's leases through it so the
         # zombie body cannot pin chips it no longer owns.
@@ -152,7 +163,7 @@ class JobEngine:
         self._watchdog_wake = threading.Event()
         self._futures: dict[str, Future] = {}
         self._last_tracebacks: dict[str, str] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("JobEngine._lock")
         # Weighted-fair dispatch state: per-class FIFO queues served by
         # weighted round-robin as workers free up.  A class's weight is
         # how many consecutive dispatches it gets per turn (default 1 —
@@ -264,8 +275,19 @@ class JobEngine:
         # every terminal write below checks it and discards instead of
         # overwriting the watchdog's recorded failure.
         ctl = {"expired": False}
+        # Cooperative-cancellation token, bound around the dispatch so
+        # the body can poll jobs_cancel.cancel_requested() anywhere
+        # down its stack.  The watchdog flips it on deadline expiry
+        # (zombies exit early instead of running to completion
+        # discarded) and the bounded shutdown drain flips it when its
+        # budget runs out.
+        token = CancelToken()
 
         def run() -> Any:
+            with jobs_cancel.bind(token):
+                return _run_attempts()
+
+        def _run_attempts() -> Any:
             meta = self.artifacts.metadata
             ledger = self.artifacts.ledger
             attempts = 0
@@ -304,6 +326,23 @@ class JobEngine:
                     # just-revoked leases.
                     logger.warning(kv(job=name, state="abandoned",
                                       **req))
+                    return None
+                if token.cancelled():
+                    # Cancelled between attempts without a deadline
+                    # expiry: the bounded shutdown drain.  Record the
+                    # terminal state (no watchdog wrote one) and stop
+                    # instead of starting an attempt the process
+                    # won't outlive.
+                    err = (
+                        f"cancelled: "
+                        f"{token.reason or 'engine shutdown'}"
+                    )
+                    logger.warning(kv(job=name, state="cancelled",
+                                      **req))
+                    try:
+                        meta.mark_failed(name, err)
+                    except Exception:  # noqa: BLE001
+                        pass
                     return None
                 # One span PER ATTEMPT (attrs attempt=1..N): retries
                 # are separate intervals in the persisted trace, not
@@ -471,6 +510,7 @@ class JobEngine:
             "job_class": job_class,
             "deadline": deadline,
             "ctl": ctl,
+            "token": token,
         }
         with self._lock:
             if self._shutdown:
@@ -503,7 +543,15 @@ class JobEngine:
         logger.info(kv(job=name, state="backoff",
                        delay=f"{delay:.3f}s", attempt=attempt, **req))
         t0 = time.monotonic()
-        time.sleep(delay)
+        # Interruptible: a bounded shutdown drain (or the deadline
+        # watchdog) flipping the token mid-backoff wakes the sleep —
+        # otherwise a fully cooperative job could outsleep the drain's
+        # grace window and be abandoned.
+        token = jobs_cancel.current_cancel_token()
+        if token is not None:
+            token.wait(delay)
+        else:
+            time.sleep(delay)
         if trace is not None:
             trace.add_span(
                 "retry_backoff", t0, time.monotonic(),
@@ -594,6 +642,7 @@ class JobEngine:
             "deadline": info["deadline"],
             "job_class": info["job_class"],
             "ctl": info["ctl"],
+            "token": info["token"],
             "t0": time.monotonic(),
             "released": False,
         }
@@ -697,9 +746,15 @@ class JobEngine:
                         # Reclaim the worker NOW: the hung body keeps
                         # its thread (unkillable), but stops counting
                         # against max_workers so queued work
-                        # dispatches.
+                        # dispatches.  Flipping the cancel token asks
+                        # the zombie to exit early (fit loops poll it
+                        # per epoch) instead of running to completion
+                        # discarded.
                         rec["released"] = True
                         rec["ctl"]["expired"] = True
+                        rec["token"].cancel(
+                            f"deadline {deadline:g}s exceeded"
+                        )
                         del self._running_recs[name]
                         self._inflight -= 1
                         expired.append((name, rec))
@@ -823,7 +878,28 @@ class JobEngine:
                 if q or include_empty
             }
 
-    def shutdown(self, wait: bool = True) -> None:
+    #: Post-cancel join grace inside a bounded shutdown drain: once
+    #: the drain budget lapses and every outstanding token is flipped,
+    #: cooperating bodies get this long to wind down before being
+    #: abandoned (they poll the token per epoch/batch, so the grace
+    #: only needs to cover one unit of work).
+    SHUTDOWN_GRACE_S = 2.0
+
+    def shutdown(self, wait: bool = True,
+                 drain_timeout_s: float | None = None,
+                 grace_s: float | None = None) -> None:
+        """Stop accepting work; with ``wait``, drain what was accepted.
+
+        The drain is BOUNDED when ``drain_timeout_s`` (default: the
+        engine's ``shutdown_drain_s``) is positive: past the budget,
+        every outstanding job's cancel token is flipped — cooperating
+        bodies (the fit surfaces poll per epoch) exit early as if
+        early-stopped — still-queued futures are cancelled, and after
+        ``grace_s`` any thread still running is abandoned (logged)
+        rather than joined forever.  A deadline-expired zombie can
+        therefore no longer hang a graceful shutdown.  ``<= 0`` keeps
+        the legacy unbounded drain.
+        """
         with self._lock:
             self._shutdown = True
             self._watchdog_wake.set()
@@ -834,11 +910,17 @@ class JobEngine:
             # Without the kick, jobs queued behind idle workers would
             # be orphaned with their metadata stuck at "pending".
             # (Deadlines stop being enforced here — the watchdog is
-            # exiting and shutdown(wait=True) waits for every body,
-            # zombies included, anyway.)
+            # exiting; the drain budget below bounds the wait instead.)
             self._dispatch_locked()
         if not wait:
             return
+        budget = (
+            self.shutdown_drain_s if drain_timeout_s is None
+            else float(drain_timeout_s)
+        )
+        deadline = (
+            time.monotonic() + budget if budget > 0 else None
+        )
         while True:
             with self._lock:
                 thread = next(iter(self._threads), None)
@@ -849,9 +931,63 @@ class JobEngine:
                 )
             if drained:
                 return
+            if deadline is not None and time.monotonic() >= deadline:
+                break  # budget spent — cooperative-cancel phase
             if thread is None:
                 # Transient gap between a worker freeing and the next
                 # queued job's thread appearing.
                 time.sleep(0.005)
                 continue
-            thread.join()
+            if deadline is None:
+                thread.join()
+            else:
+                thread.join(
+                    min(0.2, max(0.0, deadline - time.monotonic()))
+                )
+        # Drain budget exhausted: cancel everything outstanding —
+        # running bodies via their tokens (zombies were already
+        # cancelled by the watchdog at expiry), queued-never-
+        # dispatched jobs via their futures so waiters unblock — then
+        # give cooperating threads one grace window and abandon the
+        # rest (they are daemon threads; their writes race nothing:
+        # the store outlives them only within this process).
+        with self._lock:
+            stragglers = list(self._threads)
+            for rec in self._running_recs.values():
+                rec["token"].cancel("engine shutdown drain deadline")
+            dropped: list[str] = []
+            for queue in self._queues.values():
+                for _runner, queued_future, _wk, qinfo in queue:
+                    if queued_future.cancel():
+                        dropped.append(qinfo["name"])
+                queue.clear()
+        # Same terminal metadata the explicit cancel() path writes —
+        # without it the pre-created doc would sit at "pending"
+        # forever (phantom jobs after restart).  Outside the lock:
+        # store writes.
+        for name in dropped:
+            try:
+                self.artifacts.metadata.update(
+                    name,
+                    {"jobState": JobState.CANCELLED,
+                     "finished": False},
+                )
+            except Exception:  # noqa: BLE001 — shutdown must finish
+                pass
+        grace = (
+            self.SHUTDOWN_GRACE_S if grace_s is None
+            else float(grace_s)
+        )
+        grace_deadline = time.monotonic() + max(0.0, grace)
+        for thread in stragglers:
+            thread.join(
+                max(0.0, grace_deadline - time.monotonic())
+            )
+        leftover = [t.name for t in stragglers if t.is_alive()]
+        if dropped or leftover:
+            logger.error(kv(
+                event="shutdown_drain_bounded",
+                budgetS=budget, droppedQueued=len(dropped),
+                abandoned=len(leftover),
+                threads=",".join(leftover[:8]),
+            ))

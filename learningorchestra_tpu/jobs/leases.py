@@ -27,11 +27,11 @@ is how the unit tests exercise the serialization property.
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
 from typing import Sequence
 
 from learningorchestra_tpu import faults
+from learningorchestra_tpu.concurrency_rt import make_condition, make_lock
 from learningorchestra_tpu.log import get_logger, kv
 from learningorchestra_tpu.obs import tracing
 
@@ -74,7 +74,7 @@ class DeviceLeaser:
     """Blocking lease manager over a fixed set of accelerator devices."""
 
     def __init__(self, device_ids: Sequence[str] | None = None):
-        self._cv = threading.Condition()
+        self._cv = make_condition("DeviceLeaser._cv")
         self._explicit = list(device_ids) if device_ids is not None else None
         self._free: list[str] | None = None
         self._all: list[str] = []
@@ -293,7 +293,7 @@ class LeaseHandle:
     def __init__(self, cm, devices: list[str]):
         self._cm = cm
         self.devices = devices
-        self._lock = threading.Lock()
+        self._lock = make_lock("LeaseHandle._lock")
         self._released = False
 
     def release(self) -> None:

@@ -24,6 +24,8 @@ import os
 import sys
 import threading
 
+from learningorchestra_tpu.concurrency_rt import make_lock
+
 _ROOT = "lo"
 _configured = False
 
@@ -84,7 +86,7 @@ class _StdoutRouter(io.TextIOBase):
         return True
 
 
-_router_lock = threading.Lock()
+_router_lock = make_lock("log._router_lock")
 
 
 @contextlib.contextmanager

@@ -17,11 +17,11 @@ from __future__ import annotations
 import ctypes
 import json
 import subprocess
-import threading
 from pathlib import Path
 from typing import Any, Iterable
 
 from learningorchestra_tpu import faults
+from learningorchestra_tpu.concurrency_rt import make_lock
 from learningorchestra_tpu.store.document_store import (
     DuplicateKey,
     NoSuchCollection,
@@ -32,7 +32,7 @@ _REPO_ROOT = Path(__file__).resolve().parent.parent.parent
 _NATIVE_DIR = _REPO_ROOT / "native"
 _LIB_PATH = _NATIVE_DIR / "build" / "liblodstore.so"
 
-_build_lock = threading.Lock()
+_build_lock = make_lock("native._build_lock")
 _lib: ctypes.CDLL | None = None
 _build_failed = False
 

@@ -51,9 +51,10 @@ import contextlib
 import contextvars
 import dataclasses
 import math
-import threading
 import time
 from collections import OrderedDict
+
+from learningorchestra_tpu.concurrency_rt import make_lock
 
 __all__ = [
     "CostLedger",
@@ -130,7 +131,7 @@ class CostLedger:
 
     def __init__(self, max_programs: int = 256):
         self.max_programs = max(1, int(max_programs))
-        self._lock = threading.Lock()
+        self._lock = make_lock("CostLedger._lock")
         self._programs: OrderedDict[str, ProgramCost] = OrderedDict()
         self.analyses = 0
         self.analysis_failures = 0
@@ -237,7 +238,7 @@ class DeviceTimeLedger:
         self._stride = (
             max(1, round(1.0 / self.sample)) if self.sample > 0 else 0
         )
-        self._lock = threading.Lock()
+        self._lock = make_lock("DeviceTimeLedger._lock")
         # PER-KEY stride counters (bounded ring): one global counter
         # would alias deterministic interleavings — two models whose
         # dispatches strictly alternate at stride 2 would leave one
@@ -435,7 +436,7 @@ def mfu(flops: float, device_s: float, *,
 
 # -- process-wide singletons --------------------------------------------------
 
-_lock = threading.Lock()
+_lock = make_lock("costs._lock")
 _ledger: CostLedger | None = None
 _devtime: DeviceTimeLedger | None = None
 _cfg_cache = None

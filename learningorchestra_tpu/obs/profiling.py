@@ -3,6 +3,7 @@
 The monitoring service already wraps distributed train jobs in
 ``jax.profiler.trace`` sessions, but nothing could capture a profile
 from a LIVE process — the "serving p99 regressed in production, what
+
 is the device doing right now?" workflow.  This module owns that:
 
 - ``start(...)`` opens ONE capture at a time (a second start answers
@@ -27,6 +28,8 @@ import re
 import shutil
 import threading
 import time
+
+from learningorchestra_tpu.concurrency_rt import make_lock
 
 __all__ = [
     "ProfilerConflict",
@@ -60,7 +63,7 @@ class ProfilerService:
         self.root = str(root)
         self.max_seconds = float(max_seconds)
         self.max_captures = max(1, int(max_captures))
-        self._lock = threading.Lock()
+        self._lock = make_lock("ProfilerService._lock")
         self._active: dict | None = None
         # True while a stop's (potentially multi-second) trace flush
         # runs OUTSIDE the lock: a start arriving in that window

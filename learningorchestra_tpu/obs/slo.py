@@ -46,6 +46,7 @@ import json
 import threading
 import time
 
+from learningorchestra_tpu.concurrency_rt import make_lock
 from learningorchestra_tpu.log import get_logger, kv
 
 logger = get_logger("slo")
@@ -145,7 +146,7 @@ class SLOService:
 
     def __init__(self, cfg):
         self.cfg = cfg
-        self._lock = threading.Lock()
+        self._lock = make_lock("SLOService._lock")
         self.objectives: list[_Objective] = []
         if cfg.availability_target > 0:
             self.objectives.append(_Objective(
@@ -454,7 +455,7 @@ class SLOService:
 # -- process-wide singleton ---------------------------------------------------
 
 _service: SLOService | None = None
-_service_lock = threading.Lock()
+_service_lock = make_lock("slo._service_lock")
 
 
 def get_service() -> SLOService:

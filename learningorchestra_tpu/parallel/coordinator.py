@@ -29,6 +29,7 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 
+from learningorchestra_tpu.concurrency_rt import make_lock
 from learningorchestra_tpu.log import get_logger, kv
 
 logger = get_logger("coordinator")
@@ -67,7 +68,7 @@ def init_multihost(
 # -- function registry (the anti-`exec` boundary) ---------------------------
 
 _functions: dict[str, Callable] = {}
-_functions_lock = threading.Lock()
+_functions_lock = make_lock("coordinator._functions_lock")
 
 
 def register_function(name: str, fn: Callable | None = None):
@@ -106,7 +107,7 @@ class Coordinator:
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        self._lock = threading.Lock()
+        self._lock = make_lock("Coordinator._lock")
         self._agents: dict[str, dict] = {}
         self._jobs: dict[str, dict] = {}
         self._next_job = 0

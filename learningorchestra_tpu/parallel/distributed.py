@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from learningorchestra_tpu.jobs.cancel import cancel_requested
 from learningorchestra_tpu.obs import tracing as obs_tracing
 from learningorchestra_tpu.parallel.mesh import MeshSpec, build_mesh
 from learningorchestra_tpu.parallel.sharding import param_shardings
@@ -455,6 +456,12 @@ class DistributedTrainer:
                 last_save = time.monotonic()
                 ran = 0  # epochs executed THIS call (early stop may cut short)
                 for epoch_i in range(start_epoch, epochs):
+                    if cancel_requested():
+                        # Engine-side cancellation (deadline watchdog
+                        # or bounded shutdown drain): wind down like
+                        # an early stop.
+                        self.stop_training = True
+                        break
                     ran += 1
                     t0 = time.perf_counter()
                     params, opt_state, metrics = self._epoch_fn(
@@ -665,6 +672,10 @@ class DistributedTrainer:
                     max_workers=1, thread_name_prefix="shard-io"
                 ) as io:
                     for epoch_i in range(start_epoch, epochs):
+                        if cancel_requested():
+                            # Same contract as the in-memory loop.
+                            self.stop_training = True
+                            break
                         ran += 1
                         t0 = time.perf_counter()
                         # Same shard order on every process.

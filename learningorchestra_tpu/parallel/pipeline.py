@@ -44,6 +44,7 @@ from flax import linen as nn
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from learningorchestra_tpu.jobs.cancel import cancel_requested
 from learningorchestra_tpu.parallel.mesh import MeshSpec, build_mesh
 from learningorchestra_tpu.toolkit.registry import register
 from learningorchestra_tpu.train.neural import (
@@ -741,6 +742,12 @@ class PipelinedTransformer:
                 rng.permutation(n)
         try:
             for epoch_i in range(start_epoch, epochs):
+                if cancel_requested():
+                    # Engine-side cancellation (deadline watchdog or
+                    # bounded shutdown drain): wind down like an
+                    # early stop.
+                    self.stop_training = True
+                    break
                 order = rng.permutation(n) if shuffle else np.arange(n)
                 totals: dict = {}
                 wsum = self._weighted_update(
@@ -829,6 +836,10 @@ class PipelinedTransformer:
         ) as io:
             try:
                 for epoch_i in range(start_epoch, epochs):
+                    if cancel_requested():
+                        # Same contract as the in-memory loop.
+                        self.stop_training = True
+                        break
                     order = (
                         np.random.default_rng(
                             [self.seed, 3, epoch_i]

@@ -23,6 +23,7 @@ from __future__ import annotations
 import threading
 import time
 
+from learningorchestra_tpu.concurrency_rt import make_lock
 from learningorchestra_tpu.serve.fleet.autoscaler import Autoscaler
 from learningorchestra_tpu.serve.fleet.replicaset import ReplicaSet
 
@@ -38,7 +39,7 @@ class FleetManager:
         # deployment default LO_TPU_FLEET_MAX would fleet it); an
         # absent key falls back to the deployment default.
         self._bounds: dict[str, tuple[int, int] | None] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("FleetManager._lock")
         # Per-model creation coalescing (the ModelRegistry idiom): a
         # set is only REGISTERED once its first replica is placed, so
         # concurrent predicts during the (possibly seconds-long) lease

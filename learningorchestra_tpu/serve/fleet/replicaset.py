@@ -28,12 +28,12 @@ in-flight predict is dropped.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Callable
 
 import numpy as np
 
+from learningorchestra_tpu.concurrency_rt import make_lock
 from learningorchestra_tpu.log import get_logger, kv
 from learningorchestra_tpu.obs import tracing
 from learningorchestra_tpu.serve.batcher import (
@@ -182,13 +182,13 @@ class ReplicaSet:
             (int(router_seed) << 32) ^ zlib.crc32(name.encode())
         )
         self._replicas: list[Replica] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("ReplicaSet._lock")
         # Scaling is serialized separately from the routing lock: a
         # lease acquisition may block for seconds, and two concurrent
         # scalers (autoscaler tick + manual POST + lazy ensure) must
         # converge on one target instead of overshooting; routing
         # meanwhile keeps reading the replica list freely.
-        self._scale_lock = threading.Lock()
+        self._scale_lock = make_lock("ReplicaSet._scale_lock")
         self._closed = False
         self.scale_ups = 0
         self.scale_downs = 0

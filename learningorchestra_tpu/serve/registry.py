@@ -7,6 +7,7 @@ parameters on device, and keeps them resident across requests — the
 "params live in HBM, host sees them at job edges" discipline extended
 from training to serving.
 
+
 - LRU with BOTH an entry cap and a byte cap (real bytes: the sum of
   parameter leaf ``nbytes`` — unlike compiled executables, parameter
   residency is exactly measurable), ``LO_TPU_SERVE_*`` knobs;
@@ -21,6 +22,8 @@ import threading
 import time
 from collections import OrderedDict
 from typing import Any, Callable
+
+from learningorchestra_tpu.concurrency_rt import make_lock
 
 
 class ServeError(Exception):
@@ -92,7 +95,7 @@ class ModelRegistry:
         self.max_models = int(max_models)
         self.max_bytes = int(max_bytes)
         self._entries: OrderedDict[str, _Resident] = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = make_lock("ModelRegistry._lock")
         # Per-name load coalescing: concurrent first requests for one
         # model must pay a single artifact read + device upload.
         self._loading: dict[str, threading.Event] = {}

@@ -17,11 +17,11 @@ its resident weights before the next request.
 from __future__ import annotations
 
 import os
-import threading
 import time
 
 import numpy as np
 
+from learningorchestra_tpu.concurrency_rt import make_lock
 from learningorchestra_tpu.jobs.leases import LeaseTimeout
 from learningorchestra_tpu.obs.metrics import get_registry
 from learningorchestra_tpu.serve.batcher import MicroBatcher
@@ -97,7 +97,7 @@ class ServingService:
         # read per predict, no thread) until a model's replica bounds
         # allow max > 1.
         self.fleet = FleetManager(self)
-        self._lock = threading.Lock()
+        self._lock = make_lock("ServingService._lock")
         self._closed = False
         # tfevents snapshot state: a fixed wall_time keeps one stable
         # events file that each snapshot rewrites with the (windowed)
@@ -106,7 +106,7 @@ class ServingService:
         # and break the CRC framing.
         self._t0 = time.time()
         self._scalar_history: dict[str, list] = {}
-        self._scalar_lock = threading.Lock()
+        self._scalar_lock = make_lock("ServingService._scalar_lock")
         ctx.add_artifact_change_listener(self._on_artifact_changed)
 
     # -- model residency -----------------------------------------------------

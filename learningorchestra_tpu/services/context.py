@@ -60,6 +60,7 @@ class ServiceContext:
             retry_backoff_s=self.config.jobs.retry_backoff_s,
             retry_backoff_max_s=self.config.jobs.retry_backoff_max_s,
             deadline_s=self.config.jobs.deadline_s,
+            shutdown_drain_s=self.config.jobs.shutdown_drain_s,
         )
         self.loader = StoreLoader(self)
         from learningorchestra_tpu.services.webhooks import (
@@ -201,7 +202,15 @@ class ServiceContext:
         compile_cache.get_cache().remove_invalidation_listener(
             getattr(self, "_warm_hint_listener", None)
         )
-        self.engine.shutdown(wait=False)
+        # With a drain budget configured (LO_TPU_JOB_DRAIN_S — both
+        # deploy manifests set one) the graceful path WAITS, bounded:
+        # running bodies get their cancel tokens flipped past the
+        # budget and stragglers are abandoned after a grace.  Without
+        # one, keep the legacy non-blocking close (never hang a
+        # SIGTERM on an unbounded drain).
+        self.engine.shutdown(
+            wait=self.config.jobs.shutdown_drain_s > 0
+        )
         self.documents.close()
 
     # -- validation helpers shared by services --------------------------------

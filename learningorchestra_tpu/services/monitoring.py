@@ -30,6 +30,8 @@ import threading
 import time
 from typing import Any
 
+from learningorchestra_tpu.concurrency_rt import make_lock
+
 _PORT_RE = re.compile(r"http://[^\s:]+:(\d+)")
 # First char alphanumeric/underscore: forbids '.', '..' and path escapes.
 _NICK_RE = re.compile(r"[A-Za-z0-9_][A-Za-z0-9_.\-]*")
@@ -87,7 +89,7 @@ class MonitoringService:
         self.host = "0.0.0.0" if external_host else host
         self.external_host = external_host
         self._sessions: dict[str, MonitoringSession] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("MonitoringService._lock")
 
     # -- session lifecycle ---------------------------------------------------
 

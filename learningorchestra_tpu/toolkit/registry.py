@@ -12,10 +12,11 @@ a client that posts ``{"modulePath": "sklearn.linear_model", "class":
 from __future__ import annotations
 
 import inspect
-import threading
 from typing import Any, Callable
 
-_lock = threading.Lock()
+from learningorchestra_tpu.concurrency_rt import make_lock
+
+_lock = make_lock("registry._lock")
 _registry: dict[tuple[str, str], Callable] = {}
 _loaded = False
 

@@ -37,6 +37,8 @@ import os
 import shutil
 from pathlib import Path
 
+from learningorchestra_tpu.concurrency_rt import make_lock
+
 KEEP = 2  # retained checkpoints; older ones are pruned after each save
 
 
@@ -83,18 +85,17 @@ def _publish(directory: Path, step: int, history: dict | None) -> None:
 # so a single global slot would let one job's finalize swallow (or
 # republish over) another's marker.  Each directory gets its own
 # AsyncCheckpointer + one-pending-save slot, guarded by its own lock.
-import threading as _threading
 
 
 class _AsyncSlot:
     def __init__(self):
-        self.lock = _threading.Lock()
+        self.lock = make_lock("_AsyncSlot.lock")
         self.ckpt = None
         self.pending = None  # (step, history) awaiting publish
 
 
 _SLOTS: dict[str, _AsyncSlot] = {}
-_SLOTS_LOCK = _threading.Lock()
+_SLOTS_LOCK = make_lock("checkpoint._SLOTS_LOCK")
 _ATEXIT = {"registered": False}
 
 

@@ -54,6 +54,8 @@ import time
 from collections import OrderedDict
 from typing import Any, Callable
 
+from learningorchestra_tpu.concurrency_rt import make_lock
+
 __all__ = [
     "CompiledProgramCache",
     "apply_program_key",
@@ -323,7 +325,7 @@ class CompiledProgramCache:
         self.entry_bytes = int(entry_bytes)
         self._entries: OrderedDict[str, _Entry] = OrderedDict()
         self._building: dict[str, threading.Event] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("CompiledProgramCache._lock")
         self._devices: tuple | None = None
         # Bumped on every device-set clear: a build that STARTED
         # before an invalidation must not be inserted after it (its
@@ -548,7 +550,7 @@ class CompiledProgramCache:
 # -- process-wide singleton ---------------------------------------------------
 
 _cache: CompiledProgramCache | None = None
-_cache_lock = threading.Lock()
+_cache_lock = make_lock("compile_cache._cache_lock")
 
 
 def get_cache() -> CompiledProgramCache:

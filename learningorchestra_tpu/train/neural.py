@@ -31,6 +31,7 @@ import numpy as np
 import optax
 from flax import linen as nn
 
+from learningorchestra_tpu.jobs.cancel import cancel_requested
 from learningorchestra_tpu.obs import tracing as obs_tracing
 from learningorchestra_tpu.toolkit.base import Estimator, as_array
 
@@ -1149,6 +1150,13 @@ class NeuralEstimator(Estimator):
         last_save = time.monotonic()
         try:
             for epoch_i in range(start_epoch, epochs):
+                if cancel_requested():
+                    # Engine-side cancellation (deadline watchdog or
+                    # bounded shutdown drain): wind down exactly like
+                    # an early stop — params/history stay consistent
+                    # at the last completed epoch.
+                    self.stop_training = True
+                    break
                 t0 = time.perf_counter()
                 # Chaos probe per epoch: an armed ``preempt`` schedule
                 # models the real TPU event — mid-fit, after some
@@ -1374,6 +1382,10 @@ class NeuralEstimator(Estimator):
                 max_workers=1, thread_name_prefix="shard-io"
             ) as io:
                 for epoch_i in range(start_epoch, epochs):
+                    if cancel_requested():
+                        # Same contract as the in-memory loop.
+                        self.stop_training = True
+                        break
                     t0 = time.perf_counter()
                     _faults().hit("train.epoch")  # see in-memory loop
                     # Seeded per (seed, epoch), NOT once per fit: a

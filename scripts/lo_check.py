@@ -45,6 +45,18 @@ def main(argv=None) -> int:
         help="skip the cross-artifact drift gates",
     )
     parser.add_argument(
+        "--whole-program", action="store_true",
+        help="also compose the per-module lock models into the "
+        "global graph (cross-module inversions, blocking-call-"
+        "under-lock, make_lock name congruence)",
+    )
+    parser.add_argument(
+        "--witness", default=None, metavar="DUMP_JSON",
+        help="cross-check a runtime witness snapshot "
+        "(LO_TPU_WITNESS_DUMP output) against the static whole-"
+        "program graph (implies --whole-program)",
+    )
+    parser.add_argument(
         "--rules", action="store_true", help="print the rule catalog",
     )
     parser.add_argument(
@@ -63,6 +75,8 @@ def main(argv=None) -> int:
         args.package,
         repo_root=args.repo_root,
         drift=not args.no_drift,
+        whole_program=args.whole_program or args.witness is not None,
+        witness_dump=args.witness,
     )
     for path, message in report.parse_errors:
         print(f"{path}: PARSE ERROR: {message}")

@@ -19,10 +19,13 @@ export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=3
 LOGDIR="$(pwd)/tpu_chain_logs"
 mkdir -p "$LOGDIR"
 
-# Static-analysis gate FIRST: it needs no tunnel, costs ~2 s, and a
+# Static-analysis gate FIRST: it needs no tunnel, costs ~4 s, and a
 # tree failing its own lock/JAX/drift contracts should not spend
 # tunnel windows banking evidence for code that can't merge.
+# --whole-program adds the cross-module lock-order graph +
+# blocking-call-under-lock + witness-name congruence checks.
 if ! timeout 120 python -u scripts/lo_check.py learningorchestra_tpu/ \
+        --whole-program \
         > "$LOGDIR/lo_check.log" 2>&1; then
     echo "$(date -u +%H:%M:%S) lo_check FAILED — fix findings before \
 watching (see $LOGDIR/lo_check.log)" | tee -a "$LOGDIR/watch.log"

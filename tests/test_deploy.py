@@ -159,14 +159,18 @@ class TestLocalClusterBringup:
         page = _wait_for(status_shows_agents, what="status agents table")
         assert "Device leases" in page and "Recent events" in page
 
-    def test_failed_role_is_restarted(self, cluster):
-        """Kill an agent process; the supervisor must restart it (the
-        reference's restart_policy: on-failure)."""
-        proc, api_port, coord_port = cluster
+    @staticmethod
+    def _restart_drill(coord_port):
+        """Kill agent1, wait for the supervisor restart and the
+        coordinator re-registration.  pgrep is scoped to THIS
+        cluster's coordinator port so a retry's fresh cluster never
+        matches a half-torn-down predecessor's agents."""
 
         def agent1_pid():
             out = subprocess.run(
-                ["pgrep", "-f", "agent --coordinator .* --id agent1"],
+                ["pgrep", "-f",
+                 f"agent --coordinator 127.0.0.1:{coord_port} "
+                 "--id agent1"],
                 capture_output=True, text=True,
             )
             pids = [int(p) for p in out.stdout.split()]
@@ -191,6 +195,28 @@ class TestLocalClusterBringup:
             return rec if rec and rec.get("alive") else None
 
         _wait_for(agent1_alive, what="agent1 alive again")
+
+    def test_failed_role_is_restarted(self, launch_cluster):
+        """Kill an agent process; the supervisor must restart it (the
+        reference's restart_policy: on-failure).
+
+        Known load-flake (BASELINE notes, PR-13 git-stash A/B): under
+        heavy machine load the 90 s restart/re-register waits can
+        lapse on an UNCHANGED tree.  The drill retries once on a
+        FRESH cluster so tier-1 (now also witness-enabled) doesn't
+        inherit the noise — a genuine supervisor regression fails
+        both attempts."""
+        last = None
+        for _attempt in range(2):
+            _proc, _api_port, coord_port = launch_cluster()
+            try:
+                self._restart_drill(coord_port)
+                return
+            except AssertionError as exc:
+                last = exc
+        raise AssertionError(
+            f"agent restart drill failed on two fresh clusters: {last}"
+        )
 
 
 def test_compose_manifest_roles_and_flags():

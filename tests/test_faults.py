@@ -676,13 +676,33 @@ class TestTrainChaos:
         epoch 3; the ENGINE's automatic retry (no manual PATCH)
         resumes from the managed checkpoint — attempt 2 trains epochs
         3..5, never epoch 0 — with backoff applied and one span per
-        attempt in the persisted trace."""
+        attempt in the persisted trace.
+
+        Runs under the RUNTIME LOCK WITNESS (LO_TPU_WITNESS
+        semantics via set_witness): the preemption/retry error path
+        exercises lock nestings the happy path never touches, and
+        every witnessed acquisition-order edge must exist in the
+        static whole-program graph (the losan cross-check gate on an
+        ERROR path, not just a clean run)."""
+        from learningorchestra_tpu import concurrency_rt as rt
         from learningorchestra_tpu.config import Config
+        from learningorchestra_tpu.obs import metrics as obs_metrics
         from learningorchestra_tpu.services.context import ServiceContext
         from learningorchestra_tpu.services.executor import ExecutorService
         from learningorchestra_tpu.services.model import ModelService
 
+        rt.set_witness(True)
+        rt.reset()
+        # Rebuilt under the witness (enablement is construction-time):
+        # an earlier test's registry would carry a plain, invisible
+        # lock into the drill's WAL-append → trigger-counter chain.
+        obs_metrics.reset_registry()
         cfg = Config()
+        # Python store backend: the witness instruments Python-level
+        # locks, and the WAL-append-under-collection-lock nesting is
+        # the cross-module chain this drill is meant to capture (the
+        # native C++ store synchronizes internally, invisibly).
+        cfg.store.backend = "python"
         cfg.store.root = str(tmp_path / "store")
         cfg.store.volume_root = str(tmp_path / "volumes")
         cfg.jobs.retry_backoff_s = 0.01
@@ -709,6 +729,16 @@ class TestTrainChaos:
             # runs epochs 0-2 (each checkpointed), dies entering 3.
             faults.arm(
                 "train.epoch", "preempt", after=3, max_triggers=1
+            )
+            # Zero-cost schedule on the WAL boundary so the drill's
+            # store writes traverse the trigger-counter path UNDER the
+            # collection lock — the witnessed cross-module chain the
+            # losan gate below cross-checks on this error path.
+            # Bounded triggers: one is enough for the edge; unbounded
+            # would log a warning per WAL append.
+            faults.arm(
+                "store.wal_write", "delay", delay_ms=0.0,
+                max_triggers=5,
             )
             executor.create(
                 "chaos_fit",
@@ -767,7 +797,29 @@ class TestTrainChaos:
             # restart-from-scratch would re-log epoch 0 here.
             assert sorted(epochs[1]) == [0, 1, 2]
             assert sorted(epochs[2]) == [3, 4, 5]
+
+            # losan gate on the ERROR path: the drill's witnessed
+            # lock orders (store WAL under collection locks, compile
+            # cache, leases, retry bookkeeping) must all exist in the
+            # static whole-program graph.
+            from test_witness_cancel import _static_graph
+
+            from learningorchestra_tpu.analysis.witness import (
+                cross_check,
+            )
+
+            snap = rt.snapshot()
+            assert snap["edges"], (
+                "a preempted fit should witness ordering edges"
+            )
+            unmatched = cross_check(snap, _static_graph())
+            assert unmatched == [], "\n".join(
+                f.render() for f in unmatched
+            )
         finally:
+            rt.set_witness(False)
+            rt.reset()
+            obs_metrics.reset_registry()
             ctx.close()
 
 

@@ -320,7 +320,9 @@ def test_host_sync_outside_jit_is_fine(tmp_path):
 # -- cancellation worklist rule ----------------------------------------------
 
 
-def test_loop_without_cancel_check_is_warned(tmp_path):
+def test_loop_without_cancel_check_is_error(tmp_path):
+    """Promoted from warn with the cancellation PR: consulting the
+    cancel token is the contract now, not a worklist."""
     root = _write_pkg(tmp_path, {"jobs/body.py": """
         def run():
             n = 0
@@ -329,7 +331,8 @@ def test_loop_without_cancel_check_is_warned(tmp_path):
     """})
     report = run_checks(root, drift=False)
     assert _rules(report) == ["loop-no-cancel-check"]
-    assert report.errors == []
+    assert len(report.errors) == 1
+    assert report.exit_code() == 1
 
 
 def test_loop_consulting_token_is_clean(tmp_path):
@@ -340,6 +343,209 @@ def test_loop_consulting_token_is_clean(tmp_path):
                     break
     """})
     assert run_checks(root, drift=False).findings == []
+
+
+# -- whole-program golden fixtures -------------------------------------------
+
+
+def _wp_rules(root):
+    report = run_checks(root, drift=False, whole_program=True)
+    return sorted({f.rule for f in report.findings}), report
+
+
+def test_cross_module_inversion_detected(tmp_path):
+    """Each module's lock discipline is individually consistent; their
+    COMPOSITION inverts: A.one holds A._lock into B.poke (takes
+    B._lock), B.two holds B._lock into A.ping (takes A._lock)."""
+    root = _write_pkg(tmp_path, {
+        "a.py": """
+            import threading
+            from pkg.b import B
+
+            class A:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.other = B()
+
+                def one(self):
+                    with self._lock:
+                        self.other.poke()
+
+                def ping(self):
+                    with self._lock:
+                        pass
+        """,
+        "b.py": """
+            import threading
+
+            class B:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.friend = None
+
+                def wire(self):
+                    from pkg.a import A
+                    self.friend = A()
+
+                def poke(self):
+                    with self._lock:
+                        pass
+
+                def two(self):
+                    with self._lock:
+                        self.friend.ping()
+        """,
+    })
+    rules, report = _wp_rules(root)
+    assert rules == ["lock-order-global"]
+    assert report.exit_code() == 1
+    assert "A._lock" in report.findings[0].message
+    assert "B._lock" in report.findings[0].message
+
+
+def test_per_module_clean_but_globally_cyclic(tmp_path):
+    """Three modules, one module-level lock each, a call ring through
+    imported functions: every module is trivially clean alone, the
+    composed graph is a 3-cycle."""
+    root = _write_pkg(tmp_path, {
+        "a.py": """
+            import threading
+            from pkg.b import bfn
+            _LOCK = threading.Lock()
+
+            def afn():
+                with _LOCK:
+                    bfn()
+        """,
+        "b.py": """
+            import threading
+            from pkg.c import cfn
+            _LOCK = threading.Lock()
+
+            def bfn():
+                with _LOCK:
+                    cfn()
+        """,
+        "c.py": """
+            import threading
+            from pkg.a import afn
+            _LOCK = threading.Lock()
+
+            def cfn():
+                with _LOCK:
+                    afn()
+        """,
+    })
+    rules, report = _wp_rules(root)
+    assert rules == ["lock-order-global"]
+    # Per-module analyzers see nothing: the cycle only exists composed.
+    assert not [f for f in report.findings if f.rule == "lock-order"]
+
+
+def test_consistent_cross_module_order_is_clean(tmp_path):
+    root = _write_pkg(tmp_path, {
+        "a.py": """
+            import threading
+            from pkg.b import B
+
+            class A:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.other = B()
+
+                def one(self):
+                    with self._lock:
+                        self.other.poke()
+        """,
+        "b.py": """
+            import threading
+
+            class B:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def poke(self):
+                    with self._lock:
+                        pass
+        """,
+    })
+    rules, _report = _wp_rules(root)
+    assert rules == []
+
+
+def test_blocking_call_under_lock_goldens(tmp_path):
+    """join()/time.sleep without a timeout under a held lock are
+    errors; the timeout-arg forms are not."""
+    root = _write_pkg(tmp_path, {"mod.py": """
+        import threading
+        import time
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad_join(self, worker):
+                with self._lock:
+                    worker.join()
+
+            def good_join(self, worker):
+                with self._lock:
+                    worker.join(timeout=2.0)
+
+            def bad_sleep(self):
+                with self._lock:
+                    time.sleep(1.0)
+
+            def good_outside(self, worker):
+                worker.join()
+    """})
+    rules, report = _wp_rules(root)
+    assert rules == ["blocking-call-under-lock"]
+    lines = sorted(f.line for f in report.findings)
+    assert len(lines) == 2  # bad_join + bad_sleep only
+
+
+def test_blocking_call_in_locked_helper_detected(tmp_path):
+    """The ``*_locked`` convention carries the caller's lock into the
+    helper — a no-timeout wait inside one is still under lock."""
+    root = _write_pkg(tmp_path, {"mod.py": """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._done = threading.Event()
+
+            def run(self):
+                with self._lock:
+                    self._drain_locked()
+
+            def _drain_locked(self):
+                self._done.wait()
+    """})
+    rules, _report = _wp_rules(root)
+    assert "blocking-call-under-lock" in rules
+
+
+def test_lock_name_mismatch_golden(tmp_path):
+    src = """
+        from learningorchestra_tpu.concurrency_rt import make_lock
+
+        class C:
+            def __init__(self):
+                self._lock = make_lock("{name}")
+    """
+    bad = _write_pkg(
+        tmp_path, {"mod.py": src.format(name="Wrong._lock")}
+    )
+    rules, report = _wp_rules(bad)
+    assert rules == ["lock-name-mismatch"]
+    assert "C._lock" in report.findings[0].message
+
+    good = _write_pkg(
+        tmp_path / "good", {"mod.py": src.format(name="C._lock")}
+    )
+    assert _wp_rules(good)[0] == []
 
 
 # -- drift golden fixtures ---------------------------------------------------
@@ -493,8 +699,10 @@ def test_deleting_real_k8s_knob_line_trips_gate(tmp_path):
 def test_package_is_clean():
     """Zero unsuppressed error findings over the shipped tree — every
     real finding the suite surfaced was fixed (or deliberately,
-    visibly suppressed) in the PR that landed it."""
-    report = run_checks(PKG, repo_root=ROOT)
+    visibly suppressed) in the PR that landed it.  Includes the
+    whole-program pass: cross-module lock-order composition,
+    blocking-call-under-lock, and make_lock name congruence."""
+    report = run_checks(PKG, repo_root=ROOT, whole_program=True)
     assert report.parse_errors == []
     assert report.errors == [], "\n".join(
         f.render() for f in report.errors
@@ -504,7 +712,7 @@ def test_package_is_clean():
 def test_cli_end_to_end():
     proc = subprocess.run(
         [sys.executable, str(ROOT / "scripts" / "lo_check.py"),
-         str(PKG), "--repo-root", str(ROOT)],
+         str(PKG), "--repo-root", str(ROOT), "--whole-program"],
         capture_output=True, text=True, timeout=120,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
