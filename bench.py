@@ -801,6 +801,83 @@ def _faults_probe() -> dict:
     }
 
 
+def _journal_probe() -> dict:
+    """Job-journal overhead on the submit/dispatch path, pinned as a
+    SUBSYSTEM number (the acceptance bar: journal appends < 2% of a
+    minimal job dispatch).
+
+    The journal group-commits: the submit/dispatch hot path only
+    ENQUEUES slim records (the flusher thread writes FIFO batches
+    through the store WAL off-path), so the on-path overhead is the
+    enqueue cost, not the WAL write.
+
+    - ``append_us`` — one lifecycle-record enqueue (what the
+      dispatch path pays journaling ``running``);
+    - ``submit_pair_us`` — the ``submitted``+``queued`` pair enqueue
+      (what ``submit()`` pays);
+    - ``dispatch_us`` — a minimal no-op job end to end (submit →
+      result) on a journal-less engine, the denominator;
+    - ``appends_share_of_dispatch_pct`` — the submit/dispatch-path
+      share: (submit pair + running append) / dispatch — the
+      acceptance number;
+    - ``job_life_share_pct`` — all four events (submit pair,
+      running, terminal) over dispatch, for context.
+    """
+    import tempfile
+    from pathlib import Path
+
+    from learningorchestra_tpu.jobs import JobEngine, JobJournal
+    from learningorchestra_tpu.store import ArtifactStore, DocumentStore
+
+    tight = _tight_best_of
+    with tempfile.TemporaryDirectory() as td:
+        store = DocumentStore(Path(td) / "store")
+        journal = None
+        try:
+            journal = JobJournal(store, Path(td) / "store")
+            append_us = tight(
+                lambda: journal.append("running", "probe", attempt=1),
+                m=2000,
+            ) * 1e6
+            submit_pair_us = tight(
+                lambda: journal.record_submit(
+                    "probe", job_class="bench", method="run",
+                ),
+                m=2000,
+            ) * 1e6
+
+            arts = ArtifactStore(store)
+            eng = JobEngine(arts, max_workers=1)
+
+            def one_dispatch():
+                eng.submit(
+                    "bench_job2", lambda: 1, job_class="bench"
+                ).result(timeout=30)
+                eng._futures.pop("bench_job2", None)
+
+            arts.metadata.create("bench_job2", "function/python")
+            dispatch_us = tight(one_dispatch, m=50, reps=5) * 1e6
+            eng.shutdown(wait=True)
+        finally:
+            # Journal first: its flusher must finish draining into
+            # the store's WAL handles before they close.
+            if journal is not None:
+                journal.close()
+            store.close()
+    return {
+        "append_us": round(append_us, 2),
+        "submit_pair_us": round(submit_pair_us, 2),
+        "dispatch_us": round(dispatch_us, 1),
+        "appends_share_of_dispatch_pct": round(
+            (submit_pair_us + append_us) / dispatch_us * 100.0, 3
+        ),
+        "job_life_share_pct": round(
+            (submit_pair_us + 2 * append_us) / dispatch_us * 100.0,
+            3,
+        ),
+    }
+
+
 def _costs_probe() -> dict:
     """Per-dispatch cost-accounting hook cost, pinned as a SUBSYSTEM
     number (the ROADMAP bench caveat: headline A/B windows on this box
@@ -1277,6 +1354,10 @@ def _tpu_suite_child_main() -> None:
     except Exception as exc:  # noqa: BLE001 — record, don't hide
         suite["_faults"] = f"FAILED: {exc!r}"
     try:
+        suite["_journal"] = _journal_probe()
+    except Exception as exc:  # noqa: BLE001 — record, don't hide
+        suite["_journal"] = f"FAILED: {exc!r}"
+    try:
         suite["_fleet"] = _fleet_probe()
     except Exception as exc:  # noqa: BLE001 — record, don't hide
         suite["_fleet"] = f"FAILED: {exc!r}"
@@ -1303,6 +1384,7 @@ def main() -> None:
         serving_probe = suite.pop("_serving", None)
         obs_probe = suite.pop("_obs", None)
         faults_probe = suite.pop("_faults", None)
+        journal_probe = suite.pop("_journal", None)
         fleet_probe = suite.pop("_fleet", None)
         costs_probe = suite.pop("_costs", None)
         slo_probe = suite.pop("_slo", None)
@@ -1316,6 +1398,8 @@ def main() -> None:
             extra["obs"] = obs_probe
         if faults_probe is not None:
             extra["faults"] = faults_probe
+        if journal_probe is not None:
+            extra["journal"] = journal_probe
         if fleet_probe is not None:
             extra["fleet"] = fleet_probe
         if costs_probe is not None:

@@ -1486,6 +1486,27 @@ class APIServer:
         add("DELETE", rf"/observe/{NAME}/webhook/(?P<hook>[0-9]+)",
             webhook_delete)
 
+        # ---- Job control plane (jobs/engine.py + jobs/journal.py) ----
+        # DELETE cancels a queued job outright, or flips a RUNNING
+        # job's CancelToken — the body observes it at its next
+        # epoch/batch boundary, winds down like an early stop and the
+        # engine records a journaled `cancelled` terminal state
+        # (202: accepted, cooperative — poll the artifact).
+        def job_cancel(m, body, query):
+            name = m.group("name")
+            self.ctx.require_existing(name)
+            result = self.ctx.engine.cancel(name)
+            if result is True:
+                return 200, {"job": name, "result": "cancelled"}
+            if result:
+                return 202, {"job": name, "result": "cancelling"}
+            return 409, {
+                "error": f"job {name!r} is not queued or running "
+                "(already terminal)"
+            }
+
+        add("DELETE", rf"/jobs/{NAME}", job_cancel)
+
         # ---- Introspection ----
         add(
             "GET", r"/registry",

@@ -106,6 +106,7 @@ class Context:
         self.serve = _Serve(self)
         self.observability = _Observability(self)
         self.faults = _Faults(self)
+        self.jobs = _Jobs(self)
 
     # -- transport ----------------------------------------------------------
 
@@ -992,6 +993,24 @@ class _Faults:
 
     def disarm_all(self) -> dict:
         return self.ctx.request("DELETE", "/faults")
+
+
+class _Jobs:
+    """Job control plane: cooperative cancellation over the journaled
+    engine (server jobs/engine.py + jobs/journal.py)."""
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+
+    def cancel(self, name: str) -> dict:
+        """DELETE /jobs/<name> — cancel a queued job outright
+        (``result: cancelled``) or flip a RUNNING job's CancelToken
+        (``result: cancelling``, HTTP 202): the body observes it at
+        its next epoch/batch boundary, winds down like an early stop,
+        and the artifact lands in jobState ``cancelled`` with a
+        journaled terminal transition.  409 when the job is already
+        terminal."""
+        return self.ctx.request("DELETE", f"/jobs/{name}")
 
 
 class _Observe:

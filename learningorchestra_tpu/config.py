@@ -108,6 +108,27 @@ class JobConfig:
     # grace — a deadline-failed zombie can no longer hang shutdown.
     # <= 0 keeps the legacy unbounded drain.  Env: LO_TPU_JOB_DRAIN_S.
     shutdown_drain_s: float = 0.0
+    # Crash-durable job journal (jobs/journal.py): every job state
+    # transition is group-committed to the _job_journal collection's
+    # WAL (enqueued on the hot path, drained in FIFO batches by the
+    # journal flusher within ~one batch-write time), each boot mints
+    # an engine epoch (.engine_epoch) and stale-epoch stragglers are
+    # refused at commit time.  Off: legacy in-memory-only engine
+    # (interrupted jobs are re-flagged failed at boot, nothing is
+    # re-dispatched).  Env: LO_TPU_JOB_JOURNAL.
+    journal: bool = True
+    # Boot-time recovery: replay the journal and RE-DISPATCH
+    # recoverable jobs (train fits resume from their newest managed
+    # checkpoint via the PATCH path; queued jobs re-enqueue in order).
+    # Off: recovered jobs are terminally failed `orphaned-by-restart`
+    # instead (operator re-runs with a bare PATCH).
+    # Env: LO_TPU_JOB_JOURNAL_RECOVER.
+    journal_recover: bool = True
+    # Journal compaction threshold: past this many records, boot-time
+    # pruning keeps only the last record of each terminal job (full
+    # history is kept for live jobs).  <= 0 disables pruning.
+    # Env: LO_TPU_JOB_JOURNAL_MAX.
+    journal_max_records: int = 4096
 
 
 @dataclasses.dataclass
@@ -594,6 +615,16 @@ class Config:
                 "(use 1/0, true/false, yes/no, on/off)"
             )
 
+        if "LO_TPU_JOB_JOURNAL" in env:
+            cfg.jobs.journal = _bool_env("LO_TPU_JOB_JOURNAL")
+        if "LO_TPU_JOB_JOURNAL_RECOVER" in env:
+            cfg.jobs.journal_recover = _bool_env(
+                "LO_TPU_JOB_JOURNAL_RECOVER"
+            )
+        if "LO_TPU_JOB_JOURNAL_MAX" in env:
+            cfg.jobs.journal_max_records = int(
+                env["LO_TPU_JOB_JOURNAL_MAX"]
+            )
         if "LO_TPU_FLEET_ENABLED" in env:
             cfg.fleet.enabled = _bool_env("LO_TPU_FLEET_ENABLED")
         if "LO_TPU_FLEET_MIN" in env:

@@ -24,6 +24,8 @@ point                 call site
 ``serve.route``       serve/fleet/router.py — every fleet routing decision
 ``http.handler``      api/server.py — before every admitted route handler
 ``train.epoch``       train/neural.py — top of every fit epoch
+``replica.wal_ship``  store/replica.py — entry of every WAL-shipping sync
+``store.ha.failover`` store/ha.py — entry of a standby's promotion
 ====================  =======================================================
 
 A **schedule** arms a point with one of three behaviors:
@@ -93,6 +95,8 @@ POINTS = (
     "serve.route",
     "http.handler",
     "train.epoch",
+    "replica.wal_ship",
+    "store.ha.failover",
 )
 
 

@@ -14,13 +14,19 @@ from learningorchestra_tpu.jobs.engine import (
     Preempted,
     current_attempt,
 )
+from learningorchestra_tpu.jobs.journal import (
+    JobJournal,
+    StaleEpochError,
+)
 
 __all__ = [
     "CancelToken",
     "JobDeadlineExceeded",
     "JobEngine",
+    "JobJournal",
     "JobState",
     "Preempted",
+    "StaleEpochError",
     "cancel_requested",
     "current_attempt",
     "current_cancel_token",

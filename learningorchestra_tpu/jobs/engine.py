@@ -39,6 +39,7 @@ from typing import Any, Callable
 from learningorchestra_tpu import faults
 from learningorchestra_tpu.concurrency_rt import make_lock
 from learningorchestra_tpu.jobs import cancel as jobs_cancel
+from learningorchestra_tpu.jobs import journal as jobs_journal
 from learningorchestra_tpu.jobs.cancel import CancelToken
 from learningorchestra_tpu.log import capture_thread_stdout, get_logger, kv
 from learningorchestra_tpu.obs import tracing
@@ -192,6 +193,35 @@ class JobEngine:
         # Optional push-notification sink (services/webhooks.py): set
         # by the service context; completion paths call _notify.
         self.notifier = None
+        # Crash-durable job journal (jobs/journal.py): set by the
+        # service context.  Every state transition is recorded ahead
+        # of its in-memory commit (group-committed through the
+        # store's WAL by the journal flusher), and terminal commits
+        # are fenced against the store's current engine epoch.  None
+        # (raw engines, tests) disables both.
+        self.journal = None
+
+    def _journal(self, name: str, event: str, **fields) -> None:
+        """Append one transition record; never raises (a journaling
+        failure is counted and logged inside the journal — it must
+        not take down the engine)."""
+        if self.journal is not None:
+            self.journal.append(event, name, **fields)
+
+    def _fence_refused(self, name: str, req: dict) -> bool:
+        """True when the calling body's engine epoch is stale: a newer
+        recovery owns the store's metadata now — every terminal write
+        below the check must be skipped (no lost-updates, no
+        double-published state)."""
+        if self.journal is None:
+            return False
+        try:
+            self.journal.fence_check()
+        except jobs_journal.StaleEpochError as exc:
+            logger.error(kv(job=name, state="fenced",
+                            error=str(exc), **req))
+            return True
+        return False
 
     def _notify(self, name: str, event: str) -> None:
         """Fire artifact state-change webhooks; never raises, never
@@ -284,7 +314,14 @@ class JobEngine:
         token = CancelToken()
 
         def run() -> Any:
-            with jobs_cancel.bind(token):
+            # Epoch stamp: the body carries the engine epoch of ITS
+            # dispatch; terminal commits and artifact publications
+            # compare it against the store's durable epoch (fencing).
+            epoch = (
+                self.journal.epoch if self.journal is not None
+                else None
+            )
+            with jobs_cancel.bind(token), jobs_journal.stamp(epoch):
                 return _run_attempts()
 
         def _run_attempts() -> Any:
@@ -310,12 +347,49 @@ class JobEngine:
                 what ran."""
                 if trace is None:
                     return None
-                trace.end(job_sid)
+                if job_sid is not None:
+                    # None before the first attempt span begins (a
+                    # cancel landing at the loop top).
+                    trace.end(job_sid)
                 return trace.to_doc()
 
             # req=<id> on every engine log line for this job: the one
             # grep key tying logs, metadata and the span tree together.
             req = {"req": request_id} if request_id else {}
+
+            def _commit_cancelled(detail: str | None = None):
+                """Terminal bookkeeping for a RUNNING job cancelled
+                via the REST surface: the body wound down
+                cooperatively (or died doing so) — record CANCELLED,
+                not finished/failed.  Fenced like every terminal
+                commit: a stale-epoch straggler's cancel must not
+                lost-update metadata a newer recovery owns."""
+                if self._fence_refused(name, req):
+                    return None
+                reason = token.reason or "cancel requested"
+                logger.warning(kv(job=name, state="cancelled",
+                                  reason=reason, **req))
+                self._journal(name, "cancelled", reason=reason)
+                meta.update(name, {
+                    "jobState": JobState.CANCELLED,
+                    "finished": False,
+                    "exception": f"cancelled: {reason}"
+                    + (f" ({detail})" if detail else ""),
+                })
+                jobs_total.inc(
+                    job_class=job_class, state="cancelled"
+                )
+                ledger.record(
+                    name,
+                    description=description,
+                    method=method,
+                    parameters=parameters,
+                    state=JobState.CANCELLED,
+                    exception=detail,
+                    trace=trace_doc(),
+                )
+                self._notify(name, "cancelled")
+                return None
             while True:
                 if ctl["expired"]:
                     # The watchdog expired this job while it slept in
@@ -328,6 +402,11 @@ class JobEngine:
                                       **req))
                     return None
                 if token.cancelled():
+                    if ctl.get("cancelled"):
+                        # REST-cancelled while between attempts
+                        # (retry backoff): same terminal contract as
+                        # a mid-run cancel — CANCELLED, not failed.
+                        return _commit_cancelled()
                     # Cancelled between attempts without a deadline
                     # expiry: the bounded shutdown drain.  Record the
                     # terminal state (no watchdog wrote one) and stop
@@ -339,6 +418,8 @@ class JobEngine:
                     )
                     logger.warning(kv(job=name, state="cancelled",
                                       **req))
+                    self._journal(name, "cancelled",
+                                  reason=token.reason or None)
                     try:
                         meta.mark_failed(name, err)
                     except Exception:  # noqa: BLE001
@@ -352,6 +433,8 @@ class JobEngine:
                         "job", attrs={"attempt": attempts + 1}
                     )
                 with tracing.activate(trace, job_sid):
+                    self._journal(name, "running",
+                                  attempt=attempts + 1)
                     meta.mark_running(name)
                     logger.info(kv(job=name, state="running",
                                    method=method, attempt=attempts + 1,
@@ -391,6 +474,8 @@ class JobEngine:
                             kv(job=name, state="preempted",
                                attempt=attempts, **req)
                         )
+                        self._journal(name, "preempted",
+                                      attempt=attempts)
                         jobs_total.inc(
                             job_class=job_class, state="preempted"
                         )
@@ -421,6 +506,12 @@ class JobEngine:
                                 trace.end(job_sid)
                             self._backoff(name, attempts, trace, req)
                             continue
+                        if self._fence_refused(name, req):
+                            return None
+                        self._journal(
+                            name, "failed",
+                            reason="preemption retries exhausted",
+                        )
                         meta.mark_failed(
                             name, "Preempted (retries exhausted)"
                         )
@@ -437,11 +528,22 @@ class JobEngine:
                                    error=err, **req)
                             )
                             return None
+                        if self._fence_refused(name, req):
+                            # Stale-epoch straggler: the newer
+                            # recovery owns this job's metadata — a
+                            # late "failed" would lost-update it.
+                            return None
+                        if ctl.get("cancelled"):
+                            # The body died winding down after a
+                            # cooperative cancel: that is a CANCELLED
+                            # job, not a failure of the work itself.
+                            return _commit_cancelled(err)
                         logger.error(
                             kv(job=name, state="failed", error=err,
                                dt=f"{time.monotonic() - t_start:.2f}s",
                                **req)
                         )
+                        self._journal(name, "failed", reason=err)
                         meta.mark_failed(name, err)
                         jobs_total.inc(
                             job_class=job_class, state="failed"
@@ -477,12 +579,30 @@ class JobEngine:
                                **req)
                         )
                         return None
+                    if ctl.get("cancelled"):
+                        # REST-cancelled mid-run: the body observed
+                        # its token and wound down early — its partial
+                        # result must not publish as "finished".
+                        return _commit_cancelled()
+                    if self._fence_refused(name, req):
+                        # Stale-epoch straggler racing a newer
+                        # recovery: its completion must not publish.
+                        return None
                     extra = on_success(result) if on_success else None
                     logger.info(
                         kv(job=name, state="finished",
                            dt=f"{time.monotonic() - t_start:.2f}s",
                            **req)
                     )
+                    if self.journal is not None:
+                        # Epoch stamp on metadata finalization: which
+                        # engine life committed this artifact —
+                        # readable from the ordinary GET/poll path.
+                        extra = {
+                            **(extra or {}),
+                            "engineEpoch": jobs_journal.current_stamp(),
+                        }
+                    self._journal(name, "finished")
                     meta.mark_finished(name, extra or None)
                     jobs_total.inc(
                         job_class=job_class, state="finished"
@@ -512,22 +632,44 @@ class JobEngine:
             "ctl": ctl,
             "token": token,
         }
+        # Journal ahead of the in-memory enqueue (and outside the
+        # engine lock — a late-shutdown append drains inline through
+        # the store's collection lock, and nesting that under _lock
+        # would add a cross-module edge the dispatcher's hot path
+        # doesn't need).
+        if self.journal is not None:
+            self.journal.record_submit(
+                name, job_class=job_class, method=method,
+                description=description, parameters=parameters,
+                deadline_s=deadline if deadline else None,
+                request_id=request_id,
+            )
         with self._lock:
-            if self._shutdown:
-                # Same contract as handing the job to a shut-down
-                # executor (the pre-fairness behavior).
-                raise RuntimeError(
-                    "cannot submit jobs after engine shutdown"
-                )
-            queue = self._queues.get(job_class)
-            if queue is None:
-                queue = self._queues[job_class] = deque()
-                self._rr_order.append(job_class)
-                self._credits[job_class] = self._weight(job_class)
-            queue.append((run, future, warm_key, info))
-            self._futures[name] = future
-            self._prune_locked()
-            self._dispatch_locked()
+            refused = self._shutdown
+            if not refused:
+                queue = self._queues.get(job_class)
+                if queue is None:
+                    queue = self._queues[job_class] = deque()
+                    self._rr_order.append(job_class)
+                    self._credits[job_class] = self._weight(job_class)
+                queue.append((run, future, warm_key, info))
+                self._futures[name] = future
+                self._prune_locked()
+                self._dispatch_locked()
+        if refused:
+            # Same contract as handing the job to a shut-down
+            # executor (the pre-fairness behavior) — but the journal
+            # already holds this job's submitted/queued pair, so
+            # append the terminal (outside the lock: store writes)
+            # or recovery would resurrect a submission the caller
+            # was told failed.
+            self._journal(
+                name, "cancelled",
+                reason="engine shut down before enqueue",
+            )
+            raise RuntimeError(
+                "cannot submit jobs after engine shutdown"
+            )
         return future
 
     def _backoff(self, name: str, attempt: int, trace, req: dict) -> None:
@@ -783,6 +925,7 @@ class JobEngine:
         )
         logger.error(kv(job=name, state="deadline",
                         deadlineS=deadline))
+        self._journal(name, "deadline", reason=err)
         _, jobs_total = _job_metrics()
         jobs_total.inc(job_class=rec["job_class"], state="deadline")
         try:
@@ -843,10 +986,18 @@ class JobEngine:
             return None
         return future.result(timeout=timeout)
 
-    def cancel(self, name: str) -> bool:
-        """Cancel if not yet started (running jobs are not interruptible —
-        same as the reference, where a running job dies only with its
-        container; SURVEY §5.3)."""
+    def cancel(self, name: str):
+        """Cancel a queued or RUNNING job.
+
+        Queued: the future is cancelled before dispatch → ``True``
+        (the job never runs).  Running: the body's CancelToken is
+        flipped → ``"running"`` — the fit surfaces poll it per
+        epoch/batch and wind down like an early stop, after which the
+        engine records a journaled ``cancelled`` terminal state
+        instead of ``finished``.  ``False`` when the job is neither
+        (already terminal, or unknown).
+        """
+        running_rec = None
         with self._lock:
             # future.cancel() under the engine lock: the dispatcher's
             # cancelled() checks in _pick_locked run under the same
@@ -855,11 +1006,54 @@ class JobEngine:
             # depends on this.
             future = self._futures.get(name)
             cancelled = future is not None and future.cancel()
+            if cancelled:
+                cancelled_class = next(
+                    (
+                        cls
+                        for cls, queue in self._queues.items()
+                        for _r, f, _wk, _i in queue
+                        if f is future
+                    ),
+                    "unknown",
+                )
+            if not cancelled:
+                rec = self._running_recs.get(name)
+                if rec is not None and not rec["released"]:
+                    # Cooperative cancel of the RUNNING body: flag the
+                    # control block so the terminal commit records
+                    # CANCELLED, then flip the token (the order means
+                    # a body that observes the token always finds the
+                    # flag set).
+                    rec["ctl"]["cancelled"] = True
+                    rec["token"].cancel("cancel requested")
+                    running_rec = rec
+        # Store writes outside the engine lock.
         if cancelled:
+            self._journal(name, "cancelled",
+                          reason="cancelled while queued")
             self.artifacts.metadata.update(
                 name, {"jobState": JobState.CANCELLED, "finished": False}
             )
+            # Same observability as the running-cancel commit: ledger
+            # row, cancelled counter, webhook/event-feed notify — a
+            # watcher of the queued job must see the terminal
+            # transition, not wait forever.
+            _, jobs_total = _job_metrics()
+            jobs_total.inc(
+                job_class=cancelled_class, state="cancelled"
+            )
+            try:
+                self.artifacts.ledger.record(
+                    name, state=JobState.CANCELLED,
+                    exception="cancelled while queued",
+                )
+            except Exception:  # noqa: BLE001 — cancel must succeed
+                pass
+            self._notify(name, "cancelled")
             return True
+        if running_rec is not None:
+            self._journal(name, "cancel_requested")
+            return "running"
         return False
 
     def running_jobs(self) -> list[str]:
@@ -966,6 +1160,8 @@ class JobEngine:
         # forever (phantom jobs after restart).  Outside the lock:
         # store writes.
         for name in dropped:
+            self._journal(name, "cancelled",
+                          reason="shutdown drain deadline")
             try:
                 self.artifacts.metadata.update(
                     name,

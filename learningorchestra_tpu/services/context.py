@@ -108,8 +108,28 @@ class ServiceContext:
         # binary-overwrite paths notify so stale state is dropped
         # before the next read.
         self._artifact_change_listeners: list = []
-        self._reflag_interrupted_jobs()
+        # Crash-durable job journal + engine-epoch fencing
+        # (jobs/journal.py): construction mints this boot's engine
+        # epoch, so any straggler from a previous life is refused at
+        # its terminal commit.  The engine appends every transition
+        # through it.
+        from learningorchestra_tpu.jobs.journal import JobJournal
+
+        self.journal = JobJournal(
+            self.documents,
+            self.config.store.store_path(),
+            enabled=self.config.jobs.journal,
+            max_records=self.config.jobs.journal_max_records,
+        )
+        self.engine.journal = (
+            self.journal if self.journal.enabled else None
+        )
+        # Backend init FIRST: recovery may re-dispatch train fits,
+        # and job threads racing first-time backend init deadlock
+        # inside xla_bridge (the race _init_backend exists to remove).
         self._init_backend()
+        self.journal.prune()
+        self._recover_jobs()
 
     def add_artifact_change_listener(self, listener) -> None:
         """Register ``listener(name)`` to fire when an artifact's
@@ -125,50 +145,199 @@ class ServiceContext:
             except Exception:  # noqa: BLE001 — never fail the mutation
                 pass
 
-    def _reflag_interrupted_jobs(self) -> None:
-        """Any pending/running jobState at startup belonged to a DEAD
+    def _recover_jobs(self) -> None:
+        """Boot-time restart recovery over the job journal.
+
+        Any pending/running jobState at startup belonged to a DEAD
         process — this process hasn't run a job yet.  Left alone it
         wedges the artifact forever: the job will never finish, and
         ``require_not_running`` would 409 every PATCH re-run.  Matters
         most after store failover, where the promoted standby inherits
-        the killed primary's in-flight states through the shipped WAL.
-        Mark them failed with a re-run hint — the reference's
-        unfinished-work re-flag at service startup
-        (data_type_handler_image/data_type_update.py:47-59), resolved
-        into the PATCH-re-run path instead of auto-resubmission (the
-        request parameters live in the ledger;
-        ``last_recorded_parameters`` feeds a bare PATCH)."""
+        the killed primary's in-flight states (and its journal)
+        through the shipped WAL.
+
+        With the journal enabled and ``jobs.journal_recover`` on,
+        journaled jobs whose bodies are re-dispatchable are RESUBMITTED
+        through the existing PATCH machinery, in their pre-crash queue
+        order: train fits resume from their newest managed checkpoint
+        (services/executor.py's resume path), distributed fits through
+        ``update_train``.  Everything else — and every job when
+        recovery is off — is terminally failed with an explicit
+        ``orphaned-by-restart`` reason instead of leaving phantom
+        "running" metadata; jobs with NO journal record (stores that
+        predate the journal, or a disabled journal) keep the legacy
+        interrupted-re-flag message.  The reference re-flags
+        unfinished work at service startup
+        (data_type_handler_image/data_type_update.py:47-59); this
+        resolves it into automatic resumption.
+        """
+        journaled = (
+            self.journal.replay() if self.journal.enabled else {}
+        )
+        recover = (
+            self.journal.enabled and self.config.jobs.journal_recover
+        )
+        interrupted: list[tuple] = []
         for name in self.documents.list_collections():
             if name.startswith("_"):
-                continue  # internal ledgers (idempotency) have no jobs
+                continue  # internal ledgers/journal have no jobs
             try:
                 meta = self.artifacts.metadata.read(name)
             except Exception:
                 continue
-            if meta and meta.get("jobState") in ("pending", "running"):
-                self.artifacts.metadata.mark_failed(
-                    name,
-                    "job interrupted by a server restart or store "
-                    "failover before completing; re-run it with a "
-                    "PATCH (bare PATCH re-uses the last recorded "
-                    "parameters)",
+            if not meta or meta.get("jobState") not in (
+                "pending", "running"
+            ):
+                continue
+            rec = journaled.get(name)
+            # Re-enqueue order = pre-crash queue admission order (the
+            # journal's latest `queued` sequence number); journal-less
+            # jobs go last, name-ordered for determinism.
+            seq = (
+                rec["seq"] if rec and rec["seq"] >= 0
+                else float("inf")
+            )
+            interrupted.append((seq, name, meta, rec))
+        interrupted.sort(key=lambda t: (t[0], t[1]))
+        log = get_logger("context")
+        for _seq, name, meta, rec in interrupted:
+            kind = (
+                self._recoverable_kind(meta)
+                # A journal-terminal record under non-terminal
+                # metadata means the job's life ENDED (refused
+                # submission, or a crash between the journal append
+                # and the metadata commit) — orphan it, don't
+                # resurrect it.
+                if recover and rec is not None
+                and not rec.get("terminal")
+                else None
+            )
+            if kind is None:
+                self._orphan_job(name, journaled=rec is not None)
+                continue
+            try:
+                self._redispatch(name, kind, rec.get("spec") or {})
+                log.warning(
+                    f"recovered job {name!r} from the journal "
+                    f"(epoch {self.journal.epoch}): re-dispatched "
+                    "through the checkpoint-resume path"
                 )
-                get_logger("context").warning(
-                    f"re-flagged interrupted job {name!r} "
-                    "(was mid-run when the previous process died)"
+            except Exception as exc:  # noqa: BLE001 — recovery must
+                # finish: one unrecoverable job (deleted parent, bad
+                # spec) must not wedge the whole boot.
+                log.error(
+                    f"could not re-dispatch recovered job {name!r}: "
+                    f"{exc!r} — failing it orphaned-by-restart"
                 )
-                # Subscribers must see the terminal transition: the
-                # observe event feed + any registered webhooks fire
-                # exactly as the engine's own failure path would
-                # (jobs/engine.py _notify) — a watcher of the dead
-                # job would otherwise wait forever.
-                try:
-                    self.webhooks.notify(
-                        name, "failed",
-                        self.artifacts.metadata.read(name) or {},
-                    )
-                except Exception:  # noqa: BLE001 — startup must finish
-                    pass
+                self._orphan_job(
+                    name, journaled=True, detail=repr(exc)
+                )
+
+    @staticmethod
+    def _recoverable_kind(meta: dict) -> str | None:
+        """How a journaled job can be re-dispatched, or None.
+
+        Executor-family artifacts re-run through the PATCH path with
+        their last recorded parameters; tune grids are excluded (a
+        grid re-submission is not expressible through the generic
+        PATCH — their trials resume only across in-engine preemption
+        retries) and so is anything without a parent/method spec
+        (functions, models: their bodies are not derivable from
+        metadata alone)."""
+        if meta.get("distributed"):
+            return "distributed"
+        kind = str(meta.get("type", ""))
+        if (
+            kind.startswith(("train/", "evaluate/", "predict/"))
+            and meta.get("parentName")
+            and meta.get("method")
+        ):
+            return "executor"
+        return None
+
+    def _redispatch(self, name: str, kind: str, spec: dict) -> None:
+        """Resubmit a recovered job through the existing PATCH
+        machinery, carrying the journaled submit spec forward (a job
+        submitted with a deadline must resume under it, not under the
+        engine default).  Marking it failed FIRST is what routes a
+        train fit into the checkpoint-resume path (update() resumes
+        failed jobs from their newest managed checkpoint instead of
+        epoch 0)."""
+        self.artifacts.metadata.mark_failed(
+            name,
+            "orphaned-by-restart: re-dispatching from the job journal",
+        )
+        description = spec.get("description") or ""
+        if kind == "distributed":
+            from learningorchestra_tpu.services.distributed_exec import (
+                DistributedExecutorService,
+            )
+
+            DistributedExecutorService(self, None).update_train(
+                name, description=description
+            )
+        else:
+            from learningorchestra_tpu.services.executor import (
+                ExecutorService,
+            )
+
+            ExecutorService(self).update(
+                name,
+                description=description,
+                deadline_s=spec.get("deadlineS"),
+            )
+
+    def _orphan_job(self, name: str, *, journaled: bool,
+                    detail: str | None = None) -> None:
+        """Terminally fail an interrupted job that cannot (or must
+        not) be re-dispatched — never leave phantom 'running'
+        metadata."""
+        if journaled:
+            reason = (
+                "orphaned-by-restart: the orchestrator died while "
+                "this job was queued or running and its body is not "
+                "automatically re-dispatchable"
+                + (f" ({detail})" if detail else "")
+                + "; re-run it with a PATCH (bare PATCH re-uses the "
+                "last recorded parameters)"
+            )
+        else:
+            reason = (
+                "job interrupted by a server restart or store "
+                "failover before completing; re-run it with a "
+                "PATCH (bare PATCH re-uses the last recorded "
+                "parameters)"
+            )
+        self.artifacts.metadata.mark_failed(name, reason)
+        if journaled:
+            self.journal.append(
+                "failed", name, reason="orphaned-by-restart"
+            )
+        get_logger("context").warning(
+            f"re-flagged interrupted job {name!r} "
+            "(was mid-run when the previous process died)"
+        )
+        # Subscribers must see the terminal transition: the
+        # observe event feed + any registered webhooks fire
+        # exactly as the engine's own failure path would
+        # (jobs/engine.py _notify) — a watcher of the dead
+        # job would otherwise wait forever.
+        try:
+            self.webhooks.notify(
+                name, "failed",
+                self.artifacts.metadata.read(name) or {},
+            )
+        except Exception:  # noqa: BLE001 — startup must finish
+            pass
+
+    def require_current_epoch(self) -> None:
+        """Epoch fence at artifact-publication time: a job body from a
+        stale engine epoch (pre-crash straggler, or a partitioned
+        duplicate orchestrator once the control plane goes
+        multi-process) raises :class:`~learningorchestra_tpu.jobs.
+        journal.StaleEpochError` here instead of double-publishing.
+        No-op outside an engine dispatch."""
+        self.journal.fence_check()
 
     def _init_backend(self) -> None:
         """Eagerly initialize the JAX backend on the main thread.
@@ -211,6 +380,10 @@ class ServiceContext:
         self.engine.shutdown(
             wait=self.config.jobs.shutdown_drain_s > 0
         )
+        # Journal AFTER the engine (shutdown journals its cancelled
+        # drops), BEFORE the store (a drain into closed WAL handles
+        # would drop every record).
+        self.journal.close()
         self.documents.close()
 
     # -- validation helpers shared by services --------------------------------

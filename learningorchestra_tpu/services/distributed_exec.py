@@ -237,11 +237,23 @@ class DistributedExecutorService:
             # directory is always the managed one — raw paths were
             # rejected at the route.  resume defaults by request kind:
             # fresh POST wipes stale state; PATCH of a failed job
-            # resumes it.
+            # resumes it; an in-engine preemption RETRY (attempt > 0)
+            # always resumes — its checkpoints are this run's own
+            # state, never stale (the PR-7 current_attempt threading
+            # the single-device path already has).
             import shutil as _shutil
 
+            from learningorchestra_tpu.jobs import (
+                engine as engine_mod,
+            )
+
+            attempt = engine_mod.current_attempt()
             ckdir = self.ctx.checkpoint_dir(name)
             params.setdefault("resume", resume_default)
+            if attempt > 0:
+                # A retry's checkpoints are this run's own state —
+                # resume even when the request said fresh-fit.
+                params["resume"] = True
             if not params["resume"] and ckdir.exists():
                 _shutil.rmtree(ckdir, ignore_errors=True)
             params["checkpoint_dir"] = str(ckdir)
@@ -266,6 +278,9 @@ class DistributedExecutorService:
                     else:
                         trainer.fit(**params)
                 fit_time = time.perf_counter() - t0
+            # Epoch fence at publication: a stale-epoch straggler must
+            # not overwrite the artifact a recovered orchestrator owns.
+            self.ctx.require_current_epoch()
             self.ctx.volumes.save_object(artifact_type, name, instance)
             # A re-train just replaced this artifact's binary: a
             # serving registry holding the old params resident must
@@ -395,9 +410,18 @@ class DistributedExecutorService:
                     data["vy"] = str(stage / "vy.npy")
 
                 # Fresh runs must not resurrect a previous run's
-                # checkpoints (same guard as the local path).
+                # checkpoints (same guard as the local path); an
+                # in-engine preemption retry resumes its own run's
+                # checkpoints instead of re-fitting from epoch 0.
+                from learningorchestra_tpu.jobs import (
+                    engine as engine_mod,
+                )
+
+                attempt = engine_mod.current_attempt()
                 ckdir = self.ctx.checkpoint_dir(name)
                 fit_kwargs.setdefault("resume", resume_default)
+                if attempt > 0:
+                    fit_kwargs["resume"] = True
                 if not fit_kwargs["resume"] and ckdir.exists():
                     _shutil.rmtree(ckdir, ignore_errors=True)
                 fit_kwargs["checkpoint_dir"] = str(ckdir)
@@ -443,6 +467,13 @@ class DistributedExecutorService:
                 fit_time = time.perf_counter() - t0
             finally:
                 _shutil.rmtree(stage, ignore_errors=True)
+            # Epoch fence: a pre-crash straggler whose cluster job
+            # outlived the orchestrator must not rewrite the history
+            # rows a recovered run owns.  (The agents' binary write
+            # happens on their hosts and is out of this fence's
+            # reach — the engine's fenced terminal commit still stops
+            # the stale metadata from publishing.)
+            self.ctx.require_current_epoch()
             rank0 = job["results"].get("0") or job["results"].get(0)
             history = (rank0 or {}).get("history") or {}
             for doc in self.ctx.documents.find(

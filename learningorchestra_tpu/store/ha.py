@@ -57,6 +57,7 @@ import urllib.request
 from datetime import datetime, timezone
 from pathlib import Path
 
+from learningorchestra_tpu import faults
 from learningorchestra_tpu.log import get_logger
 from learningorchestra_tpu.store.replica import (
     FENCE_FILE,
@@ -267,6 +268,12 @@ class StandbyMonitor:
         over the directory.  The final sync never deletes replicated
         data (``allow_drops=False``) — a dying primary that presents
         an empty or missing store must not take the replica with it."""
+        # Chaos probe: an injected `error` models the standby dying at
+        # the election moment — promotion is idempotent (the epoch
+        # bump and fence land only on success), so a supervisor
+        # restart re-promotes cleanly; the kill-9 recovery drills arm
+        # seeded schedules here.
+        faults.hit("store.ha.failover")
         try:
             shipped = self.replica.sync(allow_drops=False)
             self.primary_epoch = max(

@@ -59,6 +59,7 @@ import urllib.parse
 import urllib.request
 from pathlib import Path
 
+from learningorchestra_tpu import faults
 from learningorchestra_tpu.store.document_store import (
     DocumentStore,
     _match,
@@ -296,6 +297,12 @@ class WalReplica:
         for the final pre-promotion sync: a promote must never delete
         replicated data, whatever the dying primary looks like.
         """
+        # Chaos probe: an injected `error` here models the standby
+        # crashing mid-ship (its supervisor restarts it; shipped
+        # offsets are durable, so the next sync resumes); `delay`
+        # models replication lag — the kill-9 recovery drills run
+        # their WAL shipping under seeded schedules.
+        faults.hit("replica.wal_ship")
         listing = self.transport.list_wals()
         shipped: dict[str, int] = {}
         seen = set()

@@ -823,6 +823,86 @@ class TestTrainChaos:
             ctx.close()
 
 
+# -- replica.wal_ship / store.ha.failover: the HA-tier points -----------------
+
+
+class TestReplicationChaos:
+    """The HA/replication tier's fault points (PR-7 carried
+    follow-up): WAL shipping and promotion run under seeded schedules
+    so the kill-9 recovery drills can chaos the failover path too."""
+
+    def test_injected_wal_ship_error_then_clean_resync(self, tmp_path):
+        """An injected error at the shipping boundary models the
+        standby crashing mid-ship: shipped offsets are durable, so
+        the next sync resumes and the replica converges."""
+        from learningorchestra_tpu.store.document_store import (
+            DocumentStore,
+        )
+        from learningorchestra_tpu.store.replica import WalReplica
+
+        primary = tmp_path / "primary"
+        store = DocumentStore(primary)
+        for i in range(5):
+            store.insert_one("rows", {"n": i})
+        replica = WalReplica(str(primary), tmp_path / "replica")
+        faults.arm("replica.wal_ship", "error", max_triggers=1)
+        with pytest.raises(FaultInjected):
+            replica.sync()
+        shipped = replica.sync()  # supervisor-restart analogue
+        assert sum(shipped.values()) > 0
+        assert faults.triggers("replica.wal_ship") == 1
+        assert len(replica.find("rows")) == 5
+        store.close()
+
+    def test_injected_wal_ship_delay_is_lag_not_failure(self, tmp_path):
+        from learningorchestra_tpu.store.document_store import (
+            DocumentStore,
+        )
+        from learningorchestra_tpu.store.replica import WalReplica
+
+        primary = tmp_path / "primary"
+        store = DocumentStore(primary)
+        store.insert_one("rows", {"n": 1})
+        replica = WalReplica(str(primary), tmp_path / "replica")
+        faults.arm("replica.wal_ship", "delay", delay_ms=30,
+                   max_triggers=1)
+        t0 = time.monotonic()
+        replica.sync()
+        assert time.monotonic() - t0 >= 0.03
+        assert len(replica.find("rows")) == 1
+        store.close()
+
+    def test_injected_failover_fault_promotion_retries(self, tmp_path):
+        """Promotion dies at the election moment under a seeded
+        schedule; the retry (a supervisor restart) promotes cleanly —
+        epoch bumped, old primary fenced."""
+        from learningorchestra_tpu.store.document_store import (
+            DocumentStore,
+        )
+        from learningorchestra_tpu.store.ha import StandbyMonitor
+        from learningorchestra_tpu.store.replica import read_epoch
+
+        primary = tmp_path / "primary"
+        store = DocumentStore(primary)
+        store.insert_one("rows", {"n": 1})
+        store.close()
+        monitor = StandbyMonitor(
+            "127.0.0.1:1", primary, tmp_path / "replica",
+            probe_timeout=0.2, new_primary_addr="127.0.0.1:9",
+        )
+        monitor.step()
+        faults.arm("store.ha.failover", "error", max_triggers=1)
+        with pytest.raises(FaultInjected):
+            monitor.promote()
+        # Nothing half-promoted: no epoch bump, no fence landed.
+        assert read_epoch(tmp_path / "replica") == 0
+        assert not (primary / ".fenced").exists()
+        promoted = monitor.promote()
+        assert read_epoch(promoted) == 1
+        assert (primary / ".fenced").exists()
+        assert faults.triggers("store.ha.failover") == 1
+
+
 # -- bench probe -------------------------------------------------------------
 
 
