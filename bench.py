@@ -402,6 +402,96 @@ def _compile_cache_probe() -> dict:
     }
 
 
+def _warmboot_probe(rounds: int = 3) -> dict:
+    """Durable-warm-start A/B (train/aot_store.py): first-dispatch
+    latency into a FRESH compile cache, cold (trace + XLA compile)
+    vs pre-warmed from an AOT-serialized executable on disk.
+
+    Subsystem probe per ROADMAP guidance, not the noisy headline
+    metric: each side is best-of-``rounds`` tight loops against its
+    own fresh ``CompiledProgramCache`` — the cold side builds through
+    a brand-new ``jax.jit`` wrapper every round (re-trace +
+    re-compile, the restart bill), the warm side restores the SAME
+    program fingerprint through the store's deserialize-and-load
+    path.  The store lives in a temp dir, installed/uninstalled via
+    ``reset_store`` so the probe leaves process state untouched.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from learningorchestra_tpu.models.mlp import MLPClassifier
+    from learningorchestra_tpu.train import aot_store
+    from learningorchestra_tpu.train import compile_cache as cc
+
+    rng = np.random.default_rng(0)
+    n_features = 64
+    est = MLPClassifier(hidden_layer_sizes=[32], num_classes=8)
+    est.compute_dtype = "float32"
+    est._init_params(jnp.asarray(
+        rng.standard_normal((1, n_features)).astype(np.float32)
+    ))
+    params = est.params
+    module = est.module
+    x = jnp.asarray(
+        rng.standard_normal((16, n_features)).astype(np.float32)
+    )
+    key = cc.apply_program_key(module, rows=16)
+    label = "warmboot:b16"
+
+    def first_dispatch(cache) -> float:
+        t0 = time.perf_counter()
+        apply = cache.get_or_build(
+            key, lambda: jax.jit(module.apply), label=label
+        )
+        jax.block_until_ready(apply(params, x))
+        return time.perf_counter() - t0
+
+    tmp = tempfile.mkdtemp(prefix="lo-warmboot-")
+    try:
+        # Populate the store once — the "previous process".
+        from jax.experimental import serialize_executable
+
+        compiled = jax.jit(module.apply).lower(params, x).compile()
+        seed = aot_store.AOTExecutableStore(
+            tmp, max_entries=8, max_bytes=1 << 30
+        )
+        seed.offer(
+            key, serialize_executable.serialize(compiled), label=label
+        )
+
+        colds, warms, aot_hits = [], [], 0
+        for _ in range(rounds):
+            aot_store.reset_store()  # no store → cold build path
+            colds.append(first_dispatch(
+                cc.CompiledProgramCache(max_entries=8)
+            ))
+        for _ in range(rounds):
+            aot_store.reset_store(
+                root=tmp, max_entries=8, max_bytes=1 << 30
+            )
+            warms.append(first_dispatch(
+                cc.CompiledProgramCache(max_entries=8)
+            ))
+            aot_hits += aot_store.get_store().hits
+    finally:
+        aot_store.reset_store()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    cold = min(colds)
+    warm = min(warms)
+    return {
+        "cold_first_dispatch_s": round(cold, 4),
+        "prewarmed_first_dispatch_s": round(warm, 4),
+        "speedup": round(cold / warm, 2) if warm > 0 else None,
+        "aot_hits": aot_hits,
+        "rounds": rounds,
+    }
+
+
 def _serving_probe(
     n_features: int = 64,
     hidden: tuple = (32,),
@@ -1369,6 +1459,10 @@ def _tpu_suite_child_main() -> None:
         suite["_slo"] = _slo_probe()
     except Exception as exc:  # noqa: BLE001 — record, don't hide
         suite["_slo"] = f"FAILED: {exc!r}"
+    try:
+        suite["_warmboot"] = _warmboot_probe()
+    except Exception as exc:  # noqa: BLE001 — record, don't hide
+        suite["_warmboot"] = f"FAILED: {exc!r}"
     print(json.dumps(suite))
 
 
@@ -1388,6 +1482,7 @@ def main() -> None:
         fleet_probe = suite.pop("_fleet", None)
         costs_probe = suite.pop("_costs", None)
         slo_probe = suite.pop("_slo", None)
+        warmboot_probe = suite.pop("_warmboot", None)
         throughput, extra = _assemble_tpu(suite)
         extra.update(flash)
         if cache_probe is not None:
@@ -1406,6 +1501,8 @@ def main() -> None:
             extra["costs"] = costs_probe
         if slo_probe is not None:
             extra["slo"] = slo_probe
+        if warmboot_probe is not None:
+            extra["warmboot"] = warmboot_probe
     else:
         _force_cpu()  # record a CPU number rather than hang the driver
         import jax
@@ -1449,6 +1546,10 @@ def main() -> None:
             extra["slo"] = _slo_probe()
         except Exception as exc:  # noqa: BLE001 — record, don't hide
             extra["slo"] = f"FAILED: {exc!r}"
+        try:
+            extra["warmboot"] = _warmboot_probe()
+        except Exception as exc:  # noqa: BLE001 — record, don't hide
+            extra["warmboot"] = f"FAILED: {exc!r}"
 
     metric = f"mnist_cnn_train_samples_per_sec_per_chip_{platform}"
     prior = _prior_best(metric, allow_cross_backend=platform == "tpu")

@@ -1993,7 +1993,7 @@ class APIServer:
         from learningorchestra_tpu.obs.metrics import Family
         from learningorchestra_tpu.store.ha import is_fenced
         from learningorchestra_tpu.store.replica import read_epoch
-        from learningorchestra_tpu.train import compile_cache
+        from learningorchestra_tpu.train import aot_store, compile_cache
 
         fams: list[Family] = []
         fams.append(
@@ -2063,6 +2063,44 @@ class APIServer:
                 "Cache entries charged at their MEASURED serialized "
                 "size (vs the flat fallback estimate).",
             ).sample(stats.get("measuredEntries", 0))
+        )
+
+        # -- durable AOT executable store (train/aot_store.py) --------
+        # Zeros when disabled (stats_snapshot keeps scrape shape
+        # stable), so dashboards never see a series appear/vanish on a
+        # config flip.
+        aot = aot_store.stats_snapshot()
+        fams.append(
+            Family(
+                "counter", "lo_compile_cache_aot_hits",
+                "AOT executables restored from the durable store "
+                "(dispatches that skipped trace AND compile).",
+            ).sample(aot["hits"])
+        )
+        fams.append(
+            Family(
+                "counter", "lo_compile_cache_aot_misses",
+                "Durable-store lookups with no usable blob.",
+            ).sample(aot["misses"])
+        )
+        fams.append(
+            Family(
+                "counter", "lo_compile_cache_aot_load_errors",
+                "Stale/corrupt AOT blobs that degraded to a live "
+                "re-trace.",
+            ).sample(aot["loadErrors"])
+        )
+        fams.append(
+            Family(
+                "gauge", "lo_compile_cache_aot_persisted_entries",
+                "Executables currently persisted in the AOT store.",
+            ).sample(aot["persistedEntries"])
+        )
+        fams.append(
+            Family(
+                "gauge", "lo_compile_cache_aot_persisted_bytes",
+                "On-disk bytes of persisted AOT executables.",
+            ).sample(aot["persistedBytes"])
         )
 
         # -- cost accounting: per-program FLOPs/HBM + device-time

@@ -151,6 +151,37 @@ class CompileCacheConfig:
 
 
 @dataclasses.dataclass
+class AOTConfig:
+    """Durable warm start (train/aot_store.py): hot compiled programs
+    are AOT-serialized to disk next to the XLA cache and restored into
+    the compile cache at boot, so a restart/deploy serves its first
+    dispatches without re-tracing.  Env knobs: LO_TPU_AOT_*."""
+
+    # Master switch — OFF by default: restored executables pin exact
+    # shapes/dtypes and device signatures, so durability is an
+    # explicit deployment opt-in (both deploy manifests set it).
+    # Env: LO_TPU_AOT_ENABLED.
+    enabled: bool = False
+    # On-disk executable store (blobs + hot-set manifest).
+    # Env: LO_TPU_AOT_DIR.
+    dir: str = "~/.learningorchestra_tpu/aot_cache"
+    # Persisted-entry cap; <= 0 disables the store.
+    # Env: LO_TPU_AOT_MAX_ENTRIES.
+    max_entries: int = 64
+    # Persisted-bytes cap (real serialized sizes from the manifest).
+    # Env: LO_TPU_AOT_MAX_BYTES.
+    max_bytes: int = 1 << 30
+    # Boot pre-warm: restore the manifest's hot set into the compile
+    # cache on a background thread at ServiceContext boot.
+    # Env: LO_TPU_AOT_PREWARM.
+    prewarm: bool = True
+    # Fleet: warm a fresh replica against its model's recorded hot
+    # bucket set BEFORE the P2C router may pick it.
+    # Env: LO_TPU_AOT_REPLICA_PREWARM.
+    replica_prewarm: bool = False
+
+
+@dataclasses.dataclass
 class ServeConfig:
     """Resident model serving (serve/): request-coalescing batched
     inference over device-pinned params (POST /serve/<model>/predict).
@@ -505,6 +536,7 @@ class Config:
     compile_cache: CompileCacheConfig = dataclasses.field(
         default_factory=CompileCacheConfig
     )
+    aot: AOTConfig = dataclasses.field(default_factory=AOTConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
@@ -624,6 +656,20 @@ class Config:
         if "LO_TPU_JOB_JOURNAL_MAX" in env:
             cfg.jobs.journal_max_records = int(
                 env["LO_TPU_JOB_JOURNAL_MAX"]
+            )
+        if "LO_TPU_AOT_ENABLED" in env:
+            cfg.aot.enabled = _bool_env("LO_TPU_AOT_ENABLED")
+        if "LO_TPU_AOT_DIR" in env:
+            cfg.aot.dir = env["LO_TPU_AOT_DIR"]
+        if "LO_TPU_AOT_MAX_ENTRIES" in env:
+            cfg.aot.max_entries = int(env["LO_TPU_AOT_MAX_ENTRIES"])
+        if "LO_TPU_AOT_MAX_BYTES" in env:
+            cfg.aot.max_bytes = int(env["LO_TPU_AOT_MAX_BYTES"])
+        if "LO_TPU_AOT_PREWARM" in env:
+            cfg.aot.prewarm = _bool_env("LO_TPU_AOT_PREWARM")
+        if "LO_TPU_AOT_REPLICA_PREWARM" in env:
+            cfg.aot.replica_prewarm = _bool_env(
+                "LO_TPU_AOT_REPLICA_PREWARM"
             )
         if "LO_TPU_FLEET_ENABLED" in env:
             cfg.fleet.enabled = _bool_env("LO_TPU_FLEET_ENABLED")

@@ -97,6 +97,8 @@ POINTS = (
     "train.epoch",
     "replica.wal_ship",
     "store.ha.failover",
+    "cache.aot_load",
+    "cache.aot_store",
 )
 
 

@@ -551,7 +551,8 @@ def _flatten_cost_analysis(raw):
 
 
 def analyze_jitted(key: str, label: str | None, fn,
-                   example_args: tuple) -> ProgramCost | None:
+                   example_args: tuple, *,
+                   aot_eligible: bool = True) -> ProgramCost | None:
     """Run XLA cost (and, deep, memory/size) analysis for the program
     ``fn(*example_args)`` and record it under ``key``.
 
@@ -562,7 +563,14 @@ def analyze_jitted(key: str, label: str | None, fn,
     ``compile()`` pays an XLA compile that the persistent XLA disk
     cache dedups against the first real call's.  Best-effort by
     design: any failure counts in ``analysis_failures`` and the build
-    proceeds with the un-analyzed ledger entry."""
+    proceeds with the un-analyzed ledger entry.
+
+    The deep path's serialized payload is the SAME artifact the
+    durable warm-start store persists (train/aot_store.py), so when
+    that store is enabled the payload is offered to it here — one
+    serialize, two consumers.  ``aot_eligible=False`` opts a program
+    out (tuple-valued builders: a restored single executable could not
+    stand in for the (epoch, evaluate) pair consumers unpack)."""
     if not enabled():
         return None
     ledger = get_ledger()
@@ -570,6 +578,7 @@ def analyze_jitted(key: str, label: str | None, fn,
     if existing is not None and existing.analyzed:
         return existing  # device-set invalidation rebuilt it: costs hold
     t0 = time.perf_counter()
+    payload = None
     try:
         import jax
 
@@ -584,13 +593,17 @@ def analyze_jitted(key: str, label: str | None, fn,
                 memory = compiled.memory_analysis()
             except Exception:  # noqa: BLE001 — backend may not report
                 memory = None
-            serialized = _serialized_size(compiled)
+            payload = _serialize_payload(compiled)
+            serialized = (
+                len(payload[0]) if payload is not None
+                else _hlo_proto_size(compiled)
+            )
             if cost is None:
                 cost = _flatten_cost_analysis(compiled.cost_analysis())
     except Exception:  # noqa: BLE001 — analysis must never fail a build
         ledger.note_failure()
         return None
-    return ledger.record_analysis(
+    record = ledger.record_analysis(
         key, label,
         flops=(cost or {}).get("flops"),
         bytes_accessed=(cost or {}).get("bytes accessed"),
@@ -598,20 +611,43 @@ def analyze_jitted(key: str, label: str | None, fn,
         serialized=serialized,
         analysis_s=time.perf_counter() - t0,
     )
+    if aot_eligible and payload is not None:
+        _offer_aot(key, label, payload)
+    return record
 
 
-def _serialized_size(compiled) -> int | None:
-    """Bytes of the serialized executable — the number the cache's
-    byte cap wants.  Falls back through jax's AOT serializer to the
-    serialized HLO proto; None when neither is available."""
+def _offer_aot(key: str, label: str | None, payload) -> None:
+    """Hand the just-serialized executable to the durable store
+    (disabled → one attribute check).  The store swallows its own
+    failures; this guard covers import/config breakage."""
+    try:
+        from learningorchestra_tpu.train import aot_store
+
+        store = aot_store.get_store()
+        if store is not None:
+            store.offer(key, payload, label=label)
+    except Exception:  # noqa: BLE001 — persistence never fails a build
+        pass
+
+
+def _serialize_payload(compiled):
+    """The full ``serialize_executable`` payload tuple — blob plus the
+    in/out tree defs ``deserialize_and_load`` needs.  None when the
+    backend can't serialize."""
     try:
         from jax.experimental import serialize_executable
 
         payload = serialize_executable.serialize(compiled)
-        blob = payload[0] if isinstance(payload, tuple) else payload
-        return len(blob)
+        if not isinstance(payload, tuple):
+            payload = (payload,)
+        return payload
     except Exception:  # noqa: BLE001
-        pass
+        return None
+
+
+def _hlo_proto_size(compiled) -> int | None:
+    """Fallback size estimate when the AOT serializer is unavailable:
+    the serialized HLO proto; None when neither is available."""
     try:
         memory = compiled.memory_analysis()
         proto = getattr(memory, "serialized_hlo_proto", None)

@@ -169,6 +169,12 @@ class FleetManager:
                 max_replicas=mx,
                 lease_timeout_s=self.cfg.lease_timeout_s,
                 router_seed=self.cfg.router_seed,
+                # getattr: test stubs provide only the dispatch seam.
+                warmup=(
+                    self.service.replica_warmup_factory(name)
+                    if hasattr(self.service, "replica_warmup_factory")
+                    else None
+                ),
             )
             try:
                 rs.scale_to(rs.min_replicas, reason="ensure")

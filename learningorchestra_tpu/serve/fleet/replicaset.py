@@ -74,7 +74,8 @@ class Replica:
 
     __slots__ = (
         "model", "idx", "device_id", "batcher", "created_at",
-        "_handle", "_jax_device", "_device_resolved", "_placed",
+        "warmed", "_handle", "_jax_device", "_device_resolved",
+        "_placed",
     )
 
     def __init__(self, model: str, idx: int, handle):
@@ -86,6 +87,10 @@ class Replica:
             else None
         )
         self.created_at = time.time()
+        # True once the pre-warm dispatches (hot bucket set) completed
+        # before the replica became routable; False means it serves
+        # cold (warm-up off, no recorded buckets, or warm-up failed).
+        self.warmed = False
         self.batcher: MicroBatcher | None = None
         self._jax_device = None
         self._device_resolved = False
@@ -137,6 +142,7 @@ class Replica:
             "batches": stats.get("batches", 0),
             "overflows": stats.get("overflows", 0),
             "latencyMs": stats.get("latencyMs", {}),
+            "warmed": self.warmed,
         }
 
 
@@ -160,6 +166,7 @@ class ReplicaSet:
         max_replicas: int = 1,
         lease_timeout_s: float = 5.0,
         router_seed: int = 0,
+        warmup: Callable[[Replica], None] | None = None,
     ):
         if not 1 <= min_replicas <= max_replicas:
             raise ValueError(
@@ -170,6 +177,11 @@ class ReplicaSet:
         self._cfg = serve_cfg
         self._leaser = leaser
         self._factory = dispatch_factory
+        # Optional pre-router warm-up (serve.ServingService binds the
+        # hot-bucket dummy dispatches when LO_TPU_AOT_REPLICA_PREWARM
+        # is on): runs against a fresh replica BEFORE it joins the
+        # routable list, so the P2C router never picks a cold device.
+        self._warmup = warmup
         self.min_replicas = int(min_replicas)
         self.max_replicas = int(max_replicas)
         self.lease_timeout_s = float(lease_timeout_s)
@@ -283,6 +295,26 @@ class ReplicaSet:
             flush_ms=self._cfg.flush_ms,
             name=f"{self.name}:r{idx}",
         )
+        if self._warmup is not None:
+            # Warm BEFORE the replica is routable: the dummy
+            # dispatches pay XLA's per-device executable load here,
+            # not under the first routed request's latency.  A failed
+            # warm-up is logged and the replica serves cold (warmed
+            # stays False) — availability beats warmth.
+            try:
+                with tracing.span(
+                    "replica.warmup", model=self.name, replica=idx,
+                    device=replica.device_id or "host",
+                ):
+                    self._warmup(replica)
+                replica.warmed = True
+            except Exception as exc:  # noqa: BLE001
+                logger.warning(kv(
+                    event="replica_warmup_failed", model=self.name,
+                    replica=idx,
+                    device=replica.device_id or "host",
+                    error=repr(exc),
+                ))
         with self._lock:
             # Closed (or raced past max by a concurrent scaler) while
             # the lease was being placed: hand everything straight back.

@@ -33,7 +33,7 @@ class ServeError(Exception):
 class _Resident:
     __slots__ = (
         "name", "estimator", "params", "nbytes", "loaded_at", "requests",
-        "apply_fns", "apply_costs", "replica_devices",
+        "apply_fns", "apply_costs", "replica_devices", "warm_shapes",
     )
 
     def __init__(self, name, estimator, params, nbytes):
@@ -57,6 +57,10 @@ class _Resident:
         # listings show WHERE each model serves, not just that it is
         # resident.  Empty for single-path models.
         self.replica_devices: dict = {}
+        # bucket rows → (padded shape, dtype str) recorded at dispatch
+        # time — the hot bucket set a fresh replica is pre-warmed
+        # against before the router may pick it.
+        self.warm_shapes: dict = {}
 
     def to_dict(self) -> dict:
         return {

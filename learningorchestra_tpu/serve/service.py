@@ -213,9 +213,10 @@ class ServingService:
         (arch, bucket) executable from the compile cache, so scaling
         1→N adds zero misses to THIS cache.  (XLA itself still
         compiles per device underneath the shared jitted callable —
-        a fresh replica's first dispatch per bucket pays that
-        device-side warm-up; see the ROADMAP follow-up on replica
-        pre-warming.)"""
+        with ``LO_TPU_AOT_REPLICA_PREWARM`` on, a fresh replica pays
+        that device-side warm-up against the recorded hot bucket set
+        BEFORE the router may pick it; see
+        :meth:`replica_warmup_factory`.)"""
         import jax
         import jax.numpy as jnp
 
@@ -259,6 +260,10 @@ class ServingService:
             apply = entry.apply_fns[rows] = (
                 cc.get_cache().get_or_build(key, builder, label=label)
             )
+        # Record the bucket for replica pre-warm (shape + dtype is
+        # all a dummy dispatch needs); dies with the entry alongside
+        # apply_fns, so invalidation never warms a stale architecture.
+        entry.warm_shapes[rows] = (padded.shape, str(padded.dtype))
         if replica is not None:
             # Hand place() the HOST array: one host→replica-device
             # transfer, not host→default-device→replica-device.
@@ -326,6 +331,37 @@ class ServingService:
             )
 
         return factory
+
+    def replica_warmup_factory(self, name: str):
+        """Pre-warm binder for the fleet manager, or None when
+        ``LO_TPU_AOT_REPLICA_PREWARM`` is off.  The returned callable
+        runs dummy dispatches for every bucket the model has actually
+        served (``entry.warm_shapes``) through the new replica —
+        paying XLA's per-device executable load/compile BEFORE the
+        P2C router can pick the replica, so scale-up under a traffic
+        spike no longer exposes cold p99.  Warm-up failures are the
+        caller's to log: a replica that can't warm still serves (cold)
+        rather than stranding acquired chips."""
+        from learningorchestra_tpu.config import get_config
+
+        try:
+            if not get_config().aot.replica_prewarm:
+                return None
+        except Exception:  # noqa: BLE001 — config breakage → no warmup
+            return None
+
+        def warm(replica):
+            try:
+                entry = self.registry.get(name)
+            except Exception:  # noqa: BLE001 — gone → nothing to warm
+                return
+            for rows, (shape, dtype) in sorted(
+                entry.warm_shapes.items()
+            ):
+                dummy = np.zeros(shape, dtype=dtype)
+                self._dispatch(name, dummy, replica=replica)
+
+        return warm
 
     def pop_single_path(self, name: str) -> MicroBatcher | None:
         """Detach (NOT close) the model's single-path batcher — THE

@@ -13,7 +13,7 @@ import pandas as pd
 
 from learningorchestra_tpu.config import Config, get_config
 from learningorchestra_tpu.jobs import JobEngine
-from learningorchestra_tpu.log import get_logger
+from learningorchestra_tpu.log import get_logger, kv
 from learningorchestra_tpu.store import (
     ArtifactStore,
     VolumeStorage,
@@ -130,6 +130,12 @@ class ServiceContext:
         self._init_backend()
         self.journal.prune()
         self._recover_jobs()
+        # Durable warm start: restore the persisted AOT hot set into
+        # the compile cache on a background thread, so recovered fits
+        # and the first post-deploy requests hit warm executables
+        # instead of re-tracing (ROADMAP item 3).
+        self._aot_prewarm_thread = None
+        self._start_aot_prewarm()
 
     def add_artifact_change_listener(self, listener) -> None:
         """Register ``listener(name)`` to fire when an artifact's
@@ -339,6 +345,83 @@ class ServiceContext:
         No-op outside an engine dispatch."""
         self.journal.fence_check()
 
+    def _start_aot_prewarm(self) -> None:
+        """Kick off the boot pre-warm when the durable AOT store is on
+        (``LO_TPU_AOT_ENABLED`` + ``LO_TPU_AOT_PREWARM``) and has a
+        manifest to walk.  Background by design: restoring executables
+        costs device-time seconds and must not gate readiness — the
+        API comes up immediately; programs not yet restored simply
+        build live as before."""
+        from learningorchestra_tpu.train import aot_store, compile_cache
+
+        try:
+            if not (
+                aot_store.enabled()
+                and self.config.aot.prewarm
+                and compile_cache.enabled()
+            ):
+                return
+            store = aot_store.get_store()
+            work = store.manifest_entries() if store is not None else []
+        except Exception:  # noqa: BLE001 — warm start is best-effort
+            return
+        if not work:
+            return
+        import threading
+
+        self._aot_prewarm_thread = threading.Thread(
+            target=self._aot_prewarm, args=(store, work),
+            name="aot-prewarm", daemon=True,
+        )
+        self._aot_prewarm_thread.start()
+
+    def _aot_prewarm(self, store, work: list[dict]) -> None:
+        """Walk the manifest hottest-first, deserializing each blob and
+        installing the restored executable into the compile cache.
+        Every restore is a span on a dedicated boot trace
+        (``boot.prewarm`` — the trace surfaces in logs; per-key
+        failures degrade to live builds, never crash the boot)."""
+        import time
+
+        from learningorchestra_tpu.obs import tracing
+        from learningorchestra_tpu.train import compile_cache
+
+        cache = compile_cache.get_cache()
+        trace = tracing.new_trace("boot.prewarm")
+        warmed = skipped = failed = 0
+        t0 = time.perf_counter()
+        with tracing.activate(trace):
+            for rec in work:
+                key = rec.get("key")
+                if not key or cache.contains(key):
+                    skipped += 1
+                    continue
+                label = rec.get("label")
+                try:
+                    with tracing.span(
+                        "prewarm", key=key[:12], label=label or "",
+                    ):
+                        compiled = store.load(key)
+                        if compiled is None:
+                            failed += 1
+                            continue
+                        ok = cache.install(
+                            key,
+                            compile_cache._AOTRestored(
+                                compiled, None, key, label
+                            ),
+                            label=label,
+                            nbytes=rec.get("bytes"),
+                        )
+                    warmed += 1 if ok else 0
+                except Exception:  # noqa: BLE001 — a bad blob costs
+                    failed += 1    # one key, not the boot
+        get_logger("services").info(kv(
+            event="aot_prewarm_done", warmed=warmed, skipped=skipped,
+            failed=failed, total=len(work),
+            seconds=round(time.perf_counter() - t0, 3),
+        ))
+
     def _init_backend(self) -> None:
         """Eagerly initialize the JAX backend on the main thread.
 
@@ -371,6 +454,13 @@ class ServiceContext:
         compile_cache.get_cache().remove_invalidation_listener(
             getattr(self, "_warm_hint_listener", None)
         )
+        # Bounded wait for an in-flight boot pre-warm (daemon thread):
+        # installs racing a closing process are harmless — the compile
+        # cache is process-global — but a short join keeps test
+        # teardown deterministic.
+        thread = getattr(self, "_aot_prewarm_thread", None)
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=5.0)
         # With a drain budget configured (LO_TPU_JOB_DRAIN_S — both
         # deploy manifests set one) the graceful path WAITS, bounded:
         # running bodies get their cancel tokens flipped past the

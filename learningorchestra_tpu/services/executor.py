@@ -116,7 +116,7 @@ class ExecutorService:
         self._submit(
             name, parent_meta, method, method_parameters, artifact_type,
             description, resume_checkpoint=False,
-            warm_key=_warm_key(model_meta, method),
+            warm_key=_warm_key(model_meta, method, method_parameters),
             deadline_s=deadline_s,
         )
         return meta
@@ -154,7 +154,9 @@ class ExecutorService:
         self._submit(
             name, parent_meta, meta.get("method"), method_parameters,
             meta.get("type"), description, resume_checkpoint=resume,
-            warm_key=_warm_key(meta, meta.get("method")),
+            warm_key=_warm_key(
+                meta, meta.get("method"), method_parameters
+            ),
             deadline_s=deadline_s,
         )
         return self.ctx.artifacts.metadata.read(name)
@@ -358,7 +360,7 @@ class ExecutorService:
             method=method,
         )
 
-        warm_key = _warm_key(model_meta, method)
+        warm_key = _warm_key(model_meta, method, param_grid)
 
         def run():
             from learningorchestra_tpu.jobs import engine as engine_mod
@@ -535,17 +537,26 @@ class ExecutorService:
         self.ctx.delete_artifact(name)
 
 
-def _warm_key(meta: dict, method) -> str | None:
-    """Coarse compiled-program tag for the engine's warm-start dispatch
-    preference: jobs instantiating the same registry class with the
-    same method very likely share traced programs.  A HINT, not a
-    guarantee — exact matching happens inside compile_cache; a wrong
-    hint merely reorders one class's queue."""
+def _warm_key(meta: dict, method,
+              method_parameters: dict | None = None) -> str | None:
+    """Program-fingerprint warm hint for the engine's warm-start
+    dispatch preference (``compile_cache.warm_fingerprint``): the
+    submitted spec's trace-shaping parameters hash into the key, so
+    two jobs share a hint exactly when they would very likely share
+    traced programs — an optimizer or layer-width change separates
+    them, where the old coarse ``module:class:method`` tag lumped a
+    whole class together.  A HINT, not a guarantee — exact matching
+    happens inside compile_cache; a wrong hint merely reorders one
+    class's queue."""
+    from learningorchestra_tpu.train import compile_cache
+
     module_path = meta.get("modulePath")
     class_name = meta.get("class")
     if not module_path or not class_name:
         return None
-    return f"{module_path}:{class_name}:{method}"
+    return compile_cache.warm_fingerprint(
+        module_path, class_name, method, method_parameters
+    )
 
 
 def _json_safe(obj):

@@ -222,7 +222,8 @@ class MonitoringService:
         finished-job metadata.  Each resident entry's byte charge
         (measured vs fallback) rides in ``entries_detail``; the
         per-program FLOPs/HBM records join in under ``programCosts``
-        (obs/costs.py)."""
+        (obs/costs.py); the durable AOT executable store's counters
+        (train/aot_store.py — zeros when disabled) under ``aot``."""
         from learningorchestra_tpu.train import compile_cache
 
         stats = compile_cache.get_cache().stats()
@@ -235,6 +236,12 @@ class MonitoringService:
                 )
         except Exception:  # noqa: BLE001 — cost listing must never
             pass  # fail the monitoring poll
+        try:
+            from learningorchestra_tpu.train import aot_store
+
+            stats["aot"] = aot_store.stats_snapshot()
+        except Exception:  # noqa: BLE001 — same contract as above
+            pass
         return stats
 
     def stop(self, nickname: str) -> bool:

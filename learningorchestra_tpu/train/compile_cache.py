@@ -68,6 +68,7 @@ __all__ = [
     "reset_cache",
     "counters_snapshot",
     "delta_since",
+    "warm_fingerprint",
 ]
 
 
@@ -87,6 +88,14 @@ def _costs():
     from learningorchestra_tpu.obs import costs
 
     return costs
+
+
+def _aot():
+    """Lazy durable-executable-store handle (train/aot_store.py): a
+    miss consults the on-disk AOT store before paying a live trace."""
+    from learningorchestra_tpu.train import aot_store
+
+    return aot_store
 
 
 # -- canonical fingerprinting -------------------------------------------------
@@ -286,6 +295,93 @@ def _record_compile_span(built_s: float, label, key: str) -> None:
         pass
 
 
+# -- durable warm start -------------------------------------------------------
+
+#: Request knobs that do not shape the traced program: two submissions
+#: differing only here share every compiled executable, so the warm
+#: hint must treat them as identical.
+_WARM_HINT_EXCLUDE = frozenset((
+    "verbose", "description", "monitoring_path", "monitoringPath",
+    "checkpoint_dir", "checkpointDir", "resume",
+))
+
+
+def warm_fingerprint(module_path, class_name, method,
+                     parameters: dict | None = None) -> str:
+    """Program-level warm-start hint for the engine's dispatcher.
+
+    The old hint was ``module:class:method`` — coarse enough that two
+    tune candidates with different optimizers (different programs!)
+    claimed the same warmth.  This fingerprints the SUBMITTED SPEC
+    through the same canonicalizer the cache keys use, minus the knobs
+    that never reach a trace (verbosity, monitoring/checkpoint paths),
+    so warm-start preference actually predicts cache hits.  Still a
+    HINT: exact matching happens inside the cache; a collision merely
+    reorders one class's queue."""
+    params = {
+        k: v for k, v in (parameters or {}).items()
+        if k not in _WARM_HINT_EXCLUDE
+    }
+    return fingerprint(
+        "warm", str(module_path), str(class_name), str(method), params
+    )
+
+
+class _AOTRestored:
+    """A deserialized AOT executable standing in for the jit wrapper a
+    builder would have produced, with a one-shot live-rebuild fallback.
+
+    A restored ``Compiled`` pins the exact input avatars of the
+    original trace, so an argument shape/dtype it never saw raises
+    where a jit wrapper would simply re-trace.  The first call failure
+    rebuilds live through the builder captured at lookup time and
+    permanently swaps the rebuilt program in (counted store-side as a
+    ``callFallbacks``); the request re-raises only if the REBUILT
+    program fails too — genuine errors stay errors, stale executables
+    cost one re-trace."""
+
+    __slots__ = ("_fn", "_builder", "_key", "_label", "_fell_back")
+
+    def __init__(self, fn, builder, key, label):
+        self._fn = fn
+        self._builder = builder
+        self._key = key
+        self._label = label
+        self._fell_back = False
+
+    def bind_builder(self, builder) -> None:
+        """Boot pre-warm restores with no builder in hand; the first
+        ``get_or_build`` hit re-arms the fallback with its caller's."""
+        if self._builder is None:
+            self._builder = builder
+
+    def __call__(self, *args, **kwargs):
+        if self._fell_back:
+            return self._fn(*args, **kwargs)
+        try:
+            return self._fn(*args, **kwargs)
+        except Exception:
+            builder = self._builder
+            if builder is None:
+                raise
+            self._fell_back = True
+            t0 = time.perf_counter()
+            rebuilt = builder()
+            if isinstance(rebuilt, tuple):
+                rebuilt = rebuilt[0]
+            self._fn = rebuilt
+            _record_compile_span(
+                time.perf_counter() - t0, self._label, self._key
+            )
+            try:
+                store = _aot().get_store()
+                if store is not None:
+                    store.note_call_fallback()
+            except Exception:  # noqa: BLE001 — accounting only
+                pass
+            return self._fn(*args, **kwargs)
+
+
 # -- the cache ---------------------------------------------------------------
 
 
@@ -408,7 +504,13 @@ class CompiledProgramCache:
                 if entry is not None:
                     self._entries.move_to_end(key)
                     self.hits += 1
-                    return entry.value
+                    value = entry.value
+                    if type(value) is _AOTRestored:
+                        # A pre-warmed executable has no rebuild path
+                        # yet; arm its call-time fallback with this
+                        # caller's builder.
+                        value.bind_builder(builder)
+                    return value
                 pending = self._building.get(key)
                 if pending is None:
                     pending = self._building[key] = threading.Event()
@@ -424,14 +526,27 @@ class CompiledProgramCache:
                     self._entries.move_to_end(key)
                     self.hits += 1
                     self.coalesced += 1
-                    return self._entries[key].value
+                    value = self._entries[key].value
+                    if type(value) is _AOTRestored:
+                        value.bind_builder(builder)
+                    return value
         t0 = time.perf_counter()
+        restored = None
         try:
-            # Chaos probe on the MISS path only: cache hits must stay
-            # untouched (a compile fault models tracing/XLA failure,
-            # which by definition happens when a program builds).
-            _faults().hit("compile.build")
-            value = builder()
+            # Durable warm start: a persisted AOT executable satisfies
+            # the miss without tracing OR compiling (train/aot_store.py
+            # validates headers/checksums; any mismatch returns None
+            # and the live build below proceeds as if no store existed).
+            restored = self._aot_restore(key, builder, label)
+            if restored is None:
+                # Chaos probe on the BUILD path only: cache hits must
+                # stay untouched (a compile fault models tracing/XLA
+                # failure, which by definition happens when a program
+                # builds).
+                _faults().hit("compile.build")
+                value = builder()
+            else:
+                value = restored[0]
         except BaseException:
             with self._lock:
                 ev = self._building.pop(key, None)
@@ -439,20 +554,30 @@ class CompiledProgramCache:
                 ev.set()
             raise
         built_s = time.perf_counter() - t0
-        _record_compile_span(built_s, label, key)
+        if restored is None:
+            # An AOT-satisfied lookup records NO compile span — the
+            # restart drill asserts pre-warmed keys rebuild nothing.
+            _record_compile_span(built_s, label, key)
         self._note_cost(key, label, built_s)
         measured = False
         if nbytes is None:
-            # Real serialized size when the builder's cost analysis
-            # measured one (ROADMAP item 3's carried debt: the byte
-            # cap charged a flat 32 MiB per entry); the flat estimate
-            # survives only as the fallback for unanalyzed programs.
-            nbytes = self._measured_bytes(key)
-            measured = nbytes is not None
+            if restored is not None:
+                # The store's manifest carries the blob's measured size.
+                nbytes = restored[1]
+                measured = nbytes is not None
+            else:
+                # Real serialized size when the builder's cost analysis
+                # measured one (ROADMAP item 3's carried debt: the byte
+                # cap charged a flat 32 MiB per entry); the flat
+                # estimate survives only as the fallback for unanalyzed
+                # programs.
+                nbytes = self._measured_bytes(key)
+                measured = nbytes is not None
         with self._lock:
             ev = self._building.pop(key, None)
             self.misses += 1
-            self.trace_time_s += built_s
+            if restored is None:
+                self.trace_time_s += built_s
             if build_generation == self._generation:
                 self._entries[key] = _Entry(
                     value,
@@ -470,6 +595,53 @@ class CompiledProgramCache:
         if ev is not None:
             ev.set()
         return value
+
+    @staticmethod
+    def _aot_restore(key: str, builder, label):
+        """``(guarded_value, nbytes|None)`` from the durable AOT store,
+        or None → build live.  Never raises except the fault plane's
+        ``Preempted`` (the store re-raises it: preemption belongs to
+        the job retry loop, not the corruption fallback)."""
+        try:
+            store = _aot().get_store()
+        except Exception:  # noqa: BLE001 — a broken store must never
+            return None  # break the build path it shortcuts
+        if store is None:
+            return None
+        compiled = store.load(key)
+        if compiled is None:
+            return None
+        rec = store.entry(key) or {}
+        return (
+            _AOTRestored(compiled, builder, key, label),
+            rec.get("bytes"),
+        )
+
+    def install(self, key: str, value, *, label: str | None = None,
+                nbytes: int | None = None) -> bool:
+        """Install an externally restored program (boot pre-warm,
+        services/context.py) WITHOUT counting a hit or miss and without
+        recording a compile span.  Respects the device-set check and
+        the eviction policy; an already-resident key wins (never
+        clobber a live entry).  Returns True when the key is resident
+        afterwards."""
+        if self.max_entries <= 0:
+            return False
+        with self._lock:
+            self._check_devices_locked()
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                return True
+            self._entries[key] = _Entry(
+                value,
+                self.entry_bytes if nbytes is None else int(nbytes),
+                label,
+                0.0,
+                measured=nbytes is not None,
+            )
+            self._entries.move_to_end(key)
+            self._evict_locked()
+            return key in self._entries
 
     @staticmethod
     def _note_cost(key: str, label, built_s: float) -> None:

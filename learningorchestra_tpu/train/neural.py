@@ -573,7 +573,13 @@ def _cached_program(
         def building():
             fn = builder()
             target = fn[0] if isinstance(fn, tuple) else fn
-            _probe_program_cost(key, label, target, cost_args)
+            # Tuple-valued builders (epoch, evaluate) are not AOT-
+            # eligible: a restored single executable couldn't stand in
+            # for the pair the consumers unpack.
+            _probe_program_cost(
+                key, label, target, cost_args,
+                aot_eligible=not isinstance(fn, tuple),
+            )
             return fn
 
     fn = cc.get_cache().get_or_build(key, building, label=label)
@@ -587,7 +593,8 @@ def _cached_program(
     )
 
 
-def _probe_program_cost(key, label, fn, cost_args) -> None:
+def _probe_program_cost(key, label, fn, cost_args, *,
+                        aot_eligible: bool = True) -> None:
     """Best-effort XLA cost analysis for a just-built program; a
     failed probe (opaque callable, exotic arg tree) must never fail
     the build it rides."""
@@ -596,7 +603,10 @@ def _probe_program_cost(key, label, fn, cost_args) -> None:
     if not obs_costs.enabled():
         return
     try:
-        obs_costs.analyze_jitted(key, label, fn, tuple(cost_args()))
+        obs_costs.analyze_jitted(
+            key, label, fn, tuple(cost_args()),
+            aot_eligible=aot_eligible,
+        )
     except Exception:  # noqa: BLE001
         pass
 

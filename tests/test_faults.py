@@ -395,6 +395,98 @@ class TestCompileChaos:
         assert faults.triggers("compile.build") == 1
 
 
+# -- cache.aot_load / cache.aot_store ----------------------------------------
+
+
+@pytest.fixture()
+def aot_round_trip(tmp_path):
+    """A durable store holding one REAL serialized executable, plus
+    the key/apply/args to restore it — installed as the process
+    singleton for the test, always uninstalled after."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import serialize_executable
+
+    from learningorchestra_tpu.train import aot_store
+    from learningorchestra_tpu.train import compile_cache as cc
+
+    store = aot_store.reset_store(
+        root=str(tmp_path / "aot"), max_entries=8, max_bytes=1 << 30
+    )
+    fn = jax.jit(lambda a: a * 2.0)
+    compiled = fn.lower(
+        jax.ShapeDtypeStruct((4,), jnp.float32)
+    ).compile()
+    key = cc.fingerprint("chaos", "aot")
+    store.offer(
+        key, serialize_executable.serialize(compiled), label="chaos"
+    )
+    yield store, key
+    aot_store.reset_store()
+
+
+class TestAOTChaos:
+    def test_injected_load_error_degrades_to_live_retrace(
+        self, aot_round_trip
+    ):
+        """A corrupt/failed AOT deserialize must never fail the
+        request: the load-error counter bumps, the blob survives
+        (injected chaos is transient, not corruption), and the
+        program builds live."""
+        import jax
+        import numpy as np
+
+        from learningorchestra_tpu.train import compile_cache as cc
+
+        store, key = aot_round_trip
+        faults.arm("cache.aot_load", "error", max_triggers=1)
+        cache = cc.CompiledProgramCache(max_entries=8)
+        built = []
+
+        def builder():
+            built.append(1)
+            return jax.jit(lambda a: a * 2.0)
+
+        apply = cache.get_or_build(key, builder, label="chaos")
+        out = np.asarray(apply(np.ones(4, dtype=np.float32)))
+        assert out.tolist() == [2.0, 2.0, 2.0, 2.0]
+        # Degraded to the live build — and the blob is still there
+        # for the next boot (an injected error is not corruption).
+        assert built == [1]
+        assert store.load_errors == 1
+        assert store.contains(key)
+        assert faults.triggers("cache.aot_load") == 1
+        # Disarmed: a fresh cache restores from disk, no rebuild.
+        cache2 = cc.CompiledProgramCache(max_entries=8)
+        restored = cache2.get_or_build(key, builder, label="chaos")
+        assert built == [1]
+        out2 = np.asarray(restored(np.ones(4, dtype=np.float32)))
+        assert out2.tolist() == [2.0, 2.0, 2.0, 2.0]
+        assert store.hits == 1
+
+    def test_injected_store_error_counts_and_build_proceeds(
+        self, tmp_path
+    ):
+        """An injected persist failure costs only the durability —
+        ``offer`` returns False, the error counter bumps, and a
+        disarmed re-offer lands the blob."""
+        from learningorchestra_tpu.train import aot_store
+        from learningorchestra_tpu.train import compile_cache as cc
+
+        store = aot_store.AOTExecutableStore(
+            str(tmp_path / "aot2"), max_entries=8, max_bytes=1 << 30
+        )
+        key = cc.fingerprint("chaos", "aot_store")
+        faults.arm("cache.aot_store", "error", max_triggers=1)
+        assert store.offer(key, ("payload",), label="chaos") is False
+        assert store.store_errors == 1
+        assert not store.contains(key)
+        assert faults.triggers("cache.aot_store") == 1
+        # Disarmed: the same offer persists.
+        assert store.offer(key, ("payload",), label="chaos") is True
+        assert store.contains(key)
+
+
 # -- store.wal_write ---------------------------------------------------------
 
 
