@@ -1183,6 +1183,130 @@ def _slo_probe() -> dict:
     }
 
 
+def _decode_probe(
+    n_prompts: int = 16,
+    max_slots: int = 16,
+    hidden: int = 128,
+    layers: int = 2,
+    heads: int = 4,
+    vocab: int = 256,
+    t0: int = 8,
+    max_new: int = 56,
+) -> dict:
+    """Streaming-decode probe: continuous batching through the decode
+    engine vs sequential solo ``generate``, tokens/sec best-of (the
+    ROADMAP bench caveat: tight-loop subsystem numbers, not the
+    noise-dominated headline).
+
+    The sequential baseline is the pre-engine serving reality — one
+    jitted decode scan per request, warm compile cache — which is
+    also the fairest one: it pipelines its own steps through async
+    dispatch exactly like the engine's lazy pools do, so the measured
+    speedup isolates what SHARING a step across in-flight sequences
+    buys.  The engine side submits every prompt at once and lets
+    admission pack the slot buckets.  A mid-flight TTFT sample rides
+    along: with a stream already generating, a newly admitted stream's
+    first token must arrive within a handful of shared steps — the
+    continuous-batching latency story next to the throughput one.
+    """
+    import numpy as np
+
+    from learningorchestra_tpu.config import Config
+    from learningorchestra_tpu.models.text import DecoderLM
+    from learningorchestra_tpu.serve.decode import DecodeEngine
+    from learningorchestra_tpu.serve.registry import ModelRegistry
+
+    total = t0 + max_new
+    rng = np.random.default_rng(0)
+    est = DecoderLM(
+        vocab_size=vocab, hidden_dim=hidden, num_layers=layers,
+        num_heads=heads, max_len=total, seed=0,
+    )
+    est.compute_dtype = "float32"
+    x = rng.integers(1, vocab, size=(8, total - 2)).astype(np.int32)
+    y = np.concatenate([x[:, 1:], np.zeros((8, 1), np.int32)], axis=1)
+    est.fit(x, y, epochs=1, batch_size=8)
+    prompts = rng.integers(
+        1, vocab, size=(n_prompts, t0)
+    ).astype(np.int32)
+
+    # Sequential baseline, warm solo program, best-of windows.
+    est.generate(prompts[:1], max_new_tokens=max_new)
+    seq_tok_s = 0.0
+    for _ in range(3):
+        t_start = time.perf_counter()
+        for i in range(n_prompts):
+            est.generate(prompts[i:i + 1], max_new_tokens=max_new)
+        dt = time.perf_counter() - t_start
+        seq_tok_s = max(seq_tok_s, n_prompts * max_new / dt)
+
+    # The engine needs only config + registry residency: a stub
+    # service around a REAL ModelRegistry (no fleet, no HTTP).
+    cfg = Config()
+    cfg.decode.max_slots = max_slots
+    cfg.decode.max_new_tokens = max(
+        cfg.decode.max_new_tokens, max_new
+    )
+    cfg.decode.max_streams = max(
+        cfg.decode.max_streams, n_prompts + 2
+    )
+
+    class _Ctx:
+        config = cfg
+
+    class _Svc:
+        ctx = _Ctx()
+        registry = ModelRegistry(lambda name: est)
+
+    engine = DecodeEngine(_Svc())
+    try:
+        # Warm pass compiles the slot-bucket ladder once.
+        engine.generate(
+            "bench_lm", prompts.tolist(), max_new_tokens=max_new
+        )
+        eng_tok_s, out = 0.0, None
+        for _ in range(3):
+            t_start = time.perf_counter()
+            out = engine.generate(
+                "bench_lm", prompts.tolist(), max_new_tokens=max_new
+            )
+            dt = time.perf_counter() - t_start
+            eng_tok_s = max(eng_tok_s, n_prompts * max_new / dt)
+        solo = np.asarray(
+            est.generate(prompts[:1], max_new_tokens=max_new)
+        )[0].tolist()
+        bit_identical = out["tokens"][0] == solo
+
+        # Mid-flight admission TTFT.
+        bg = engine.generate(
+            "bench_lm", prompts[0].tolist(),
+            max_new_tokens=max_new, stream=True,
+        )
+        deadline = time.time() + 30
+        while not bg.tokens and time.time() < deadline:
+            time.sleep(0.002)
+        mid = engine.generate(
+            "bench_lm", prompts[1].tolist(),
+            max_new_tokens=max_new, stream=True,
+        )
+        mid.wait_done(60)
+        bg.wait_done(60)
+        ttft_ms = mid.summary().get("ttftMs")
+    finally:
+        engine.close()
+    return {
+        "sequential_tok_s": round(seq_tok_s, 1),
+        "engine_tok_s": round(eng_tok_s, 1),
+        "continuous_batching_speedup": round(
+            eng_tok_s / seq_tok_s, 2
+        ) if seq_tok_s else None,
+        "midflight_ttft_ms": ttft_ms,
+        "bit_identical_to_solo": bool(bit_identical),
+        "n_prompts": n_prompts,
+        "max_new": max_new,
+    }
+
+
 def _fleet_probe(
     n_requests: int = 384,
     concurrency: int = 16,
@@ -1452,6 +1576,10 @@ def _tpu_suite_child_main() -> None:
     except Exception as exc:  # noqa: BLE001 — record, don't hide
         suite["_fleet"] = f"FAILED: {exc!r}"
     try:
+        suite["_decode"] = _decode_probe()
+    except Exception as exc:  # noqa: BLE001 — record, don't hide
+        suite["_decode"] = f"FAILED: {exc!r}"
+    try:
         suite["_costs"] = _costs_probe()
     except Exception as exc:  # noqa: BLE001 — record, don't hide
         suite["_costs"] = f"FAILED: {exc!r}"
@@ -1480,6 +1608,7 @@ def main() -> None:
         faults_probe = suite.pop("_faults", None)
         journal_probe = suite.pop("_journal", None)
         fleet_probe = suite.pop("_fleet", None)
+        decode_probe = suite.pop("_decode", None)
         costs_probe = suite.pop("_costs", None)
         slo_probe = suite.pop("_slo", None)
         warmboot_probe = suite.pop("_warmboot", None)
@@ -1497,6 +1626,8 @@ def main() -> None:
             extra["journal"] = journal_probe
         if fleet_probe is not None:
             extra["fleet"] = fleet_probe
+        if decode_probe is not None:
+            extra["decode"] = decode_probe
         if costs_probe is not None:
             extra["costs"] = costs_probe
         if slo_probe is not None:
@@ -1538,6 +1669,10 @@ def main() -> None:
             extra["fleet"] = _fleet_probe()
         except Exception as exc:  # noqa: BLE001 — record, don't hide
             extra["fleet"] = f"FAILED: {exc!r}"
+        try:
+            extra["decode"] = _decode_probe()
+        except Exception as exc:  # noqa: BLE001 — record, don't hide
+            extra["decode"] = f"FAILED: {exc!r}"
         try:
             extra["costs"] = _costs_probe()
         except Exception as exc:  # noqa: BLE001 — record, don't hide

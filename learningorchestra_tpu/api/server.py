@@ -1174,6 +1174,67 @@ class APIServer:
                 }
 
         add("POST", rf"/serve/{NAME}/predict", serve_predict)
+
+        def serve_generate(m, body, query):
+            """Autoregressive decode against a resident LM.  With
+            ``stream=true`` the return value is the DecodeStream
+            itself — the HTTP layer recognizes its ``sse_events``
+            surface and writes a ``text/event-stream`` body, one
+            event per generated token (registered ``no_timeout``: the
+            stream outlives any slot budget; backpressure lives in
+            the engine's own stream cap)."""
+            body = body or {}
+            prompts = body.get("prompts")
+            if prompts is None:
+                prompts = body.get("instances")
+            if prompts is None:
+                raise ValidationError("missing 'prompts'")
+            stream = bool(body.get("stream"))
+            kwargs = {
+                "max_new_tokens": int(body.get("maxNewTokens", 32)),
+                "stream": stream,
+                "seed": int(body.get("seed", 0)),
+            }
+            if body.get("temperature") is not None:
+                kwargs["temperature"] = float(body["temperature"])
+            if body.get("topK") is not None:
+                kwargs["top_k"] = int(body["topK"])
+            if body.get("topP") is not None:
+                kwargs["top_p"] = float(body["topP"])
+            try:
+                result = self.serving.generate(
+                    m.group("name"), prompts, **kwargs
+                )
+            except QueueFull as exc:
+                return 429, {
+                    "error": str(exc),
+                    "retryAfter": self.config.serve.retry_after_s,
+                }
+            if stream:
+                return 200, result  # DecodeStream → SSE writer
+            return 200, result
+
+        add("POST", rf"/serve/{NAME}/generate", serve_generate,
+            no_timeout=True)
+
+        def serve_generate_abort(m, body, query):
+            """Server-side abort of an in-flight decode stream: frees
+            the KV page slot at the next step boundary even when the
+            SSE socket is still nominally open (lost client)."""
+            ok = self.serving.decode.abort(
+                m.group("name"), m.group("stream"),
+                reason="aborted by DELETE",
+            )
+            if not ok:
+                return 404, {
+                    "error": f"no active stream {m.group('stream')!r} "
+                    f"for model {m.group('name')!r}"
+                }
+            return 200, {"aborted": m.group("stream")}
+
+        add("DELETE",
+            rf"/serve/{NAME}/generate/(?P<stream>[A-Za-z0-9]+)",
+            serve_generate_abort)
         add(
             "POST", rf"/serve/{NAME}/load",
             lambda m, b, q: (
@@ -2615,6 +2676,10 @@ class APIServer:
                 self._send(status, payload)
 
             def _send(self, status: int, payload):
+                events = getattr(payload, "sse_events", None)
+                if callable(events):
+                    self._send_sse(status, payload, events)
+                    return
                 if (
                     isinstance(payload, tuple)
                     and len(payload) == 2
@@ -2644,6 +2709,38 @@ class APIServer:
                     )
                 self.end_headers()
                 self.wfile.write(data)
+
+            def _send_sse(self, status: int, stream, events):
+                """Server-sent-events body for a DecodeStream payload.
+                No Content-Length is possible (the token count is not
+                known up front), so under HTTP/1.1 the body is
+                EOF-delimited: ``Connection: close`` and the handler
+                drops keep-alive for this socket.  A broken pipe mid-
+                stream IS the client-disconnect signal — it aborts the
+                stream so the engine frees its KV pages at the next
+                step boundary."""
+                self.close_connection = True
+                self.send_response(status)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-store")
+                self.send_header("Connection", "close")
+                rid = getattr(self, "_request_id", None)
+                if rid:
+                    self.send_header("X-Request-Id", rid)
+                self.end_headers()
+                try:
+                    for name, doc in events():
+                        chunk = (
+                            f"event: {name}\n"
+                            f"data: {json.dumps(doc, default=str)}\n\n"
+                        )
+                        self.wfile.write(chunk.encode())
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError,
+                        OSError):
+                    abort = getattr(stream, "abort", None)
+                    if callable(abort):
+                        abort("client disconnected")
 
             def do_GET(self):
                 self._run("GET")

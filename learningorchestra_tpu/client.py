@@ -778,6 +778,98 @@ class _Serve:
             "POST", f"/serve/{model}/predict", {"instances": instances}
         )
 
+    def generate(self, model: str, prompts, *,
+                 max_new_tokens: int = 32, stream: bool = False,
+                 temperature: float | None = None,
+                 top_k: int | None = None, top_p: float | None = None,
+                 seed: int = 0, timeout: float | None = None):
+        """Autoregressive decode against a resident LM.
+
+        Non-stream (default): POST /serve/<model>/generate, returns
+        the full ``{"tokens": [[...]], "newTokens": [[...]], ...}``
+        response.  With ``stream=True`` (single prompt only) the call
+        returns a GENERATOR of ``(event, doc)`` pairs parsed from the
+        server's ``text/event-stream`` body — ``("open", ...)``, then
+        one ``("token", {"t": id, "i": pos})`` per generated token,
+        terminated by ``("done", summary)`` / ``("error", ...)`` /
+        ``("aborted", ...)``.  Closing the generator drops the socket,
+        which the server treats as a client abort (KV pages freed at
+        the next decode step)."""
+        body: dict = {
+            "prompts": prompts,
+            "maxNewTokens": int(max_new_tokens),
+            "seed": int(seed),
+        }
+        if temperature is not None:
+            body["temperature"] = temperature
+        if top_k is not None:
+            body["topK"] = top_k
+        if top_p is not None:
+            body["topP"] = top_p
+        if not stream:
+            return self.ctx.request(
+                "POST", f"/serve/{model}/generate", body
+            )
+        body["stream"] = True
+        return self._sse_events(
+            f"/serve/{model}/generate", body, timeout
+        )
+
+    def _sse_events(self, path: str, body: dict,
+                    timeout: float | None):
+        """Minimal SSE line parser over the streaming decode body:
+        accumulates ``event:``/``data:`` fields, yields on each blank
+        line.  urllib only — same zero-dependency discipline as the
+        rest of the client."""
+        req = urllib.request.Request(
+            self.ctx.base + path, method="POST",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            resp = urllib.request.urlopen(
+                req,
+                timeout=timeout or max(
+                    self.ctx.request_timeout, 300.0
+                ),
+            )
+        except urllib.error.HTTPError as exc:
+            raise Context._client_error(exc) from None
+        try:
+            event: str | None = None
+            data_lines: list[str] = []
+            for raw in resp:
+                line = raw.decode(
+                    "utf-8", errors="replace"
+                ).rstrip("\r\n")
+                if line:
+                    if line.startswith("event:"):
+                        event = line[len("event:"):].strip()
+                    elif line.startswith("data:"):
+                        data_lines.append(
+                            line[len("data:"):].strip()
+                        )
+                    continue
+                if event is None and not data_lines:
+                    continue  # keep-alive blank
+                joined = "\n".join(data_lines)
+                try:
+                    doc = json.loads(joined) if joined else {}
+                except json.JSONDecodeError:
+                    doc = {"raw": joined}
+                yield (event or "message", doc)
+                event, data_lines = None, []
+        finally:
+            resp.close()
+
+    def abort_stream(self, model: str, stream_id: str) -> dict:
+        """DELETE /serve/<model>/generate/<stream> — server-side abort
+        of an in-flight decode stream (frees its KV slot at the next
+        step boundary); 404 when the stream already finished."""
+        return self.ctx.request(
+            "DELETE", f"/serve/{model}/generate/{stream_id}"
+        )
+
     def load(self, model: str) -> dict:
         """Pin a trained artifact's params resident on device."""
         return self.ctx.request("POST", f"/serve/{model}/load", {})

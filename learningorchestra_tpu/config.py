@@ -210,6 +210,37 @@ class ServeConfig:
 
 
 @dataclasses.dataclass
+class DecodeConfig:
+    """Streaming LM decode engine (serve/decode/): resident KV page
+    pools + continuous batching + SSE token streaming behind
+    ``POST /serve/<model>/generate``.  Env knobs: LO_TPU_DECODE_*."""
+
+    # Master switch: off, /generate still answers non-stream requests
+    # through the solo jitted scan; stream=true is refused (406).
+    # Env: LO_TPU_DECODE_ENABLED.
+    enabled: bool = True
+    # Largest slot bucket per KV page pool (power-of-two growth up to
+    # this): bounds concurrent in-flight sequences per (model, kv
+    # bucket) AND the slot dimension of every step executable.
+    # Env: LO_TPU_DECODE_MAX_SLOTS.
+    max_slots: int = 8
+    # Largest KV-length bucket (pages per slot); also caps prompt+
+    # generation length served by the engine.  The effective cap is
+    # min(model max_len, this).  Env: LO_TPU_DECODE_MAX_KV.
+    max_kv: int = 2048
+    # Active + pending stream cap per model — beyond it, submission
+    # sheds load (HTTP 429 + Retry-After).
+    # Env: LO_TPU_DECODE_MAX_STREAMS.
+    max_streams: int = 64
+    # Server-side ceiling on a request's maxNewTokens.
+    # Env: LO_TPU_DECODE_MAX_NEW.
+    max_new_tokens: int = 128
+    # Idle decode workers park and free their resident KV pools after
+    # this long with no streams.  Env: LO_TPU_DECODE_IDLE_S.
+    idle_timeout_s: float = 60.0
+
+
+@dataclasses.dataclass
 class FleetConfig:
     """Fleet serving (serve/fleet/): multi-replica data plane over
     leased chips with metrics-driven autoscaling.  Env knobs:
@@ -247,6 +278,12 @@ class FleetConfig:
     # LO_TPU_FLEET_SLOPE_WINDOW_S.
     up_slope: float = 0.0
     slope_window_s: float = 30.0
+    # Cost-aware scale-up: attributed device-time fraction (per-model
+    # device seconds per wall second, obs/costs.py serving ledger)
+    # above this triggers scale-up — a model saturating its chip
+    # scales BEFORE queues back up.  0 = off.
+    # Env: LO_TPU_FLEET_UP_DEVICE_FRAC.
+    up_device_frac: float = 0.0
     # Chip-lease budget when placing a new replica; on timeout the
     # scale-up is skipped and retried next tick.
     # Env: LO_TPU_FLEET_LEASE_TIMEOUT_S.
@@ -345,6 +382,13 @@ class SLOConfig:
     # LO_TPU_SLO_PREDICT_TARGET.
     predict_p99_ms: float = 250.0
     predict_target: float = 0.99
+    # Streamed-decode time-to-first-token objective: at least
+    # decode_ttft_target of streams see their first token under
+    # decode_ttft_ms.  0 ms disables the objective (the default — a
+    # deployment opts in when it serves LMs).
+    # Env: LO_TPU_SLO_DECODE_TTFT_MS / LO_TPU_SLO_DECODE_TTFT_TARGET.
+    decode_ttft_ms: float = 0.0
+    decode_ttft_target: float = 0.99
     # Job success objective: finished / (finished + failed + deadline)
     # over the window.  Env: LO_TPU_SLO_JOB_SUCCESS.
     job_success_target: float = 0.99
@@ -538,6 +582,9 @@ class Config:
     )
     aot: AOTConfig = dataclasses.field(default_factory=AOTConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+    decode: DecodeConfig = dataclasses.field(
+        default_factory=DecodeConfig
+    )
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     rollup: RollupConfig = dataclasses.field(
@@ -671,6 +718,24 @@ class Config:
             cfg.aot.replica_prewarm = _bool_env(
                 "LO_TPU_AOT_REPLICA_PREWARM"
             )
+        if "LO_TPU_DECODE_ENABLED" in env:
+            cfg.decode.enabled = _bool_env("LO_TPU_DECODE_ENABLED")
+        if "LO_TPU_DECODE_MAX_SLOTS" in env:
+            cfg.decode.max_slots = int(env["LO_TPU_DECODE_MAX_SLOTS"])
+        if "LO_TPU_DECODE_MAX_KV" in env:
+            cfg.decode.max_kv = int(env["LO_TPU_DECODE_MAX_KV"])
+        if "LO_TPU_DECODE_MAX_STREAMS" in env:
+            cfg.decode.max_streams = int(
+                env["LO_TPU_DECODE_MAX_STREAMS"]
+            )
+        if "LO_TPU_DECODE_MAX_NEW" in env:
+            cfg.decode.max_new_tokens = int(
+                env["LO_TPU_DECODE_MAX_NEW"]
+            )
+        if "LO_TPU_DECODE_IDLE_S" in env:
+            cfg.decode.idle_timeout_s = float(
+                env["LO_TPU_DECODE_IDLE_S"]
+            )
         if "LO_TPU_FLEET_ENABLED" in env:
             cfg.fleet.enabled = _bool_env("LO_TPU_FLEET_ENABLED")
         if "LO_TPU_FLEET_MIN" in env:
@@ -694,6 +759,10 @@ class Config:
         if "LO_TPU_FLEET_SLOPE_WINDOW_S" in env:
             cfg.fleet.slope_window_s = float(
                 env["LO_TPU_FLEET_SLOPE_WINDOW_S"]
+            )
+        if "LO_TPU_FLEET_UP_DEVICE_FRAC" in env:
+            cfg.fleet.up_device_frac = float(
+                env["LO_TPU_FLEET_UP_DEVICE_FRAC"]
             )
         if "LO_TPU_FLEET_LEASE_TIMEOUT_S" in env:
             cfg.fleet.lease_timeout_s = float(
@@ -766,6 +835,14 @@ class Config:
             cfg.slo.job_success_target = _fraction_env(
                 "LO_TPU_SLO_JOB_SUCCESS"
             )
+        if "LO_TPU_SLO_DECODE_TTFT_MS" in env:
+            cfg.slo.decode_ttft_ms = float(
+                env["LO_TPU_SLO_DECODE_TTFT_MS"]
+            )
+        if "LO_TPU_SLO_DECODE_TTFT_TARGET" in env:
+            cfg.slo.decode_ttft_target = _fraction_env(
+                "LO_TPU_SLO_DECODE_TTFT_TARGET"
+            )
         if "LO_TPU_SLO_FAST_S" in env:
             cfg.slo.fast_window_s = float(env["LO_TPU_SLO_FAST_S"])
         if "LO_TPU_SLO_SLOW_S" in env:
@@ -785,6 +862,8 @@ class Config:
             ("LO_TPU_SLO_AVAILABILITY", cfg.slo.availability_target),
             ("LO_TPU_SLO_PREDICT_TARGET", cfg.slo.predict_target),
             ("LO_TPU_SLO_JOB_SUCCESS", cfg.slo.job_success_target),
+            ("LO_TPU_SLO_DECODE_TTFT_TARGET",
+             cfg.slo.decode_ttft_target),
         ):
             if value >= 1.0:
                 raise ValueError(

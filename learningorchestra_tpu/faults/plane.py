@@ -93,6 +93,7 @@ POINTS = (
     "store.wal_write",
     "serve.apply",
     "serve.route",
+    "serve.decode_step",
     "http.handler",
     "train.epoch",
     "replica.wal_ship",

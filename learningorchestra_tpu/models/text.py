@@ -380,24 +380,30 @@ class GreedyDecodeMixin:
             )
         total = min(self.max_len, t0 + max_new_tokens)
 
-        # One (jitted scan, cache shapes) pair per prompt shape, cached
-        # across calls; params enter as an argument, not a baked-in
-        # constant, and the model-wide eval_shape trace runs once per
-        # shape, not per call.
-        fns = getattr(self, "_decode_fns", None)
-        if fns is None:
-            fns = self._decode_fns = {}
-        key = (bsz, total, t0, sample, top_k, top_p is not None)
-        entry = fns.get(key)
-        if entry is not None:
-            fns[key] = fns.pop(key)  # refresh recency (LRU, not FIFO)
-        else:
-            if len(fns) >= 8:
-                # Bound the compiled-scan cache: varied prompt shapes
-                # in a long-lived server must not accumulate
-                # executables without limit (LRU eviction — hits above
-                # refresh recency, so the front is least-recent).
-                fns.pop(next(iter(fns)))
+        # One (jitted scan, cache shapes) pair per prompt shape,
+        # resolved through the CROSS-JOB compiled-program cache
+        # (train/compile_cache): decode scans get fingerprints,
+        # hit/miss stats, warm-start hints and the cache's bounded
+        # eviction like every other program — two estimator instances
+        # of one architecture share the executable (params enter as an
+        # argument, never a baked-in constant), where the old private
+        # per-instance LRU of 8 compiled one each, invisibly.
+        from learningorchestra_tpu.train import compile_cache as cc
+
+        shape_sig = (bsz, total, t0, sample, top_k, top_p is not None)
+        cache_key = cc.program_key(
+            "decode",
+            module=cc.module_fingerprint(self.module),
+            optimizer=None,
+            loss="-",
+            dtype="-",
+            shapes=("decode", *shape_sig),
+        )
+        label = (
+            f"decode:{type(self.module).__name__}:b{bsz}:t{total}"
+        )
+
+        def _build_decode():
             decode_mod = self.module.clone(decode=True)
             # Cache shapes via eval_shape (no real forward, no
             # throwaway params); the trained params drive the scan.
@@ -470,9 +476,11 @@ class GreedyDecodeMixin:
                 )
                 return buf
 
-            entry = fns[key] = (jax.jit(decode), cache_shapes)
+            return jax.jit(decode), cache_shapes
 
-        decode, cache_shapes = entry
+        decode, cache_shapes = cc.get_cache().get_or_build(
+            cache_key, _build_decode, label=label
+        )
         cache0 = jax.tree_util.tree_map(
             lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
         )

@@ -393,6 +393,15 @@ class DeviceTimeLedger:
             doc["mfu"] = util
         return doc
 
+    def model_device_s(self, model: str) -> float:
+        """Accumulated device-seconds attributed to ``model`` (0.0
+        when unseen/evicted) — the fleet autoscaler's cost-aware
+        scale-up signal reads this as a monotone counter and takes
+        deltas per tick."""
+        with self._lock:
+            entry = self._models.get(model)
+            return float(entry[0]) if entry else 0.0
+
     def job_summary(self, job: str,
                     peak_flops: float = 0.0) -> dict | None:
         with self._lock:

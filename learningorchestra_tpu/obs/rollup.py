@@ -70,6 +70,9 @@ CORE_FAMILIES = (
     "lo_serving_model_queue_depth",
     "lo_serving_predict_duration_seconds",
     "lo_serving_replicas",
+    "lo_serving_decode_ttft_seconds",
+    "lo_serving_decode_itl_seconds",
+    "lo_serving_decode_tokens_total",
 )
 
 

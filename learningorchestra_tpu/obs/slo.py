@@ -87,7 +87,10 @@ class _Objective:
     def instances(self, engine) -> list[str]:
         if self.kind == "latency":
             return engine.label_values(
-                "lo_serving_predict_duration_seconds", "model"
+                self.spec.get(
+                    "metric", "lo_serving_predict_duration_seconds"
+                ),
+                "model",
             )
         return ["all"]
 
@@ -107,7 +110,9 @@ class _Objective:
             return bad, total
         if self.kind == "latency":
             frac = engine.fraction_below(
-                "lo_serving_predict_duration_seconds",
+                self.spec.get(
+                    "metric", "lo_serving_predict_duration_seconds"
+                ),
                 {"model": instance},
                 self.spec["threshold_s"], window_s, now=now,
             )
@@ -135,6 +140,8 @@ class _Objective:
                "errorBudget": round(1.0 - self.target, 6)}
         if "threshold_s" in self.spec:
             doc["thresholdMs"] = self.spec["threshold_s"] * 1e3
+        if "metric" in self.spec:
+            doc["metric"] = self.spec["metric"]
         return doc
 
 
@@ -157,6 +164,15 @@ class SLOService:
             self.objectives.append(_Objective(
                 "predict-latency", "latency", cfg.predict_target,
                 threshold_s=cfg.predict_p99_ms / 1e3,
+            ))
+        if getattr(cfg, "decode_ttft_ms", 0) > 0:
+            # Streaming decode: time-to-first-token per model — the
+            # latency SLO for the SSE surface, over the decode
+            # engine's own TTFT histogram instead of predict's.
+            self.objectives.append(_Objective(
+                "decode-ttft", "latency", cfg.decode_ttft_target,
+                threshold_s=cfg.decode_ttft_ms / 1e3,
+                metric="lo_serving_decode_ttft_seconds",
             ))
         if cfg.job_success_target > 0:
             self.objectives.append(_Objective(

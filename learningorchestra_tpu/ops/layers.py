@@ -214,11 +214,18 @@ class MultiHeadSelfAttention(nn.Module):
                 lambda: jnp.zeros((), jnp.int32),
             )
             if is_initialized:
+                # A (B,)-shaped cache_index means each batch row sits
+                # at its OWN position — the continuous-batching engine
+                # steps a mixed pool of sequences with one executable.
+                # A scalar index keeps the classic lockstep semantics.
+                idx = ci.value
+                batched_idx = idx.ndim == 1
                 if self.rope:
                     # Rotate at the CURRENT position before caching —
                     # the cache holds rotated keys, so lookups need no
                     # re-rotation.
-                    pos1 = jnp.full((1,), ci.value)
+                    pos1 = idx[:, None] if batched_idx \
+                        else jnp.full((1,), idx)
                     q = apply_rope(q, pos1)
                     k = apply_rope(k, pos1)
                 if t != 1:
@@ -231,13 +238,23 @@ class MultiHeadSelfAttention(nn.Module):
                         f"a {t}-token chunk (prefill runs through the "
                         "scan one token at a time)"
                     )
-                idx = ci.value
-                ck.value = jax.lax.dynamic_update_slice(
-                    ck.value, k, (0, 0, idx, 0)
-                )
-                cv.value = jax.lax.dynamic_update_slice(
-                    cv.value, v, (0, 0, idx, 0)
-                )
+                tk_cache = ck.value.shape[2]
+                if batched_idx:
+                    # Per-row one-hot select writes: row r lands at
+                    # slot idx[r].  jnp.where is bit-exact against
+                    # dynamic_update_slice for the written lane and
+                    # leaves every other lane untouched.
+                    hot = jnp.arange(tk_cache)[None, :] == idx[:, None]
+                    sel = hot[:, None, :, None]
+                    ck.value = jnp.where(sel, k, ck.value)
+                    cv.value = jnp.where(sel, v, cv.value)
+                else:
+                    ck.value = jax.lax.dynamic_update_slice(
+                        ck.value, k, (0, 0, idx, 0)
+                    )
+                    cv.value = jax.lax.dynamic_update_slice(
+                        cv.value, v, (0, 0, idx, 0)
+                    )
                 ci.value = idx + t
                 # Causality is enforced HERE — the layer owns
                 # cache_index, so it ANDs a validity mask (slots beyond
@@ -246,11 +263,11 @@ class MultiHeadSelfAttention(nn.Module):
                 # passed, including none at all.  Flash brings nothing
                 # for T_q == 1 queries.  The sliding window is likewise
                 # the layer's invariant, not each decode loop's.
-                tk_cache = ck.value.shape[2]
                 slot = jnp.arange(tk_cache)[None, :]
-                valid = slot <= idx
+                bound = idx[:, None] if batched_idx else idx
+                valid = slot <= bound
                 if self.window is not None:
-                    valid = valid & (slot > (idx - self.window))
+                    valid = valid & (slot > (bound - self.window))
                 key_mask = valid if key_mask is None else (
                     key_mask & valid
                 )

@@ -1,0 +1,13 @@
+"""Resident streaming-decode engine (continuous batching + SSE).
+
+``DecodeEngine`` is the serving service's facade; ``DecodeStream`` is
+the per-request lifecycle object the API layer's SSE writer drains;
+``pages``/``build_step`` hold the KV page pools and the bucketed step
+executables.
+"""
+
+from learningorchestra_tpu.serve.decode.engine import DecodeEngine
+from learningorchestra_tpu.serve.decode.pages import PagePool, build_step
+from learningorchestra_tpu.serve.decode.streams import DecodeStream
+
+__all__ = ["DecodeEngine", "DecodeStream", "PagePool", "build_step"]

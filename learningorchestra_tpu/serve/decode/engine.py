@@ -1,0 +1,745 @@
+"""DecodeEngine — resident continuous-batching LM serving.
+
+The serve/ path was one-shot ``apply`` only; this engine opens the
+streaming-generation workload (ROADMAP item 1): per-model decode
+workers step KV page pools (``pages.py``) with one jitted step per
+(arch, slot-bucket, kv-bucket), admit newly-arrived prompts into
+in-flight steps (continuous batching — no barrier batching), emit
+tokens over SSE, and tear a stream down cooperatively through its
+PR-14 CancelToken at the next step boundary.
+
+Fleet integration: when a model has a live replica set, each new
+stream is routed to a replica by the set's P2C router over live decode
+slot counts, and every step's device time lands in the per-model
+attributed device-time ledger — the same signal the autoscaler's
+``LO_TPU_FLEET_UP_DEVICE_FRAC`` threshold reads.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from learningorchestra_tpu.concurrency_rt import make_condition, make_lock
+from learningorchestra_tpu.log import get_logger, kv
+from learningorchestra_tpu.obs.metrics import get_registry
+from learningorchestra_tpu.serve.batcher import QueueFull
+from learningorchestra_tpu.serve.bucketing import bucket_for
+from learningorchestra_tpu.serve.decode.pages import PagePool, build_step
+from learningorchestra_tpu.serve.decode.streams import DecodeStream
+from learningorchestra_tpu.serve.registry import ServeError
+
+logger = get_logger("decode")
+
+#: Ceiling on a non-stream request's wait for its streams to finish.
+_NONSTREAM_TIMEOUT_S = 300.0
+
+#: Lazy (non-SSE) pools sync to host every this-many steps so async
+#: dispatch cannot run unboundedly ahead of the device.
+_SYNC_STRIDE = 32
+
+
+class _DecodeHists:
+    """Identity-cached handles on the decode metric families — the
+    ``_PredictHist`` rebind idiom (serve/service.py): a
+    ``reset_registry()`` mid-life re-homes the series, the steady
+    state pays one identity check.  TTFT and inter-token latency are
+    the two decode SLO primitives; the token counter feeds throughput
+    rollups."""
+
+    __slots__ = ("_reg", "_ttft", "_itl", "_tokens", "_bound")
+
+    def __init__(self):
+        self._reg = None
+        self._ttft = None
+        self._itl = None
+        self._tokens = None
+        self._bound: dict = {}
+
+    def _bind(self, model: str):
+        reg = get_registry()
+        if reg is not self._reg:
+            self._ttft = reg.histogram(
+                "lo_serving_decode_ttft_seconds",
+                "Time to first generated token per streamed decode "
+                "(admission wait + prefill steps + first step).",
+                labels=("model",),
+            )
+            self._itl = reg.histogram(
+                "lo_serving_decode_itl_seconds",
+                "Inter-token latency between consecutive streamed "
+                "decode tokens.",
+                labels=("model",),
+            )
+            self._tokens = reg.counter(
+                "lo_serving_decode_tokens_total",
+                "Generated tokens per served model (all transports).",
+                labels=("model",),
+            )
+            self._bound = {}
+            self._reg = reg
+        bound = self._bound.get(model)
+        if bound is None:
+            if len(self._bound) >= 256:
+                self._bound.clear()
+            bound = self._bound[model] = (
+                self._ttft.bind(model=model),
+                self._itl.bind(model=model),
+            )
+        return bound
+
+    def ttft(self, dt_s: float, model: str) -> None:
+        self._bind(model)[0].observe(dt_s)
+
+    def itl(self, dt_s: float, model: str) -> None:
+        self._bind(model)[1].observe(dt_s)
+
+    def tokens(self, n: int, model: str) -> None:
+        self._bind(model)
+        self._tokens.inc(n, model=model)
+
+
+_decode_hists = _DecodeHists()
+
+
+class _ModelDecoder:
+    """One model's decode worker: admission queue, page pools, step
+    loop.  All pool state is owned by the worker thread; the condition
+    variable hands streams in and wakes the worker for aborts."""
+
+    def __init__(self, engine: "DecodeEngine", name: str):
+        self.engine = engine
+        self.name = name
+        self.cfg = engine.cfg
+        self._cv = make_condition("_ModelDecoder._cv")
+        self._pending: deque = deque()
+        self._pools: dict = {}  # (replica_idx | None, kv) → PagePool
+        self._streams: dict = {}  # stream_id → DecodeStream (active)
+        self._step_state: dict = {}  # (S, kv) → (step fn, cache shapes)
+        self._thread: threading.Thread | None = None
+        self._closed = False
+        self.steps = 0
+
+    # -- submission (any thread) --------------------------------------------
+
+    def submit(self, stream: DecodeStream) -> None:
+        with self._cv:
+            if self._closed:
+                raise ServeError(
+                    f"decode for {self.name!r} is shut down"
+                )
+            active = len(self._streams) + len(self._pending)
+            if active >= self.cfg.max_streams:
+                raise QueueFull(
+                    f"decode for {self.name!r} at max_streams="
+                    f"{self.cfg.max_streams}"
+                )
+            self._pending.append(stream)
+            self._streams[stream.stream_id] = stream
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name=f"decode-{self.name}",
+                    daemon=True,
+                )
+                self._thread.start()
+            self._cv.notify_all()
+
+    def abort(self, stream_id: str, reason: str) -> bool:
+        with self._cv:
+            stream = self._streams.get(stream_id)
+            if stream is None:
+                return False
+            stream.token.cancel(reason)
+            self._cv.notify_all()
+            return True
+
+    def wake(self) -> None:
+        with self._cv:
+            self._cv.notify_all()
+
+    # -- worker --------------------------------------------------------------
+
+    def _any_live(self) -> bool:
+        return any(p.live for p in self._pools.values())
+
+    def _run(self) -> None:
+        idle_since: float | None = None
+        while True:
+            with self._cv:
+                while (not self._closed and not self._pending
+                       and not self._any_live()):
+                    if idle_since is None:
+                        idle_since = time.monotonic()
+                    waited = time.monotonic() - idle_since
+                    if waited >= self.cfg.idle_timeout_s:
+                        # Idle past the knob: free the resident pools
+                        # (KV HBM back to the allocator) and park; the
+                        # next submit restarts the worker.
+                        self._pools.clear()
+                        self._step_state.clear()
+                        self._thread = None
+                        return
+                    self._cv.wait(
+                        timeout=self.cfg.idle_timeout_s - waited
+                    )
+                if self._closed:
+                    pending = list(self._pending)
+                    self._pending.clear()
+                    pools = list(self._pools.values())
+                    self._pools.clear()
+                    self._thread = None
+                    break
+                idle_since = None
+                pending = list(self._pending)
+                self._pending.clear()
+            deferred = []
+            for stream in pending:
+                if not self._admit(stream):
+                    deferred.append(stream)
+            self._step_all()
+            if deferred:
+                with self._cv:
+                    # Back to the FRONT: arrival order is admission
+                    # order once capacity frees up.
+                    self._pending.extendleft(reversed(deferred))
+        # closed: fail whatever never got (or was mid) service.
+        for stream in pending:
+            stream.fail("decode engine shut down")
+        for pool in pools:
+            for slot, stream in enumerate(pool.streams):
+                if stream is not None:
+                    pool.release(slot)
+                    stream.fail("decode engine shut down")
+        with self._cv:
+            self._streams.clear()
+
+    # -- admission -----------------------------------------------------------
+
+    def _route_replica(self):
+        """P2C-pick a replica for a new stream when the model is
+        fleet-served; None keeps the registry-resident single path.
+        Depth signal = live decode slots per replica, the decode
+        analogue of the predict router's queue depth."""
+        try:
+            rs = self.engine.service.fleet.registered_set(self.name)
+        except Exception:  # noqa: BLE001 — routing must not kill admit
+            rs = None
+        if rs is None:
+            return None
+        with rs._lock:
+            replicas = list(rs._replicas)
+        if not replicas:
+            return None
+        depths = []
+        for replica in replicas:
+            depths.append(sum(
+                pool.live for key, pool in self._pools.items()
+                if key[0] == replica.idx
+            ))
+        order = rs.router.choose(depths)
+        return replicas[order[0]]
+
+    def _admit(self, stream: DecodeStream) -> bool:
+        if stream.token.cancelled():
+            self._finish(stream, aborted=True)
+            return True
+        try:
+            replica = self._route_replica()
+            ridx = None if replica is None else replica.idx
+            kv = bucket_for(
+                stream.total,
+                min(self.cfg.max_kv, self._max_len()),
+            )
+            pool = self._pools.get((ridx, kv))
+            if pool is None:
+                pool = self._pools[(ridx, kv)] = PagePool(
+                    kv, self.cfg.max_slots, replica_idx=ridx,
+                )
+            slot = pool.admit(
+                stream,
+                lambda want: self._step_for(want, kv)[1],
+            )
+        except Exception as exc:  # noqa: BLE001 — fail THIS stream
+            logger.error("decode admit failed %s", kv(
+                model=self.name, stream=stream.stream_id,
+                error=str(exc),
+            ))
+            self._finish(stream, error=f"admission failed: {exc}")
+            return True
+        return slot is not None
+
+    def _max_len(self) -> int:
+        entry = self.engine.service.registry.get(self.name)
+        return int(getattr(entry.estimator, "max_len", self.cfg.max_kv))
+
+    # -- stepping ------------------------------------------------------------
+
+    def _step_for(self, nslots: int, kvlen: int):
+        """(jitted step, cache shapes) for one (S, Tk) cell, resolved
+        through the cross-job compile cache: fingerprints, hit/miss
+        stats, warm-start hints and AOT eligibility — never a private
+        dict of executables.  Memoized on the decoder (dies with the
+        model teardown) and recorded on the registry entry's
+        ``decode_warm`` so replica pre-warm can replay it."""
+        state = self._step_state.get((nslots, kvlen))
+        if state is None:
+            from learningorchestra_tpu.train import compile_cache as cc
+
+            entry = self.engine.service.registry.get(self.name)
+            module = entry.estimator.module
+            key = cc.program_key(
+                "decode_step",
+                module=cc.module_fingerprint(module),
+                optimizer=None,
+                loss="-",
+                dtype="-",
+                shapes=("decode_step", nslots, kvlen),
+            )
+            label = (
+                f"decode:{type(module).__name__}"
+                f":s{nslots}:k{kvlen}"
+            )
+            state = cc.get_cache().get_or_build(
+                key, lambda: build_step(module, nslots, kvlen),
+                label=label,
+            )
+            self._step_state[(nslots, kvlen)] = state
+            entry.decode_warm[(nslots, kvlen)] = True
+        return state
+
+    def _params_for(self, pool: PagePool):
+        entry = self.engine.service.registry.get(self.name)
+        if pool.replica_idx is None:
+            return entry.params
+        try:
+            rs = self.engine.service.fleet.registered_set(self.name)
+            if rs is not None:
+                with rs._lock:
+                    replicas = list(rs._replicas)
+                for replica in replicas:
+                    if replica.idx == pool.replica_idx:
+                        params, _ = replica.place(
+                            entry, np.zeros((1, 1), np.int32)
+                        )
+                        return params
+        except Exception:  # noqa: BLE001 — scaled-down replica →
+            pass  # degrade to registry-resident params
+        return entry.params
+
+    def _step_all(self) -> None:
+        from learningorchestra_tpu import faults
+
+        for key in list(self._pools):
+            pool = self._pools[key]
+            # Abort sweep FIRST: a cancelled stream's pages are freed
+            # within one step boundary of the cancel, even if the
+            # step itself then faults.
+            for slot, stream in enumerate(pool.streams):
+                if stream is not None and stream.token.cancelled():
+                    pool.release(slot)
+                    self._finish(stream, aborted=True)
+            if not pool.live:
+                continue
+            try:
+                faults.hit("serve.decode_step")
+                self._step_pool(pool)
+            except Exception as exc:  # noqa: BLE001 — chaos/device
+                # Blast radius = this pool's in-flight streams (the
+                # real scope of a device fault mid-step); the worker
+                # and the other pools stay healthy.
+                logger.error("decode step failed %s", kv(
+                    model=self.name, pool=f"{key}", error=str(exc),
+                ))
+                for slot, stream in enumerate(pool.streams):
+                    if stream is not None:
+                        pool.release(slot)
+                        self._finish(
+                            stream, error=f"decode step failed: {exc}"
+                        )
+
+    def _step_pool(self, pool: PagePool) -> None:
+        import jax.numpy as jnp
+
+        from learningorchestra_tpu.obs import costs as obs_costs
+
+        step, _ = self._step_for(pool.nslots, pool.kv)
+        live = np.array(
+            [s is not None for s in pool.streams], bool
+        )
+        t0s = np.array(
+            [s.t0 if s is not None else pool.kv + 1
+             for s in pool.streams],
+            np.int32,
+        )
+        eager = any(
+            s is not None and s.eager for s in pool.streams
+        )
+        # ``pool.pos`` is host state mutated in place right after this
+        # dispatch; jax's CPU backend may alias numpy buffers
+        # zero-copy, so a lazily-executed step would read positions
+        # from the FUTURE once the host loop runs ahead of the device
+        # (e.g. behind a bucket-grow compile).  Snapshot per dispatch —
+        # ``t0s``/``live`` above are already fresh per-call arrays.
+        pos_now = pool.pos.copy()
+        t_start = time.perf_counter()
+        pool.cache, pool.buf, col = step(
+            self._params_for(pool), pool.cache, pool.buf,
+            jnp.asarray(pos_now), jnp.asarray(t0s),
+            jnp.asarray(live),
+        )
+        pool.steps += 1
+        self.steps += 1
+        col_host = None
+        if eager or pool.steps % _SYNC_STRIDE == 0:
+            # SSE wants the token NOW; lazy pools sync on a stride so
+            # async dispatch pipelines the loop like the solo scan.
+            col_host = np.asarray(col)
+        now = time.perf_counter()
+        if eager and obs_costs.enabled():
+            led = obs_costs.devtime()
+            weight = led.will_record(self.name)
+            if weight:
+                led.record_model(
+                    weight, now - t_start, None, None,
+                    self.name, f"dec{pool.nslots}x{pool.kv}",
+                )
+        for slot, stream in enumerate(pool.streams):
+            if stream is None:
+                continue
+            nxt_pos = int(pool.pos[slot]) + 1
+            pool.pos[slot] = nxt_pos
+            if nxt_pos >= stream.t0 and col_host is not None \
+                    and stream.eager:
+                self._emit(stream, int(col_host[slot]), nxt_pos, now)
+            if nxt_pos >= stream.total - 1:
+                # Terminal: the full row (prompt + continuation) is in
+                # the buffer; lazy streams surface everything here.
+                row = np.asarray(pool.buf[slot])
+                if not stream.eager:
+                    stream.tokens = [
+                        int(t) for t in row[stream.t0: stream.total]
+                    ]
+                    stream.first_at = stream.first_at or now
+                    _decode_hists.ttft(
+                        stream.first_at - stream.arrived, self.name
+                    )
+                    _decode_hists.tokens(
+                        len(stream.tokens), self.name
+                    )
+                pool.release(slot)
+                self._finish(stream, row=row)
+
+    def _emit(self, stream: DecodeStream, tok: int, pos: int,
+              now: float) -> None:
+        if stream.first_at is None:
+            stream.first_at = now
+            _decode_hists.ttft(now - stream.arrived, self.name)
+        else:
+            _decode_hists.itl(now - stream.last_at, self.name)
+        stream.last_at = now
+        stream.push_token(tok, pos)
+        _decode_hists.tokens(1, self.name)
+
+    def _finish(self, stream: DecodeStream, *, row=None,
+                error: str | None = None,
+                aborted: bool = False) -> None:
+        if error is not None:
+            stream.fail(error)
+        elif aborted:
+            stream.mark_aborted()
+        else:
+            stream.finish()
+        with self._cv:
+            self._streams.pop(stream.stream_id, None)
+
+    # -- lifecycle / observability -------------------------------------------
+
+    def warm_replica(self, replica, entry) -> None:
+        """Run one dummy step per recorded (S, Tk) cell against the
+        replica's placed params — pays the per-device executable
+        load/compile before the router may pick the replica (the
+        decode leg of PR-16 replica pre-warm)."""
+        import jax.numpy as jnp
+
+        for (nslots, kvlen) in sorted(entry.decode_warm):
+            step, cache_shapes = self._step_for(nslots, kvlen)
+            pool = PagePool(kvlen, nslots, replica_idx=replica.idx)
+            pool._alloc(cache_shapes, nslots)
+            params, _ = replica.place(
+                entry, np.zeros((1, 1), np.int32)
+            )
+            step(
+                params, pool.cache, pool.buf,
+                jnp.zeros(nslots, jnp.int32),
+                jnp.full(nslots, kvlen + 1, jnp.int32),
+                jnp.zeros(nslots, bool),
+            )
+
+    def stats(self) -> dict:
+        with self._cv:
+            pending = len(self._pending)
+            active = len(self._streams)
+        pools = [
+            {
+                "kv": pool.kv,
+                "slots": pool.nslots,
+                "live": pool.live,
+                "steps": pool.steps,
+                "pageBytes": pool.page_bytes(),
+                "replica": pool.replica_idx,
+            }
+            for pool in self._pools.values()
+        ]
+        return {
+            "activeStreams": active,
+            "pending": pending,
+            "steps": self.steps,
+            "pools": pools,
+        }
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            thread = self._thread
+            self._cv.notify_all()
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=10.0)
+        with self._cv:
+            pending = list(self._pending)
+            self._pending.clear()
+            self._streams.clear()
+            pools = list(self._pools.values())
+            self._pools.clear()
+            self._step_state.clear()
+        for stream in pending:
+            stream.fail("decode engine shut down")
+        for pool in pools:
+            for slot, stream in enumerate(pool.streams):
+                if stream is not None:
+                    pool.release(slot)
+                    stream.fail("decode engine shut down")
+
+
+class DecodeEngine:
+    """Facade the serving service owns: per-model decoders, request
+    validation, the stream/non-stream transports."""
+
+    def __init__(self, service):
+        self.service = service
+        self.cfg = service.ctx.config.decode
+        self._lock = make_lock("DecodeEngine._lock")
+        self._decoders: dict[str, _ModelDecoder] = {}
+        self._closed = False
+
+    # -- request surface -----------------------------------------------------
+
+    def _decoder_for(self, name: str) -> _ModelDecoder:
+        with self._lock:
+            if self._closed:
+                raise ServeError("decode engine is shut down")
+            decoder = self._decoders.get(name)
+            if decoder is None:
+                decoder = self._decoders[name] = _ModelDecoder(
+                    self, name
+                )
+            return decoder
+
+    @staticmethod
+    def _as_prompt_rows(prompts) -> list[np.ndarray]:
+        """Request JSON → per-stream prompt rows.  Rows may be RAGGED
+        (each stream carries its own t0 — continuous batching decodes
+        them independently); pad id 0 is reserved."""
+        if isinstance(prompts, np.ndarray):
+            prompts = prompts.tolist()
+        if not isinstance(prompts, (list, tuple)) or not prompts:
+            raise ServeError("'prompts' must be a non-empty array")
+        if not isinstance(prompts[0], (list, tuple, np.ndarray)):
+            prompts = [prompts]
+        rows = []
+        for row in prompts:
+            try:
+                r = np.asarray(row, dtype=np.int32)
+            except (ValueError, TypeError) as exc:
+                raise ServeError(
+                    f"prompt row is not an int array: {exc}"
+                ) from None
+            if r.ndim != 1 or r.shape[0] == 0:
+                raise ServeError(
+                    "each prompt must be a non-empty 1-D token array"
+                )
+            if (r == 0).any():
+                raise ServeError(
+                    "prompts must not contain pad id 0"
+                )
+            rows.append(r)
+        return rows
+
+    def _open_stream(self, name: str, decoder: _ModelDecoder,
+                     prompt: np.ndarray, max_new: int, max_len: int,
+                     *, eager: bool) -> DecodeStream:
+        t0 = int(prompt.shape[0])
+        cap = min(max_len, self.cfg.max_kv)
+        if t0 >= cap:
+            raise ServeError(
+                f"prompt length {t0} exceeds decode capacity {cap} "
+                f"(model max_len / LO_TPU_DECODE_MAX_KV)"
+            )
+        max_new = max(1, min(int(max_new), self.cfg.max_new_tokens))
+        total = min(cap, t0 + max_new)
+        stream = DecodeStream(name, prompt, t0, total, eager=eager)
+        decoder.submit(stream)
+        return stream
+
+    def generate(self, name: str, prompts, *,
+                 max_new_tokens: int = 32, stream: bool = False,
+                 temperature=None, top_k=None, top_p=None,
+                 seed: int = 0):
+        """Entry point behind ``POST /serve/<model>/generate``.
+
+        Greedy decodes run on the resident engine (stream or not);
+        sampling parameters fall back to the solo jitted scan
+        (non-stream only — a sampled decode has no per-step identity
+        to stream against the engine's greedy executables)."""
+        entry = self.service.registry.get(name)
+        estimator = entry.estimator
+        if not hasattr(estimator, "generate"):
+            raise ServeError(
+                f"artifact {name!r} ({type(estimator).__name__}) is "
+                "not a generative LM; only GreedyDecodeMixin models "
+                "can serve /generate"
+            )
+        sampling = (
+            temperature is not None or top_k is not None
+            or top_p is not None
+        )
+        rows = self._as_prompt_rows(prompts)
+        if sampling or not self.cfg.enabled:
+            if stream:
+                raise ServeError(
+                    "streaming decode requires the resident engine "
+                    "(greedy only, LO_TPU_DECODE_ENABLED=1); drop the "
+                    "sampling parameters or set stream=false"
+                )
+            return self._solo_generate(
+                name, entry, rows, max_new_tokens,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                seed=seed,
+            )
+        if stream and len(rows) != 1:
+            raise ServeError(
+                "stream=true serves exactly one prompt per request"
+            )
+        decoder = self._decoder_for(name)
+        max_len = int(getattr(estimator, "max_len", self.cfg.max_kv))
+        streams = [
+            self._open_stream(
+                name, decoder, row, max_new_tokens, max_len,
+                eager=stream,
+            )
+            for row in rows
+        ]
+        entry.requests += 1
+        if stream:
+            return streams[0]
+        t0 = time.perf_counter()
+        for s in streams:
+            remaining = _NONSTREAM_TIMEOUT_S - (
+                time.perf_counter() - t0
+            )
+            if not s.wait_done(max(0.1, remaining)):
+                for other in streams:
+                    other.abort("decode timed out")
+                raise ServeError("decode timed out")
+        failed = [s for s in streams if s.error is not None]
+        if failed:
+            raise ServeError(failed[0].error)
+        aborted = [
+            s for s in streams
+            if s.token.cancelled() and s.error is None
+        ]
+        if aborted:
+            raise ServeError(
+                f"decode aborted: {aborted[0].token.reason}"
+            )
+        return {
+            "model": name,
+            "tokens": [
+                s.prompt.tolist() + s.tokens for s in streams
+            ],
+            "newTokens": [s.tokens for s in streams],
+            "streams": [s.summary() for s in streams],
+        }
+
+    def _solo_generate(self, name, entry, rows, max_new_tokens, *,
+                       temperature, top_k, top_p, seed):
+        """Per-shape solo scan fallback (sampling / engine disabled):
+        one call per distinct prompt length so ragged rows stay legal."""
+        out_tokens: list[list[int]] = []
+        for row in rows:
+            try:
+                buf = entry.estimator.generate(
+                    row[None, :], max_new_tokens=int(max_new_tokens),
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    seed=int(seed),
+                )
+            except ValueError as exc:
+                # Bad sampling spec (top_k without temperature, ...)
+                # is a client error, not a server fault → 406.
+                raise ServeError(str(exc)) from None
+            out_tokens.append(np.asarray(buf)[0].tolist())
+        entry.requests += 1
+        return {
+            "model": name,
+            "tokens": out_tokens,
+            "newTokens": [
+                t[rows[i].shape[0]:] for i, t in enumerate(out_tokens)
+            ],
+            "sampled": temperature is not None,
+        }
+
+    def abort(self, name: str, stream_id: str,
+              reason: str = "aborted by client") -> bool:
+        with self._lock:
+            decoder = self._decoders.get(name)
+        if decoder is None:
+            return False
+        return decoder.abort(stream_id, reason)
+
+    # -- fleet / lifecycle ---------------------------------------------------
+
+    def warm_replica(self, name: str, replica) -> None:
+        """Decode leg of replica pre-warm: replay every recorded
+        (slot-bucket, kv-bucket) step against the new replica's
+        placed params.  Failures are the caller's to log — a replica
+        that can't warm still serves cold."""
+        entry = self.service.registry.peek(name)
+        if entry is None or not entry.decode_warm:
+            return
+        self._decoder_for(name).warm_replica(replica, entry)
+
+    def drop_model(self, name: str) -> None:
+        with self._lock:
+            decoder = self._decoders.pop(name, None)
+        if decoder is not None:
+            decoder.close()
+
+    def stats(self) -> dict:
+        with self._lock:
+            decoders = dict(self._decoders)
+        return {
+            "enabled": bool(self.cfg.enabled),
+            "models": {
+                name: d.stats() for name, d in decoders.items()
+            },
+        }
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            decoders = list(self._decoders.values())
+            self._decoders.clear()
+        for decoder in decoders:
+            decoder.close()

@@ -1,0 +1,204 @@
+"""KV page pools — resident decode state, bucketed on both axes.
+
+One pool per (model, routed replica, KV-length bucket): a batch of S
+decode *slots* over a KV cache of Tk *pages* per slot.  Both S and Tk
+are power-of-two buckets (``serve/bucketing.py`` discipline), so a
+whole deployment runs at most ``log2(max_slots)+1`` ×
+``log2(max_kv)+1`` step executables per architecture — the small fixed
+hot set the pjit serving papers converge on — and every one resolves
+through the cross-job compile cache
+(:mod:`~learningorchestra_tpu.train.compile_cache`), so fingerprints,
+hit/miss stats and AOT eligibility all apply.
+
+The continuous-batching trick is the per-row ``cache_index``: the
+attention decode branch (ops/layers.py) accepts a (S,)-shaped index,
+so slots sit at DIFFERENT sequence positions inside one jitted step —
+a newly admitted prompt starts its one-token-per-step prefill in the
+same dispatch that extends its neighbours.  Freed slots are simply
+zeroed in the token buffer: an all-pad row masks to an exact-zero
+attention output (the masked-softmax double-where), so stale KV pages
+cost nothing and need no scrubbing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def set_index(cache, pos):
+    """Rebind every ``cache_index`` leaf of a decode cache tree to the
+    per-slot position vector ``pos`` (S,) — the step's single source of
+    truth for where each slot writes and how far it may attend."""
+    out = {}
+    for key, val in cache.items():
+        if isinstance(val, dict):
+            out[key] = set_index(val, pos)
+        elif key == "cache_index":
+            out[key] = pos
+        else:
+            out[key] = val
+    return out
+
+
+def build_step(module, nslots: int, kv: int):
+    """(jitted step fn, cache shape tree) for one (arch, S, Tk) cell.
+
+    The step replicates the solo ``GreedyDecodeMixin.generate`` scan
+    body exactly — same token gather, same key mask, same f32 argmax,
+    same write-at-``pos+1`` — but with per-slot positions, so a slot
+    admitted mid-flight produces bit-identical tokens to a solo decode
+    of the same prompt (greedy only; sampling stays on the solo path).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    decode_mod = module.clone(decode=True)
+    cache_shapes = jax.eval_shape(
+        decode_mod.init, jax.random.PRNGKey(0),
+        jnp.zeros((nslots, kv), jnp.int32),
+    )["cache"]
+
+    def step(variables, cache, buf, pos, t0s, live):
+        cache = set_index(cache, pos)
+        tok = jnp.take_along_axis(buf, pos[:, None], axis=1)
+        kmask = (jnp.arange(kv)[None, :] <= pos[:, None]) & (buf != 0)
+        logits, mut = decode_mod.apply(
+            {**variables, "cache": cache}, tok,
+            positions=pos[:, None], key_mask=kmask,
+            mutable=["cache"],
+        )
+        step_logits = logits[:, 0].astype(jnp.float32)
+        nxt = jnp.argmax(step_logits, -1).astype(jnp.int32)
+        nxt_pos = pos + 1
+        prev = jnp.take_along_axis(buf, nxt_pos[:, None], axis=1)[:, 0]
+        # ``live`` gates the write: a free slot's buffer row stays
+        # all-pad (its attention mask stays empty), and a slot still
+        # prefilling copies the NEXT prompt token instead of the
+        # model's prediction — identical to the solo scan's
+        # ``i + 1 >= t0`` select.
+        col = jnp.where(live & (nxt_pos >= t0s), nxt, prev)
+        buf = buf.at[jnp.arange(nslots), nxt_pos].set(col)
+        return mut["cache"], buf, col
+
+    return jax.jit(step), cache_shapes
+
+
+class PagePool:
+    """S slots × Tk KV pages of resident decode state for one model.
+
+    Only the owning model's decode worker thread touches a pool, so the
+    pool itself is lock-free; the worker's condition variable is the
+    synchronization point for admission and abort.
+    """
+
+    __slots__ = ("kv", "nslots", "max_slots", "cache", "buf", "pos",
+                 "streams", "steps", "replica_idx")
+
+    def __init__(self, kv: int, max_slots: int,
+                 replica_idx: int | None = None):
+        self.kv = int(kv)
+        self.nslots = 0
+        self.max_slots = int(max_slots)
+        self.cache = None  # device tree, allocated on first admit
+        self.buf = None    # (S, Tk) int32 token buffer
+        self.pos = np.zeros(0, np.int32)
+        self.streams: list = []
+        self.steps = 0
+        self.replica_idx = replica_idx
+
+    # -- capacity ------------------------------------------------------------
+
+    @property
+    def live(self) -> int:
+        return sum(1 for s in self.streams if s is not None)
+
+    def page_bytes(self) -> int:
+        """Resident KV bytes — observability for the freeing tests."""
+        import jax
+
+        if self.cache is None:
+            return 0
+        return sum(
+            leaf.nbytes for leaf in jax.tree_util.tree_leaves(self.cache)
+        )
+
+    def _alloc(self, cache_shapes, nslots: int) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        def leaf(s):
+            if s.ndim == 0:
+                # cache_index: scalar in the shape probe, per-slot
+                # vector in the pool (the batched decode branch).
+                return jnp.zeros((nslots,), jnp.int32)
+            return jnp.zeros(s.shape, s.dtype)
+
+        self.cache = jax.tree_util.tree_map(leaf, cache_shapes)
+        self.buf = jnp.zeros((nslots, self.kv), jnp.int32)
+        self.pos = np.zeros(nslots, np.int32)
+        self.streams = [None] * nslots
+        self.nslots = nslots
+
+    def _grow(self, cache_shapes, nslots: int) -> None:
+        """Pad every per-slot axis up to the next slot bucket; existing
+        slots keep their pages and positions bit-for-bit."""
+        import jax
+        import jax.numpy as jnp
+
+        extra = nslots - self.nslots
+
+        def pad(leaf):
+            width = [(0, extra)] + [(0, 0)] * (leaf.ndim - 1)
+            return jnp.pad(leaf, width)
+
+        del cache_shapes  # same tree structure; pad in place
+        self.cache = jax.tree_util.tree_map(pad, self.cache)
+        self.buf = jnp.pad(self.buf, [(0, extra), (0, 0)])
+        self.pos = np.concatenate(
+            [self.pos, np.zeros(extra, np.int32)]
+        )
+        self.streams.extend([None] * extra)
+        self.nslots = nslots
+
+    # -- slot lifecycle ------------------------------------------------------
+
+    def admit(self, stream, cache_shapes_for) -> int | None:
+        """Seat ``stream`` in a free slot (growing to the next slot
+        bucket if needed, up to ``max_slots``); None when full.  The
+        slot's buffer row gets the prompt, position 0 — prefill runs
+        through the shared step one token at a time, exactly like the
+        solo scan."""
+        from learningorchestra_tpu.serve.bucketing import bucket_for
+
+        slot = None
+        for i, s in enumerate(self.streams):
+            if s is None:
+                slot = i
+                break
+        if slot is None:
+            if self.nslots >= self.max_slots:
+                return None
+            want = bucket_for(self.nslots + 1, self.max_slots)
+            if self.nslots == 0:
+                self._alloc(cache_shapes_for(want), want)
+            else:
+                self._grow(cache_shapes_for(want), want)
+            slot = next(
+                i for i, s in enumerate(self.streams) if s is None
+            )
+        row = np.zeros(self.kv, np.int32)
+        row[: stream.t0] = stream.prompt
+        self.buf = self.buf.at[slot].set(row)
+        self.pos[slot] = 0
+        self.streams[slot] = stream
+        return slot
+
+    def release(self, slot: int) -> None:
+        """Free the slot and its KV pages: zeroing the buffer row
+        empties the slot's attention mask, so whatever K/V the pages
+        still hold is unreachable — the pages are free for the next
+        admit without a scrub pass."""
+        self.streams[slot] = None
+        self.pos[slot] = 0
+        if self.buf is not None:
+            self.buf = self.buf.at[slot].set(0)

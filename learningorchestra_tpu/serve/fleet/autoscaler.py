@@ -18,7 +18,12 @@ idle — see ``ReplicaSet.signals``.)  The decisions:
   model's queue depth GROWS faster than ``LO_TPU_FLEET_UP_SLOPE``
   rows/second, least-squares-fitted over the shared rollup series
   (``lo_serving_model_queue_depth``, obs/rollup.py) so a ramp scales
-  BEFORE the level crosses the queue-frac threshold;
+  BEFORE the level crosses the queue-frac threshold, or — cost-aware —
+  when the model's DEVICE-TIME fraction since the last tick (decode
+  steps + serving dispatches, the obs/costs attribution ledger)
+  crosses ``LO_TPU_FLEET_UP_DEVICE_FRAC`` (compute-bound decode keeps
+  queues short while pinning the chip; queue depth alone cannot see
+  that saturation);
 - **scale down** after ``LO_TPU_FLEET_DOWN_TICKS`` consecutive
   empty-queue ticks, draining the victim's batcher before its chip
   lease returns to the pool (training jobs queued on the leaser get
@@ -107,11 +112,14 @@ class Autoscaler:
         for name, rs in self._manager.sets_snapshot():
             sig = rs.signals()
             slope = self._queue_slope(name)
+            dev_s = self._device_seconds(name)
+            now_mono = time.monotonic()
             with self._lock:
                 st = self._state.setdefault(
                     name, {"up": 0, "down": 0,
                            "sheds": sig["sheds"],
-                           "requests": sig["requests"]}
+                           "requests": sig["requests"],
+                           "dev_s": dev_s, "dev_t": now_mono}
                 )
                 shed = sig["sheds"] - st["sheds"]
                 st["sheds"] = sig["sheds"]
@@ -119,6 +127,23 @@ class Autoscaler:
                     "requests", sig["requests"]
                 )
                 st["requests"] = sig["requests"]
+                # Cost-aware trigger: fraction of wall time this
+                # model spent ON DEVICE since the last tick (decode
+                # steps + serving dispatches, the obs/costs devtime
+                # ledger).  Near 1.0 means the replica's chip is
+                # compute-bound even if its queue drains between
+                # ticks — the saturation queue depth cannot see.
+                dt = now_mono - st.get("dev_t", now_mono)
+                device_frac = (
+                    (dev_s - st.get("dev_s", dev_s)) / dt
+                    if dt > 0 else 0.0
+                )
+                st["dev_s"] = dev_s
+                st["dev_t"] = now_mono
+                dev_sig = (
+                    self.cfg.up_device_frac > 0
+                    and device_frac >= self.cfg.up_device_frac
+                )
                 # Growth-slope trigger: the queue is RAMPING even if
                 # its level is still under the frac threshold — the
                 # rate-of-change controller the decision ledger's
@@ -140,6 +165,7 @@ class Autoscaler:
                     or (self.cfg.up_p99_ms > 0 and served > 0
                         and sig["p99_ms"] >= self.cfg.up_p99_ms)
                     or slope_sig
+                    or dev_sig
                 )
                 # "Idle" means NO traffic since the last tick, not an
                 # instantaneously empty queue: under steady load the
@@ -186,7 +212,8 @@ class Autoscaler:
                                 self.cfg.up_p99_ms > 0
                                 and sig["p99_ms"]
                                 >= self.cfg.up_p99_ms
-                            ) else "slope"
+                            ) else
+                            "slope" if slope_sig else "devtime"
                         )
                 elif down_sig and n > rs.min_replicas:
                     st["up"] = 0
@@ -223,6 +250,10 @@ class Autoscaler:
                 "queueSlope": (
                     round(slope, 4) if slope is not None else None
                 ),
+                # Device-time fraction since the last tick (decode +
+                # predict attribution) — the cost-aware signal; 0.0
+                # on a model's first evaluation.
+                "deviceFrac": round(device_frac, 4),
                 "upStreak": up_streak,
                 "downStreak": down_streak,
                 "blocked": blocked,
@@ -295,6 +326,18 @@ class Autoscaler:
         except Exception:  # noqa: BLE001
             return None
 
+    def _device_seconds(self, name: str) -> float:
+        """This model's accumulated device-seconds from the obs/costs
+        attribution ledger (decode steps + serving dispatches).  0.0
+        when cost tracking is disabled or errors — the autoscaler
+        must never die on an obs hiccup."""
+        try:
+            from learningorchestra_tpu.obs import costs as obs_costs
+
+            return obs_costs.devtime().model_device_s(name)
+        except Exception:  # noqa: BLE001
+            return 0.0
+
     def forget(self, name: str) -> None:
         """Drop a dissolved model's streak state (manager drop path)."""
         with self._lock:
@@ -312,6 +355,7 @@ class Autoscaler:
                 "upP99Ms": self.cfg.up_p99_ms,
                 "upSlope": self.cfg.up_slope,
                 "slopeWindowS": self.cfg.slope_window_s,
+                "upDeviceFrac": self.cfg.up_device_frac,
                 "ticks": self.ticks,
                 "streaks": {
                     name: {"up": st["up"], "down": st["down"]}

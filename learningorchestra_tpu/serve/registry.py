@@ -34,6 +34,7 @@ class _Resident:
     __slots__ = (
         "name", "estimator", "params", "nbytes", "loaded_at", "requests",
         "apply_fns", "apply_costs", "replica_devices", "warm_shapes",
+        "decode_warm",
     )
 
     def __init__(self, name, estimator, params, nbytes):
@@ -61,6 +62,11 @@ class _Resident:
         # time — the hot bucket set a fresh replica is pre-warmed
         # against before the router may pick it.
         self.warm_shapes: dict = {}
+        # (slot-bucket, kv-bucket) → True for every decode step
+        # executable this model resolved — the decode leg of replica
+        # pre-warm (serve/decode/engine.py); dies with the entry like
+        # warm_shapes, so invalidation never warms a stale arch.
+        self.decode_warm: dict = {}
 
     def to_dict(self) -> dict:
         return {

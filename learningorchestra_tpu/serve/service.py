@@ -97,6 +97,12 @@ class ServingService:
         # read per predict, no thread) until a model's replica bounds
         # allow max > 1.
         self.fleet = FleetManager(self)
+        # Streaming decode (serve/decode/): resident KV page pools +
+        # continuous batching for GreedyDecodeMixin models.  Dormant
+        # (no thread, no pools) until the first /generate.
+        from learningorchestra_tpu.serve.decode import DecodeEngine
+
+        self.decode = DecodeEngine(self)
         self._lock = make_lock("ServingService._lock")
         self._closed = False
         # tfevents snapshot state: a fixed wall_time keeps one stable
@@ -167,6 +173,10 @@ class ServingService:
         model comes back at its configured scale; an explicit unload
         forgets the model entirely."""
         self._drop_batcher(name)
+        # Decode pools hold the stale architecture's KV shapes and
+        # step closures — in-flight streams fail fast, the next
+        # /generate rebuilds against the reloaded artifact.
+        self.decode.drop_model(name)
         self.fleet.drop(name, keep_bounds=keep_bounds)
 
     # -- predict -------------------------------------------------------------
@@ -360,6 +370,9 @@ class ServingService:
             ):
                 dummy = np.zeros(shape, dtype=dtype)
                 self._dispatch(name, dummy, replica=replica)
+            # Decode leg: replay recorded (slot, kv) step executables
+            # so streamed generation never pays a cold replica either.
+            self.decode.warm_replica(name, replica)
 
         return warm
 
@@ -443,6 +456,13 @@ class ServingService:
             "latencyMs": round(dt * 1e3, 3),
         }
 
+    def generate(self, name: str, prompts, **kwargs):
+        """Streaming/batch LM generation — the decode engine's facade
+        (``POST /serve/<model>/generate``).  Returns a dict for
+        non-stream requests, a :class:`~learningorchestra_tpu.serve.
+        decode.DecodeStream` (the SSE payload) for ``stream=True``."""
+        return self.decode.generate(name, prompts, **kwargs)
+
     # -- observability -------------------------------------------------------
 
     def stats(self) -> dict:
@@ -460,6 +480,7 @@ class ServingService:
             "registry": self.registry.stats(),
             "models": per_model,
             "fleet": self.fleet.snapshot(),
+            "decode": self.decode.stats(),
             "config": {
                 "maxBatch": self.cfg.max_batch,
                 "maxQueue": self.cfg.max_queue,
@@ -569,7 +590,10 @@ class ServingService:
             self._closed = True
             batchers = list(self._batchers.values())
             self._batchers.clear()
-        # Fleet first: stops the autoscaler (no scale decisions against
+        # Decode first: in-flight streams get a terminal event before
+        # their replicas/chips go away under them.
+        self.decode.close()
+        # Fleet next: stops the autoscaler (no scale decisions against
         # a closing service), drains replica batchers, releases chips.
         self.fleet.close()
         for batcher in batchers:

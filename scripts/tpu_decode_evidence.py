@@ -1,0 +1,33 @@
+"""On-box streaming-decode evidence: run bench._decode_probe and print
+its JSON — continuous-batching engine throughput vs sequential solo
+decode, mid-flight-admission TTFT, and bit-identity of engine output
+against the solo path.  Short stage (~2-3 min): trains one tiny decoder
+LM, then times best-of-3 on both paths on whatever backend is up, so it
+records the speedup for the SAME box and build the other stages measure.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from bench import _decode_probe  # noqa: E402
+
+
+def main() -> None:
+    result = {"decode": _decode_probe()}
+    speedup = result["decode"]["continuous_batching_speedup"]
+    identical = result["decode"]["bit_identical_to_solo"]
+    # Loud verdict line for the watch log; the JSON is the record.
+    verdict = "OK" if (speedup >= 2.0 and identical) else "REGRESSION"
+    print(
+        f"decode continuous-batching speedup {speedup}x, "
+        f"bit_identical={identical} ({verdict}: need >= 2.0x + identical)",
+        file=sys.stderr, flush=True,
+    )
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

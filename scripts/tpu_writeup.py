@@ -26,6 +26,7 @@ STAGES = [
     ("tpu_flash_evidence", "Flash evidence (sub-minute headline)"),
     ("tpu_obs_evidence", "Observability overhead probe"),
     ("tpu_warmboot_evidence", "Warm-boot probe (AOT cache vs cold trace)"),
+    ("tpu_decode_evidence", "Streaming decode probe (continuous batching vs solo)"),
     ("tpu_recovery_smoke", "Kill-9 recovery drill (journal resume)"),
     ("tpu_quick_evidence", "Quick evidence (headline numbers)"),
     ("tpu_validate_r2", "Round-2 backlog validation"),

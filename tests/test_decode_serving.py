@@ -1,0 +1,522 @@
+"""Streaming LM decode engine (serve/decode/): SSE round-trips over
+live HTTP, continuous-batching bit-identity under mid-flight
+admission, cooperative stream teardown freeing KV pages at a step
+boundary (under an armed ``serve.decode_step`` fault), the decode
+metric families on /metrics.prom, the TTFT SLO objective, the
+cost-aware autoscaler signal, and the client bindings.
+
+The bit-identity invariant is the one everything rests on: a prompt
+admitted into an IN-FLIGHT pool (other rows mid-generation, dead
+slots present) must decode exactly what a solo ``generate`` produces
+— per-row ``cache_index`` + masked attention make padding and
+foreign rows invisible.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+import requests
+
+from learningorchestra_tpu import faults
+from learningorchestra_tpu.obs import metrics as obs_metrics
+from learningorchestra_tpu.obs import rollup as obs_rollup
+from learningorchestra_tpu.obs import slo as obs_slo
+from tests.lm_oracle import naive_greedy_decode
+
+PREFIX = "/api/learningOrchestra/v1"
+
+
+def _install_trained_lm(server, name, *, vocab=16, hidden=32,
+                        layers=2, heads=4, max_len=16):
+    """Finished train artifact holding a fitted tiny DecoderLM (the
+    decode path is under test, not training quality)."""
+    from learningorchestra_tpu.models.text import DecoderLM
+
+    rng = np.random.default_rng(7)
+    x = rng.integers(1, vocab, size=(16, max_len - 2)).astype(np.int32)
+    y = np.concatenate(
+        [x[:, 1:], np.zeros((16, 1), np.int32)], axis=1
+    )
+    est = DecoderLM(
+        vocab_size=vocab, hidden_dim=hidden, num_layers=layers,
+        num_heads=heads, max_len=max_len, seed=0,
+    )
+    est.compute_dtype = "float32"
+    est.fit(x, y, epochs=2, batch_size=16)
+    server.ctx.volumes.save_object("train/tensorflow", name, est)
+    server.ctx.artifacts.metadata.create(name, "train/tensorflow")
+    server.ctx.artifacts.metadata.mark_finished(name)
+    return est
+
+
+@pytest.fixture(scope="module")
+def decode_api(tmp_path_factory):
+    from learningorchestra_tpu.api import APIServer
+    from learningorchestra_tpu.config import Config
+
+    tmp = tmp_path_factory.mktemp("decode_api")
+    cfg = Config()
+    cfg.store.root = str(tmp / "store")
+    cfg.store.volume_root = str(tmp / "volumes")
+    server = APIServer(cfg)
+    port = server.start_background()
+    base = f"http://127.0.0.1:{port}{PREFIX}"
+    est = _install_trained_lm(server, "lm_srv")
+    yield server, base, est
+    server.shutdown()
+
+
+def _parse_sse(resp):
+    """[(event, data-json)] from a requests streaming response."""
+    import json as _json
+
+    events, event, data = [], None, []
+    for raw in resp.iter_lines():
+        line = raw.decode() if isinstance(raw, bytes) else raw
+        if line:
+            if line.startswith("event:"):
+                event = line[len("event:"):].strip()
+            elif line.startswith("data:"):
+                data.append(line[len("data:"):].strip())
+            continue
+        if event is None and not data:
+            continue
+        events.append((event, _json.loads("\n".join(data) or "{}")))
+        event, data = None, []
+    return events
+
+
+class TestSSERoundTrip:
+    def test_stream_matches_solo_generate(self, decode_api):
+        server, base, est = decode_api
+        prompt = [5, 1, 2, 9]
+        solo = np.asarray(est.generate(
+            np.asarray([prompt], np.int32), max_new_tokens=8
+        ))[0].tolist()
+
+        resp = requests.post(
+            f"{base}/serve/lm_srv/generate",
+            json={"prompts": [prompt], "stream": True,
+                  "maxNewTokens": 8},
+            stream=True, timeout=60,
+        )
+        assert resp.status_code == 200, resp.text
+        assert resp.headers["Content-Type"].startswith(
+            "text/event-stream"
+        )
+        events = _parse_sse(resp)
+        names = [e for e, _ in events]
+        assert names[0] == "open"
+        assert names[-1] == "done"
+        toks = [doc["t"] for e, doc in events if e == "token"]
+        assert prompt + toks == solo
+        # The done summary carries the lifecycle accounting.
+        done = events[-1][1]
+        assert done["promptTokens"] == len(prompt)
+        assert done["newTokens"] == 8
+        assert done["ttftMs"] is not None
+
+    def test_nonstream_json_matches_and_is_batched(self, decode_api):
+        server, base, est = decode_api
+        prompts = [[5, 1, 2, 9], [3, 3, 7, 1]]
+        resp = requests.post(
+            f"{base}/serve/lm_srv/generate",
+            json={"prompts": prompts, "maxNewTokens": 8},
+            timeout=60,
+        )
+        assert resp.status_code == 200, resp.text
+        body = resp.json()
+        oracle = naive_greedy_decode(est, prompts, 12)
+        assert body["tokens"] == oracle.tolist()
+        # Both rows decoded through ONE shared pool (continuous
+        # batching), not two solo calls.
+        stats = server.serving.decode.stats()["models"]["lm_srv"]
+        assert stats["pools"], "no KV page pool was created"
+
+    def test_decode_warm_shapes_recorded_for_prewarm(self, decode_api):
+        server, _, _ = decode_api
+        entry = server.serving.registry.peek("lm_srv")
+        assert entry is not None and entry.decode_warm, (
+            "decode step shapes must be recorded for replica pre-warm"
+        )
+        for slots, kvlen in entry.decode_warm:
+            assert slots & (slots - 1) == 0  # power-of-two bucketed
+            assert kvlen & (kvlen - 1) == 0
+
+    def test_validation_errors_are_406(self, decode_api):
+        _, base, _ = decode_api
+        # Pad id in prompt.
+        resp = requests.post(
+            f"{base}/serve/lm_srv/generate",
+            json={"prompts": [[0, 1]], "maxNewTokens": 2}, timeout=30,
+        )
+        assert resp.status_code == 406
+        # Prompt at/over capacity (model max_len 16).
+        resp = requests.post(
+            f"{base}/serve/lm_srv/generate",
+            json={"prompts": [list(range(1, 17))], "maxNewTokens": 2},
+            timeout=30,
+        )
+        assert resp.status_code == 406
+        # Bad sampling spec falls through the solo path as 406 too.
+        resp = requests.post(
+            f"{base}/serve/lm_srv/generate",
+            json={"prompts": [[1, 2]], "topK": 3, "maxNewTokens": 2},
+            timeout=30,
+        )
+        assert resp.status_code == 406
+
+
+class TestContinuousBatching:
+    def test_midflight_admission_is_bit_identical(self, decode_api):
+        """A prompt admitted while another stream is mid-generation
+        (same kv bucket → same pool, live foreign row + dead slots)
+        decodes exactly the solo result."""
+        server, _, est = decode_api
+        eng = server.serving.decode
+        try:
+            # Slow the steps (timing only — a delay fault cannot
+            # perturb the math) so A is reliably still mid-flight
+            # when B joins; an unthrottled eager stream finishes in
+            # ~20ms, a losable race under load.
+            faults.arm(
+                "serve.decode_step", "delay", delay_ms=50,
+                max_triggers=256,
+            )
+            # Stream A: long generation holding the kv=16 pool open.
+            a = eng.generate(
+                "lm_srv", [7, 2, 4, 1], max_new_tokens=12, stream=True
+            )
+            # Wait until A is genuinely mid-flight (some tokens out,
+            # generation not finished).
+            deadline = time.monotonic() + 30
+            while len(a.tokens) < 2 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert 0 < len(a.tokens) < 12, "stream A not mid-flight"
+            # B admitted into the in-flight pool: t0=8, max_new=8 →
+            # total 16, the same kv bucket as A.
+            prompt_b = [3, 9, 1, 5, 2, 8, 4, 6]
+            out = eng.generate("lm_srv", [prompt_b], max_new_tokens=8)
+        finally:
+            faults.reset()
+        solo = np.asarray(est.generate(
+            np.asarray([prompt_b], np.int32), max_new_tokens=8
+        ))[0].tolist()
+        assert out["tokens"][0] == solo
+        a.wait_done(30)
+        # A was not perturbed either.
+        solo_a = np.asarray(est.generate(
+            np.asarray([[7, 2, 4, 1]], np.int32), max_new_tokens=12
+        ))[0].tolist()
+        assert [7, 2, 4, 1] + a.tokens == solo_a
+
+    def test_concurrent_streams_share_one_pool(self, decode_api):
+        server, base, est = decode_api
+        eng = server.serving.decode
+        prompts = [[1, 2, 3, 4], [9, 8, 7, 6], [2, 2, 4, 4]]
+        results = [None] * len(prompts)
+
+        def _one(i):
+            results[i] = eng.generate(
+                "lm_srv", [prompts[i]], max_new_tokens=8
+            )
+
+        threads = [
+            threading.Thread(target=_one, args=(i,))
+            for i in range(len(prompts))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        oracle = naive_greedy_decode(est, prompts, 12)
+        for i, res in enumerate(results):
+            assert res is not None
+            assert res["tokens"][0] == oracle[i].tolist()
+
+
+class TestStreamTeardown:
+    def test_abort_frees_kv_within_one_step(self, decode_api):
+        """Cancel mid-stream under an armed ``serve.decode_step``
+        delay: the slot is swept (abort sweep runs BEFORE the fault
+        point) and freed within at most one further decode step."""
+        server, _, _ = decode_api
+        eng = server.serving.decode
+        try:
+            # Slow every step from the START so the stream cannot race
+            # to completion between first-token and the abort below.
+            faults.arm(
+                "serve.decode_step", "delay", delay_ms=150,
+                max_triggers=64,
+            )
+            stream = eng.generate(
+                "lm_srv", [4, 4, 2, 1], max_new_tokens=12,
+                stream=True,
+            )
+            deadline = time.monotonic() + 30
+            while not stream.tokens and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert stream.tokens, "stream never produced a token"
+            st = eng.stats()["models"]["lm_srv"]
+            steps_at_abort = st["steps"]
+            assert eng.abort("lm_srv", stream.stream_id)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                st = eng.stats()["models"]["lm_srv"]
+                if st["activeStreams"] == 0 and all(
+                    p["live"] == 0 for p in st["pools"]
+                ):
+                    break
+                time.sleep(0.01)
+            assert st["activeStreams"] == 0
+            assert all(p["live"] == 0 for p in st["pools"])
+            assert st["steps"] - steps_at_abort <= 1, (
+                "KV pages must be freed at the next step boundary"
+            )
+            assert stream.done()
+            assert stream.token.cancelled()
+        finally:
+            faults.reset()
+
+    def test_delete_route_aborts_then_404(self, decode_api):
+        server, base, _ = decode_api
+        eng = server.serving.decode
+        try:
+            # Keep the stream alive until the DELETE lands.
+            faults.arm(
+                "serve.decode_step", "delay", delay_ms=150,
+                max_triggers=64,
+            )
+            stream = eng.generate(
+                "lm_srv", [6, 1, 3, 2], max_new_tokens=12,
+                stream=True,
+            )
+            resp = requests.delete(
+                f"{base}/serve/lm_srv/generate/{stream.stream_id}",
+                timeout=30,
+            )
+            assert resp.status_code == 200, resp.text
+            assert resp.json()["aborted"] == stream.stream_id
+            assert stream.wait_done(10)
+        finally:
+            faults.reset()
+        resp = requests.delete(
+            f"{base}/serve/lm_srv/generate/{stream.stream_id}",
+            timeout=30,
+        )
+        assert resp.status_code == 404
+
+
+class TestDecodeObservability:
+    def test_ttft_itl_families_on_prom(self, decode_api):
+        _, base, _ = decode_api
+        requests.post(
+            f"{base}/serve/lm_srv/generate",
+            json={"prompts": [[5, 5, 5]], "maxNewTokens": 4},
+            timeout=60,
+        )
+        text = requests.get(f"{base}/metrics.prom", timeout=30).text
+        for family in (
+            "lo_serving_decode_ttft_seconds",
+            "lo_serving_decode_itl_seconds",
+            "lo_serving_decode_tokens_total",
+        ):
+            assert family in text, f"{family} missing from exposition"
+        assert 'model="lm_srv"' in text
+
+    def test_devtime_ledger_attributes_decode(self, decode_api):
+        from learningorchestra_tpu.obs import costs as obs_costs
+
+        _, base, _ = decode_api
+        before = obs_costs.devtime().model_device_s("lm_srv")
+        resp = requests.post(
+            f"{base}/serve/lm_srv/generate",
+            json={"prompts": [[1, 2, 3]], "stream": True,
+                  "maxNewTokens": 6},
+            stream=True, timeout=60,
+        )
+        _parse_sse(resp)  # drain: eager streams attribute per step
+        after = obs_costs.devtime().model_device_s("lm_srv")
+        assert after > before
+
+
+class TestDecodeSLO:
+    def test_ttft_objective_fires_on_slow_decode(self):
+        """The decode-TTFT objective drives the same burn-rate
+        machinery as predict latency: all-over-threshold TTFT
+        observations push the burn over the threshold and the alert
+        fires; a healthy model stays inactive."""
+        from learningorchestra_tpu.config import (
+            RollupConfig, SLOConfig,
+        )
+
+        obs_metrics.reset_registry()
+        try:
+            engine = obs_rollup.reset_engine(RollupConfig(tick_s=0.0))
+            service = obs_slo.reset_service(SLOConfig(
+                availability_target=0.0, predict_p99_ms=0.0,
+                job_success_target=0.0, decode_ttft_ms=50.0,
+                decode_ttft_target=0.9, for_s=0.0, resolve_s=5.0,
+                fast_window_s=30.0, slow_window_s=60.0,
+                burn_threshold=5.0,
+            ))
+            assert [o.name for o in service.objectives] == [
+                "decode-ttft"
+            ]
+            reg = obs_metrics.get_registry()
+            hist = reg.histogram(
+                "lo_serving_decode_ttft_seconds", "t",
+                labels=("model",),
+            )
+            engine.tick(now=0.0)
+            for _ in range(20):
+                hist.observe(0.5, model="slow_lm")   # 10x threshold
+                hist.observe(0.001, model="fast_lm")  # well under
+            engine.tick(now=1.0)
+            states = {
+                (st["slo"], st["instance"]): st["state"]
+                for st in service.alerts()["alerts"]
+            }
+            assert states[("decode-ttft", "slow_lm")] == "firing"
+            assert states[("decode-ttft", "fast_lm")] == "inactive"
+        finally:
+            obs_rollup.reset_engine()
+            obs_slo.reset_service()
+            obs_metrics.reset_registry()
+
+
+class TestCostAwareAutoscaling:
+    def test_devtime_signal_scales_up_and_ledger_records_frac(self):
+        """Device-time fraction over LO_TPU_FLEET_UP_DEVICE_FRAC
+        counts as saturation even with empty queues, and every
+        decision-ledger entry carries the fraction it read."""
+        from learningorchestra_tpu.config import FleetConfig
+        from learningorchestra_tpu.obs import costs as obs_costs
+        from learningorchestra_tpu.serve.fleet.autoscaler import (
+            Autoscaler,
+        )
+
+        class _Sig:
+            name = "lm_auto"
+            min_replicas, max_replicas = 1, 3
+            size = 1
+
+            def signals(self):
+                # Queues empty, nothing shed — only devtime saturates.
+                return {
+                    "replicas": self.size, "queue_depth": 0,
+                    "queue_frac": 0.0, "p99_ms": 0.0,
+                    "sheds": 0, "requests": 0,
+                }
+
+        class _Mgr:
+            def __init__(self, rs):
+                self.rs = rs
+
+            def sets_snapshot(self):
+                return [(self.rs.name, self.rs)]
+
+            def scale(self, name, n, *, reason):
+                self.rs.size = n
+                return n
+
+        rs = _Sig()
+        cfg = FleetConfig(
+            interval_s=0.0, up_queue_frac=0.9, up_ticks=1,
+            down_ticks=99, up_device_frac=0.5,
+        )
+        scaler = Autoscaler(_Mgr(rs), cfg)
+        # Tick 1 primes the devtime baseline; fraction present (0.0).
+        assert scaler.tick() == []
+        entry = scaler.status()["ledger"][-1]
+        assert entry["deviceFrac"] == 0.0
+        assert entry["action"] == "hold"
+        # Attribute device time between ticks: frac = 5s / tiny dt
+        # is far over the 0.5 threshold.
+        time.sleep(0.02)
+        obs_costs.devtime().record_model(
+            1, 5.0, None, None, "lm_auto", None
+        )
+        made = scaler.tick()
+        assert made and made[0]["signal"] == "devtime"
+        assert rs.size == 2
+        entry = scaler.status()["ledger"][-1]
+        assert entry["action"] == "up"
+        assert entry["reason"] == "devtime"
+        assert entry["deviceFrac"] > 0.5
+        assert scaler.status()["upDeviceFrac"] == 0.5
+
+
+class TestClientBindings:
+    def test_generate_stream_and_fallback(self, decode_api):
+        from learningorchestra_tpu.client import ClientError, Context
+
+        server, base, est = decode_api
+        port = int(base.split(":")[2].split("/")[0])
+        ctx = Context(f"http://127.0.0.1:{port}")
+        prompt = [2, 7, 1, 4]
+        solo = np.asarray(est.generate(
+            np.asarray([prompt], np.int32), max_new_tokens=6
+        ))[0].tolist()
+        # Non-stream JSON fallback.
+        out = ctx.serve.generate("lm_srv", [prompt], max_new_tokens=6)
+        assert out["tokens"][0] == solo
+        # SSE stream through the line-parser generator.
+        toks, names = [], []
+        for event, doc in ctx.serve.generate(
+            "lm_srv", prompt, stream=True, max_new_tokens=6
+        ):
+            names.append(event)
+            if event == "token":
+                toks.append(doc["t"])
+        assert names[0] == "open" and names[-1] == "done"
+        assert prompt + toks == solo
+        # Abort of an already-finished stream is a clean 404.
+        stream = server.serving.decode.generate(
+            "lm_srv", [prompt], max_new_tokens=2, stream=True
+        )
+        assert stream.wait_done(30)
+        with pytest.raises(ClientError) as exc:
+            ctx.serve.abort_stream("lm_srv", stream.stream_id)
+        assert exc.value.status == 404
+
+
+class TestDecodeCompileCache:
+    def test_solo_decode_programs_shared_cross_instance(self):
+        """Satellite: GreedyDecodeMixin's decode scan resolves through
+        the cross-job CompiledProgramCache — a second estimator of the
+        identical architecture hits instead of re-tracing."""
+        from learningorchestra_tpu.models.text import DecoderLM
+        from learningorchestra_tpu.train import compile_cache as cc
+
+        def _tiny():
+            est = DecoderLM(
+                vocab_size=8, hidden_dim=16, num_layers=1,
+                num_heads=2, max_len=12, seed=0,
+            )
+            est.compute_dtype = "float32"
+            x = np.ones((4, 6), np.int32)
+            y = np.concatenate(
+                [x[:, 1:], np.zeros((4, 1), np.int32)], axis=1
+            )
+            est.fit(x, y, epochs=1, batch_size=4)
+            return est
+
+        a, b = _tiny(), _tiny()
+        cache = cc.get_cache()
+        before = cache.stats()["hits"]
+        a.generate(np.asarray([[1, 2]], np.int32), max_new_tokens=3)
+        labels = [
+            lbl for lbl in cache.stats()["programs"]
+            if lbl and lbl.startswith("decode:")
+        ]
+        assert any("_DecoderLM" in lbl for lbl in labels)
+        # Same estimator again: pure hit.
+        a.generate(np.asarray([[1, 2]], np.int32), max_new_tokens=3)
+        mid = cache.stats()["hits"]
+        assert mid > before
+        # DIFFERENT estimator, identical architecture: cross-job hit.
+        b.generate(np.asarray([[1, 2]], np.int32), max_new_tokens=3)
+        assert cache.stats()["hits"] > mid

@@ -610,6 +610,53 @@ class TestServeRouteChaos:
         assert faults.triggers("serve.route") == 2
 
 
+def _install_trained_lm(server, name):
+    """Finished train artifact holding a fitted tiny DecoderLM —
+    chaos on the decode step is what's under test, not training."""
+    from learningorchestra_tpu.models.text import DecoderLM
+
+    rng = np.random.default_rng(1)
+    x = rng.integers(1, 8, size=(8, 12)).astype(np.int32)
+    y = np.concatenate([x[:, 1:], np.zeros((8, 1), np.int32)], axis=1)
+    est = DecoderLM(vocab_size=8, hidden_dim=16, num_layers=1,
+                    num_heads=2, max_len=16, seed=0)
+    est.compute_dtype = "float32"
+    est.fit(x, y, epochs=1, batch_size=8)
+    server.ctx.volumes.save_object("train/tensorflow", name, est)
+    server.ctx.artifacts.metadata.create(name, "train/tensorflow")
+    server.ctx.artifacts.metadata.mark_finished(name)
+    return est
+
+
+class TestDecodeChaos:
+    """The decode engine's step fault point (serve.decode_step),
+    fired in the worker immediately before each pool step."""
+
+    def test_injected_decode_step_fails_streams_not_worker(
+        self, chaos_api
+    ):
+        server, base, _ = chaos_api
+        _install_trained_lm(server, "chaos_lm")
+        faults.arm("serve.decode_step", "error", max_triggers=1)
+        resp = requests.post(
+            f"{base}/serve/chaos_lm/generate",
+            json={"prompts": [[5, 1, 2]], "maxNewTokens": 4},
+        )
+        # Blast radius = that pool's streams: the request fails with
+        # the injected fault surfaced, 406 (ServeError), not a 500.
+        assert resp.status_code == 406, resp.text
+        assert "injected fault" in resp.json()["error"]
+        # The decode worker survived the poisoned step: the very next
+        # generate serves normally.
+        resp = requests.post(
+            f"{base}/serve/chaos_lm/generate",
+            json={"prompts": [[5, 1, 2]], "maxNewTokens": 4},
+        )
+        assert resp.status_code == 200, resp.text
+        assert len(resp.json()["newTokens"][0]) == 4
+        assert faults.triggers("serve.decode_step") == 1
+
+
 class TestHttpChaos:
     def test_injected_handler_error_then_recovery(self, chaos_api):
         _, base, _ = chaos_api
