@@ -1210,8 +1210,8 @@ class APIServer:
                     "error": str(exc),
                     "retryAfter": self.config.serve.retry_after_s,
                 }
-            if stream:
-                return 200, result  # DecodeStream → SSE writer
+            # stream=true returns the DecodeStream itself; _send
+            # duck-types its sse_events surface into an SSE body.
             return 200, result
 
         add("POST", rf"/serve/{NAME}/generate", serve_generate,

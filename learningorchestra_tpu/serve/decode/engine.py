@@ -197,7 +197,22 @@ class _ModelDecoder:
                 self._pending.clear()
             deferred = []
             for stream in pending:
-                if not self._admit(stream):
+                try:
+                    admitted = self._admit(stream)
+                except Exception as exc:  # noqa: BLE001 — a bug in
+                    # admission (or its error handler) costs ONE
+                    # stream, never the model's worker thread: an
+                    # unfinished stream here would stall every
+                    # in-flight SSE client on a no_timeout route.
+                    logger.error("decode admit raised %s", kv(
+                        model=self.name, stream=stream.stream_id,
+                        error=str(exc),
+                    ))
+                    self._finish(
+                        stream, error=f"admission failed: {exc}"
+                    )
+                    continue
+                if not admitted:
                     deferred.append(stream)
             self._step_all()
             if deferred:
@@ -249,18 +264,18 @@ class _ModelDecoder:
         try:
             replica = self._route_replica()
             ridx = None if replica is None else replica.idx
-            kv = bucket_for(
+            kvlen = bucket_for(
                 stream.total,
                 min(self.cfg.max_kv, self._max_len()),
             )
-            pool = self._pools.get((ridx, kv))
+            pool = self._pools.get((ridx, kvlen))
             if pool is None:
-                pool = self._pools[(ridx, kv)] = PagePool(
-                    kv, self.cfg.max_slots, replica_idx=ridx,
+                pool = self._pools[(ridx, kvlen)] = PagePool(
+                    kvlen, self.cfg.max_slots, replica_idx=ridx,
                 )
             slot = pool.admit(
                 stream,
-                lambda want: self._step_for(want, kv)[1],
+                lambda want: self._step_for(want, kvlen)[1],
             )
         except Exception as exc:  # noqa: BLE001 — fail THIS stream
             logger.error("decode admit failed %s", kv(
@@ -398,14 +413,7 @@ class _ModelDecoder:
             # async dispatch pipelines the loop like the solo scan.
             col_host = np.asarray(col)
         now = time.perf_counter()
-        if eager and obs_costs.enabled():
-            led = obs_costs.devtime()
-            weight = led.will_record(self.name)
-            if weight:
-                led.record_model(
-                    weight, now - t_start, None, None,
-                    self.name, f"dec{pool.nslots}x{pool.kv}",
-                )
+        synced = col_host is not None
         for slot, stream in enumerate(pool.streams):
             if stream is None:
                 continue
@@ -418,6 +426,7 @@ class _ModelDecoder:
                 # Terminal: the full row (prompt + continuation) is in
                 # the buffer; lazy streams surface everything here.
                 row = np.asarray(pool.buf[slot])
+                synced = True
                 if not stream.eager:
                     stream.tokens = [
                         int(t) for t in row[stream.t0: stream.total]
@@ -431,6 +440,24 @@ class _ModelDecoder:
                     )
                 pool.release(slot)
                 self._finish(stream, row=row)
+        # Devtime attribution flushes at every host sync, whichever
+        # transport forced it — eager token read (per step), lazy
+        # stride boundary, or a terminal row read — so non-stream
+        # decode feeds the autoscaler's LO_TPU_FLEET_UP_DEVICE_FRAC
+        # signal too.  Between syncs the async backlog's device work
+        # is paid inside the syncing call, so measuring to HERE (past
+        # the row reads above) captures the stride's full cost as one
+        # amortized sample.
+        pool.pending_devtime += time.perf_counter() - t_start
+        if synced and obs_costs.enabled():
+            led = obs_costs.devtime()
+            weight = led.will_record(self.name)
+            if weight:
+                led.record_model(
+                    weight, pool.pending_devtime, None, None,
+                    self.name, f"dec{pool.nslots}x{pool.kv}",
+                )
+            pool.pending_devtime = 0.0
 
     def _emit(self, stream: DecodeStream, tok: int, pos: int,
               now: float) -> None:
@@ -482,6 +509,9 @@ class _ModelDecoder:
         with self._cv:
             pending = len(self._pending)
             active = len(self._streams)
+            # Snapshot under the cv: the worker clears/inserts pool
+            # entries concurrently (idle parking, admission).
+            pools_snap = list(self._pools.values())
         pools = [
             {
                 "kv": pool.kv,
@@ -491,7 +521,7 @@ class _ModelDecoder:
                 "pageBytes": pool.page_bytes(),
                 "replica": pool.replica_idx,
             }
-            for pool in self._pools.values()
+            for pool in pools_snap
         ]
         return {
             "activeStreams": active,

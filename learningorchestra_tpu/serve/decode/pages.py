@@ -92,7 +92,7 @@ class PagePool:
     """
 
     __slots__ = ("kv", "nslots", "max_slots", "cache", "buf", "pos",
-                 "streams", "steps", "replica_idx")
+                 "streams", "steps", "replica_idx", "pending_devtime")
 
     def __init__(self, kv: int, max_slots: int,
                  replica_idx: int | None = None):
@@ -105,6 +105,11 @@ class PagePool:
         self.streams: list = []
         self.steps = 0
         self.replica_idx = replica_idx
+        # Step wall time not yet flushed to the devtime ledger: lazy
+        # pools dispatch async and only pay the device sync on the
+        # stride boundary, so per-step times are accumulated here and
+        # recorded as one amortized sample at each sync.
+        self.pending_devtime = 0.0
 
     # -- capacity ------------------------------------------------------------
 

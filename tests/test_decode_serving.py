@@ -341,6 +341,24 @@ class TestDecodeObservability:
         after = obs_costs.devtime().model_device_s("lm_srv")
         assert after > before
 
+    def test_devtime_ledger_attributes_lazy_decode(self, decode_api):
+        """Non-stream decode attributes device time too (flushed at
+        the terminal row sync, not only on the eager per-step path) —
+        otherwise bulk /generate load would never trip the
+        autoscaler's LO_TPU_FLEET_UP_DEVICE_FRAC signal."""
+        from learningorchestra_tpu.obs import costs as obs_costs
+
+        _, base, _ = decode_api
+        before = obs_costs.devtime().model_device_s("lm_srv")
+        resp = requests.post(
+            f"{base}/serve/lm_srv/generate",
+            json={"prompts": [[1, 2, 3]], "maxNewTokens": 6},
+            timeout=60,
+        )
+        assert resp.status_code == 200, resp.text
+        after = obs_costs.devtime().model_device_s("lm_srv")
+        assert after > before
+
 
 class TestDecodeSLO:
     def test_ttft_objective_fires_on_slow_decode(self):
