@@ -1183,6 +1183,115 @@ def _slo_probe() -> dict:
     }
 
 
+def _flight_probe() -> dict:
+    """Flight-recorder probe: what the always-on incident timeline
+    costs on the hot path, as tight-loop best-of SUBSYSTEM numbers.
+
+    Three appends measured: DISABLED (the deployed ``record()`` cost
+    when ``LO_TPU_FLIGHT_ENABLED=0`` — one module-global check),
+    ENABLED (dict build + GIL-atomic deque append, the always-on
+    default), and the TRIGGER path (what a hot-path caller pays for
+    ``bundle.trigger`` once the debounce window has it returning
+    immediately — the alert-storm steady state; actual assembly is
+    file IO on its own thread and never rides a request).  The
+    acceptance bound is the enabled append against the same real
+    single-row serving dispatch the costs/SLO probes use: ≤ 1%.
+    """
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from learningorchestra_tpu.config import (
+        BundleConfig,
+        FlightConfig,
+    )
+    from learningorchestra_tpu.obs import bundle as obs_bundle
+    from learningorchestra_tpu.obs import flight as obs_flight
+    from learningorchestra_tpu.serve.batcher import MicroBatcher
+
+    tight = _tight_best_of
+
+    try:
+        # Disabled: the LO_TPU_FLIGHT_ENABLED=0 deployment's cost.
+        obs_flight.reset(FlightConfig(enabled=False))
+        disabled_ns = tight(
+            lambda: obs_flight.record(
+                "http", "request", route="GET /r", status=200,
+            )
+        ) * 1e9
+
+        # Enabled (the default): a full-shape HTTP event into a
+        # warm ring — eviction is in steady state, as deployed.
+        obs_flight.reset(FlightConfig())
+        for _ in range(600):
+            obs_flight.record(
+                "http", "request", route="GET /r", status=200,
+            )
+        enabled_ns = tight(
+            lambda: obs_flight.record(
+                "http", "request", route="GET /r", status=200,
+            )
+        ) * 1e9
+
+        # Trigger path: debounced module-level bundle.trigger — the
+        # per-call cost once an incident already landed its bundle.
+        with tempfile.TemporaryDirectory() as tmp:
+            svc = obs_bundle.reset_service(
+                BundleConfig(dir=tmp, debounce_s=3600.0),
+                providers={},
+            )
+            obs_bundle.trigger("bench")  # lands the first bundle
+            deadline = time.perf_counter() + 10.0
+            while (svc.status()["building"]
+                   and time.perf_counter() < deadline):
+                time.sleep(0.01)  # assembly is on its own thread
+            trigger_ns = tight(
+                lambda: obs_bundle.trigger("bench"), m=2000,
+            ) * 1e9
+            # Drop the singleton BEFORE the tempdir: a late assembly
+            # must not race the directory teardown.
+            obs_bundle.reset_service()
+
+        # Denominator: the same real single-row serving dispatch the
+        # costs/SLO probes use.
+        from learningorchestra_tpu.models.mlp import MLPClassifier
+
+        est = MLPClassifier(hidden_layer_sizes=[128], num_classes=4)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((64, 64)).astype(np.float32)
+        est.fit(x, rng.integers(0, 4, (64,)), epochs=1, batch_size=64)
+        apply = jax.jit(est.module.apply)
+        batcher = MicroBatcher(
+            lambda padded: apply(est.params, jnp.asarray(padded)),
+            max_batch=64, max_queue=256, flush_ms=0.0, name="bench",
+        )
+        row = x[:1]
+        try:
+            batcher.submit(row)  # warm the bucket-1 executable
+            dispatch_us = tight(
+                lambda: batcher.submit(row), m=300, reps=5
+            ) * 1e6
+        finally:
+            batcher.close()
+    finally:
+        obs_flight.reset()
+        obs_bundle.reset_service()
+
+    return {
+        "record_disabled_ns": round(disabled_ns, 1),
+        "record_enabled_ns": round(enabled_ns, 1),
+        "trigger_debounced_ns": round(trigger_ns, 1),
+        "serving_dispatch_us": round(dispatch_us, 2),
+        # The acceptance bound: the always-on enabled append against
+        # one real single-row dispatch.
+        "per_dispatch_share_pct": round(
+            enabled_ns / 1e3 / dispatch_us * 100.0, 3
+        ),
+    }
+
+
 def _decode_probe(
     n_prompts: int = 16,
     max_slots: int = 16,
@@ -1588,6 +1697,10 @@ def _tpu_suite_child_main() -> None:
     except Exception as exc:  # noqa: BLE001 — record, don't hide
         suite["_slo"] = f"FAILED: {exc!r}"
     try:
+        suite["_flight"] = _flight_probe()
+    except Exception as exc:  # noqa: BLE001 — record, don't hide
+        suite["_flight"] = f"FAILED: {exc!r}"
+    try:
         suite["_warmboot"] = _warmboot_probe()
     except Exception as exc:  # noqa: BLE001 — record, don't hide
         suite["_warmboot"] = f"FAILED: {exc!r}"
@@ -1611,6 +1724,7 @@ def main() -> None:
         decode_probe = suite.pop("_decode", None)
         costs_probe = suite.pop("_costs", None)
         slo_probe = suite.pop("_slo", None)
+        flight_probe = suite.pop("_flight", None)
         warmboot_probe = suite.pop("_warmboot", None)
         throughput, extra = _assemble_tpu(suite)
         extra.update(flash)
@@ -1632,6 +1746,8 @@ def main() -> None:
             extra["costs"] = costs_probe
         if slo_probe is not None:
             extra["slo"] = slo_probe
+        if flight_probe is not None:
+            extra["flight"] = flight_probe
         if warmboot_probe is not None:
             extra["warmboot"] = warmboot_probe
     else:

@@ -28,6 +28,11 @@ from learningorchestra_tpu.config import Config, get_config
 from learningorchestra_tpu.jobs.leases import LeaseTimeout
 from learningorchestra_tpu.obs import metrics as obs_metrics
 from learningorchestra_tpu.obs import tracing as obs_tracing
+from learningorchestra_tpu.obs.bundle import (
+    BundleBusy,
+    BundleError,
+    BundleNotFound,
+)
 from learningorchestra_tpu.obs.profiling import (
     ProfilerConflict,
     ProfilerError,
@@ -239,6 +244,28 @@ class APIServer:
         self.rollup = obs_rollup.ensure_engine(self.config.rollup)
         self.slo = obs_slo.ensure_service(self.config.slo)
         self.rollup.start()
+        # Always-on flight recorder + incident debug bundles
+        # (obs/flight.py, obs/bundle.py): the recorder arms at boot
+        # and rides every request/step at a lock-free deque append;
+        # the bundle assembler snapshots rings + every subsystem's
+        # live state whenever an SLO fires, a job dies terminally, a
+        # lock stalls — or an operator POSTs /observability/bundle.
+        from learningorchestra_tpu.obs import bundle as obs_bundle
+        from learningorchestra_tpu.obs import flight as obs_flight
+
+        obs_flight.ensure(self.config.flight)
+        if not self.config.bundle.dir:
+            # Derived default beside the profiler's capture store:
+            # bundles are artifacts of the same volume lifecycle.
+            self.config.bundle.dir = _os.path.join(
+                self.config.store.volume_path(), "_bundles"
+            )
+        self.bundles = obs_bundle.ensure_service(
+            self.config.bundle,
+            providers=self._bundle_providers(),
+            profiler=self.profiler,
+        )
+        self.slo.add_sink(self._slo_bundle_sink)
         # Unified observability (obs/): push metrics for the HTTP
         # layer, pull collectors over every subsystem's existing stats,
         # rendered at GET /metrics.prom.  The legacy JSON endpoints
@@ -297,6 +324,80 @@ class APIServer:
         faults.load_env({
             faults.ENV_PREFIX + suffix: spec
             for suffix, spec in self.config.faults.specs.items()
+        })
+
+    # -- debug bundles --------------------------------------------------------
+
+    def _bundle_providers(self) -> dict:
+        """Content sources for obs/bundle.py, stem → zero-arg callable.
+        Each runs inside the assembler's per-provider try/except: a
+        broken subsystem becomes a manifest error, not a lost bundle."""
+
+        def metrics():
+            from learningorchestra_tpu.obs.metrics import get_registry
+
+            return get_registry().snapshot()
+
+        def rollup():
+            eng = self.rollup
+            series = {}
+            for fam in eng.families:
+                try:
+                    series[fam] = eng.timeseries(fam, max_points=60)
+                except Exception as exc:  # noqa: BLE001 — one family
+                    series[fam] = {"error": repr(exc)}  # at a time
+            return {"status": eng.status(), "series": series}
+
+        def slo():
+            return {
+                "alerts": self.slo.alerts(),
+                "status": self.slo.status(),
+            }
+
+        def journal():
+            tail = max(0, int(self.config.bundle.journal_tail))
+            j = self.ctx.journal
+            docs = self.ctx.documents
+            from learningorchestra_tpu.jobs.journal import (
+                JOURNAL_COLLECTION,
+            )
+
+            try:
+                j.flush()
+            except Exception:  # noqa: BLE001 — a flush failure still
+                pass  # leaves the already-persisted records readable
+            if not docs.collection_exists(JOURNAL_COLLECTION):
+                return {"records": []}
+            records = list(docs.find(JOURNAL_COLLECTION))
+            return {"records": records[-tail:] if tail else []}
+
+        def locks():
+            from learningorchestra_tpu import concurrency_rt
+
+            return concurrency_rt.snapshot()
+
+        return {
+            "metrics": metrics,
+            "rollup": rollup,
+            "slo": slo,
+            "fleet": lambda: self.serving.fleet.snapshot(),
+            "journal": journal,
+            "faults": lambda: faults.status(),
+            "locks": locks,
+        }
+
+    def _slo_bundle_sink(self, event: dict) -> None:
+        """SLO alert-transition sink: a ``firing`` transition IS the
+        incident signal — ask for a bundle (debounced/single-flight
+        inside the service; assembly runs on its own thread, so the
+        rollup tick this sink rides never blocks on file IO)."""
+        if event.get("state") != "firing":
+            return
+        self.bundles.trigger("slo_firing", {
+            "slo": event.get("slo"),
+            "instance": event.get("instance"),
+            "burnFast": event.get("burnFast"),
+            "burnSlow": event.get("burnSlow"),
         })
 
     # -- idempotency ----------------------------------------------------------
@@ -1693,6 +1794,108 @@ class APIServer:
             lambda m, b, q: (200, self.slo.status()),
         )
 
+        # Runtime objectives: the drill surface — POST an ad-hoc
+        # objective (e.g. availability scoped to one route) before an
+        # experiment, DELETE it after.  Config-built objectives are
+        # the deployment's contract and stay non-removable.
+        def slo_create(m, body, query):
+            body = body or {}
+            threshold_ms = body.get("thresholdMs")
+            try:
+                doc = self.slo.add_objective(
+                    body.get("name"), body.get("kind"),
+                    body.get("target", 0),
+                    threshold_s=(
+                        float(threshold_ms) / 1000.0
+                        if threshold_ms is not None else None
+                    ),
+                    metric=body.get("metric"),
+                    route=body.get("route"),
+                )
+            except (TypeError, ValueError) as exc:
+                raise ValidationError(str(exc)) from None
+            return 201, {"objective": doc}
+
+        def slo_delete(m, body, query):
+            name = m.group("name")
+            if not self.slo.remove_objective(name):
+                return 404, {
+                    "error": f"no runtime objective {name!r}"
+                }
+            return 200, {"result": "deleted"}
+
+        add("POST", r"/observability/slo", slo_create)
+        add("DELETE", rf"/observability/slo/{NAME}", slo_delete)
+
+        # ---- Flight recorder + debug bundles (obs/flight.py,
+        # obs/bundle.py) ----
+        # /flight is the live incident view: per-domain rings plus
+        # the merged timeline.  /bundle (POST) freezes everything
+        # into a durable on-disk bundle NOW; /bundles is the store.
+        def flight_view(m, body, query):
+            from learningorchestra_tpu.obs import flight as obs_flight
+
+            domains = None
+            if query.get("domain"):
+                domains = tuple(
+                    d for d in str(query["domain"]).split(",") if d
+                )
+            try:
+                limit = int(query.get("limit", 0))
+            except ValueError:
+                raise ValidationError(
+                    "limit must be an integer"
+                ) from None
+            doc = obs_flight.snapshot(domains=domains, limit=limit)
+            doc["timeline"] = obs_flight.timeline(
+                domains=domains, limit=limit
+            )
+            return 200, doc
+
+        def bundle_create(m, body, query):
+            body = body or {}
+            reason = str(body.get("reason") or "manual")
+            return 201, {
+                "bundle": self.bundles.build(reason, {"via": "rest"})
+            }
+
+        def bundle_get(m, body, query):
+            name = m.group("name")
+            rel = query.get("file")
+            if rel:
+                # Retrieval: one bundle artifact's bytes (path
+                # traversal is rejected inside read_file).
+                return 200, (
+                    "application/octet-stream",
+                    self.bundles.read_file(name, rel),
+                )
+            doc = self.bundles.manifest(name)
+            if doc is None:
+                return 404, {"error": f"no bundle {name!r}"}
+            return 200, doc
+
+        def bundle_delete(m, body, query):
+            name = m.group("name")
+            if not self.bundles.delete(name):
+                return 404, {"error": f"no bundle {name!r}"}
+            return 200, {"result": "deleted"}
+
+        add("GET", r"/observability/flight", flight_view)
+        add("POST", r"/observability/bundle", bundle_create)
+        add(
+            "GET", r"/observability/bundles",
+            lambda m, b, q: (200, self.bundles.status()),
+        )
+        add(
+            "DELETE", r"/observability/bundles",
+            lambda m, b, q: (
+                200, {"deleted": self.bundles.delete_all()},
+            ),
+        )
+        add("GET", rf"/observability/bundles/{NAME}", bundle_get)
+        add("DELETE", rf"/observability/bundles/{NAME}",
+            bundle_delete)
+
         # ---- On-demand profiler capture (obs/profiling.py) ----
         # start/stop wrap jax.profiler around a LIVE process: capture
         # a device trace while production traffic runs, list the
@@ -1946,12 +2149,13 @@ class APIServer:
             faults.hit("http.handler")
             return handler(m, body, query)
         except (DuplicateArtifact, ConflictError,
-                ProfilerConflict) as exc:
+                ProfilerConflict, BundleBusy) as exc:
             return 409, {"error": str(exc)}
-        except (NotFoundError, ProfilerNotFound) as exc:
+        except (NotFoundError, ProfilerNotFound,
+                BundleNotFound) as exc:
             return 404, {"error": str(exc)}
         except (ValidationError, RegistryError, ServeError,
-                ProfilerError) as exc:
+                ProfilerError, BundleError) as exc:
             return 406, {"error": str(exc)}
         except LeaseTimeout as exc:
             # No chip lease within the placement budget: the pool is
@@ -2020,7 +2224,23 @@ class APIServer:
                     self._obs_registry = reg
         return self._http_hist, self._http_total, self._http_max
 
-    def _record_metric(self, key: str, status: int, dt_ms: float) -> None:
+    def _record_metric(self, key: str, status: int, dt_ms: float,
+                       request_id: str | None = None) -> None:
+        # Flight-recorder timeline entry FIRST (lock-free append).
+        # The request id is threaded explicitly: this runs on the HTTP
+        # thread, outside invoke()'s contextvar binding.
+        from learningorchestra_tpu.obs import flight as obs_flight
+
+        if request_id is not None:
+            obs_flight.record(
+                "http", "request", route=key, status=status,
+                ms=round(dt_ms, 3), requestId=request_id,
+            )
+        else:
+            obs_flight.record(
+                "http", "request", route=key, status=status,
+                ms=round(dt_ms, 3),
+            )
         with self._metrics_lock:
             rec = self._metrics.setdefault(
                 key,
@@ -2232,6 +2452,28 @@ class APIServer:
             for model, mstats in sstats["models"].items():
                 mdepth.sample(mstats["queueDepth"], model=model)
             fams.append(mdepth)
+
+        # -- decode concurrency: live stream count and admission
+        # headroom per resident-LM model, straight from the decoder's
+        # own stats (free = unoccupied slots across its page pools —
+        # the number of streams admittable without a pool grow).
+        dstats = self.serving.decode.stats()
+        if dstats["models"]:
+            dactive = Family(
+                "gauge", "lo_serving_decode_active_streams",
+                "Streams active (queued+resident) per decode model.",
+            )
+            dfree = Family(
+                "gauge", "lo_serving_decode_free_slots",
+                "Unoccupied page-pool slots per decode model.",
+            )
+            for model, ds in dstats["models"].items():
+                dactive.sample(ds["activeStreams"], model=model)
+                dfree.sample(
+                    sum(p["slots"] - p["live"] for p in ds["pools"]),
+                    model=model,
+                )
+            fams += [dactive, dfree]
 
         # -- fleet: per-replica attribution.  Cardinality is bounded
         # by construction (models <= registry max_models, replicas <=
@@ -2480,7 +2722,8 @@ class APIServer:
             # Saturated: shed load NOW rather than queue behind
             # max_inflight stuck handlers (a slow-loris of long POSTs
             # must not grow threads without bound).
-            self._record_metric("saturated", 503, 0.0)
+            self._record_metric("saturated", 503, 0.0,
+                                request_id=request_id)
             return 503, {
                 "error": "gateway saturated "
                          f"({self.config.api.max_inflight} requests "
@@ -2512,7 +2755,8 @@ class APIServer:
         if handler is None:
             status, payload = self.router.dispatch(verb, path, body, query)
             self._record_metric(
-                route_key, status, (_time.perf_counter() - t0) * 1e3
+                route_key, status, (_time.perf_counter() - t0) * 1e3,
+                request_id=request_id,
             )
             return status, payload
 
@@ -2526,6 +2770,7 @@ class APIServer:
                     self._record_metric(
                         route_key, hit[1],
                         (_time.perf_counter() - t0) * 1e3,
+                        request_id=request_id,
                     )
                     return hit[1], hit[2]
         elif verb != "GET":
@@ -2545,11 +2790,13 @@ class APIServer:
                 self._record_metric(
                     route_key, status,
                     (_time.perf_counter() - t0) * 1e3,
+                    request_id=request_id,
                 )
                 return status, payload
             if kind == "mismatch":
                 self._record_metric(
-                    route_key, 422, (_time.perf_counter() - t0) * 1e3
+                    route_key, 422, (_time.perf_counter() - t0) * 1e3,
+                    request_id=request_id,
                 )
                 return 422, {
                     "error": "this idempotency key was already used "
@@ -2560,7 +2807,8 @@ class APIServer:
                 }
             if kind == "ambiguous":
                 self._record_metric(
-                    route_key, 409, (_time.perf_counter() - t0) * 1e3
+                    route_key, 409, (_time.perf_counter() - t0) * 1e3,
+                    request_id=request_id,
                 )
                 return 409, {
                     "error": "a previous attempt with this "
@@ -2628,7 +2876,8 @@ class APIServer:
                     _time.monotonic() + ttl, status, payload
                 )
         self._record_metric(
-            route_key, status, (_time.perf_counter() - t0) * 1e3
+            route_key, status, (_time.perf_counter() - t0) * 1e3,
+            request_id=request_id,
         )
         return status, payload
 

@@ -1007,6 +1007,92 @@ class _Observability:
         budget remaining per instance."""
         return self.ctx.request("GET", "/observability/slo")
 
+    def slo_create(self, name: str, kind: str, target: float,
+                   threshold_ms: float | None = None,
+                   metric: str | None = None,
+                   route: str | None = None) -> dict:
+        """POST /observability/slo — register an ad-hoc runtime
+        objective (the drill surface): ``availability`` with an
+        optional ``route`` filter (e.g. ``"GET /health"``), or
+        ``latency`` with ``threshold_ms`` against a histogram
+        ``metric``.  Runtime objectives evaluate on the same rollup
+        clock as config-built ones and are removable."""
+        body: dict = {"name": name, "kind": kind, "target": target}
+        if threshold_ms is not None:
+            body["thresholdMs"] = threshold_ms
+        if metric is not None:
+            body["metric"] = metric
+        if route is not None:
+            body["route"] = route
+        return self.ctx.request("POST", "/observability/slo", body)
+
+    def slo_delete(self, name: str) -> dict:
+        """DELETE /observability/slo/<name> — drop a runtime
+        objective and its live alert rows (config-built objectives
+        are the deployment's contract and answer 404)."""
+        return self.ctx.request(
+            "DELETE", f"/observability/slo/{name}"
+        )
+
+    # -- flight recorder + debug bundles --------------------------------
+
+    def flight(self, domains: list | None = None,
+               limit: int | None = None) -> dict:
+        """GET /observability/flight — the always-on flight
+        recorder's per-domain event rings (http, decode, jobs,
+        compile, faults, locks) plus the merged incident
+        ``timeline`` ordered by monotonic time."""
+        query: dict = {}
+        if domains:
+            query["domain"] = ",".join(domains)
+        if limit is not None:
+            query["limit"] = limit
+        return self.ctx.request(
+            "GET", "/observability/flight", query=query
+        )
+
+    def bundle_create(self, reason: str | None = None) -> dict:
+        """POST /observability/bundle — assemble a debug bundle NOW
+        (synchronous; a concurrent assembly raises ClientError 409).
+        Returns the manifest: flight rings, metrics/rollup/SLO/fleet
+        snapshots, journal tail, fault + lock state."""
+        body = {"reason": reason} if reason else {}
+        return self.ctx.request(
+            "POST", "/observability/bundle", body
+        )
+
+    def bundles(self) -> dict:
+        """GET /observability/bundles — the on-disk bundle store:
+        retained bundles plus assembler status (built/debounced
+        counters, retention knobs)."""
+        return self.ctx.request("GET", "/observability/bundles")
+
+    def bundle_get(self, name: str) -> dict:
+        """GET /observability/bundles/<name> — one bundle's
+        manifest (file list, sizes, trigger reason/detail,
+        per-provider errors)."""
+        return self.ctx.request(
+            "GET", f"/observability/bundles/{name}"
+        )
+
+    def bundle_fetch(self, name: str, path: str) -> bytes:
+        """One bundle artifact's bytes (e.g. ``flight.json``)."""
+        return self.ctx.request(
+            "GET", f"/observability/bundles/{name}",
+            query={"file": path}, raw=True,
+        )
+
+    def bundle_delete(self, name: str) -> dict:
+        """DELETE /observability/bundles/<name>."""
+        return self.ctx.request(
+            "DELETE", f"/observability/bundles/{name}"
+        )
+
+    def bundles_clear(self) -> dict:
+        """DELETE /observability/bundles — drop every retained
+        bundle; returns the count removed."""
+        return self.ctx.request("DELETE", "/observability/bundles")
+
     # -- on-demand profiler capture -------------------------------------
 
     def profile_start(self, name: str | None = None,

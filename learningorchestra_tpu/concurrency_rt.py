@@ -244,6 +244,16 @@ class _WitnessLock:
                     "site": _call_site(),
                     "at": time.time(),
                 })
+        if held_names:
+            # Function-local import: obs.flight imports make_lock from
+            # this module.  record() is lock-free, so this is safe even
+            # though the caller is about to block on a witnessed lock.
+            from learningorchestra_tpu.obs import flight as _flight
+            _flight.record(
+                "locks", "contention",
+                wanted=self.name, thread=thread.name,
+                held=list(dict.fromkeys(held_names)),
+            )
 
     def _clear_waiting(self, thread) -> None:
         with _STATE_LOCK:
@@ -327,6 +337,20 @@ def _watchdog_loop(stop: threading.Event) -> None:
                 "GET /observability/locks for the full dump\n%s",
                 waiter, for_s, name, owner or "<unheld>",
                 _format_stacks(),
+            )
+            # A stall is exactly the moment the flight rings are worth
+            # freezing: record the episode and ask for a debug bundle
+            # (no-op unless a server has wired the bundle service).
+            from learningorchestra_tpu.obs import bundle as _bundle
+            from learningorchestra_tpu.obs import flight as _flight
+            _flight.record(
+                "locks", "stall",
+                lock=name, thread=waiter,
+                forS=round(for_s, 3), holder=owner or "",
+            )
+            _bundle.trigger(
+                "lock_stall",
+                lock=name, thread=waiter, forS=round(for_s, 3),
             )
 
 

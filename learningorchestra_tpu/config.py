@@ -466,6 +466,55 @@ class ProfilingConfig:
 
 
 @dataclasses.dataclass
+class FlightConfig:
+    """Always-on flight recorder (obs/flight.py): bounded per-domain
+    event rings holding the last N runtime events — HTTP requests,
+    decode stream steps, job dispatch decisions, compile-cache builds,
+    fault triggers, lock contention — each stamped with monotonic time
+    and the request id.  Env knobs: LO_TPU_FLIGHT_*."""
+
+    # Master switch.  Disabled, every record() is one global check.
+    # Env: LO_TPU_FLIGHT_ENABLED.
+    enabled: bool = True
+    # Ring capacity per domain; the newest events win.  Retention in
+    # seconds = events / event rate, so size for the fast domains
+    # (decode steps) — 512 covers ~30 s of a busy decoder.
+    # Env: LO_TPU_FLIGHT_EVENTS.
+    events: int = 512
+
+
+@dataclasses.dataclass
+class BundleConfig:
+    """Debug-bundle assembler (obs/bundle.py): on an SLO alert firing,
+    a watchdog stall, a retries-exhausted job failure or a manual
+    POST, snapshot the flight rings + metrics + rollup tails + SLO
+    state + fleet ledger + journal tail into a versioned on-disk
+    bundle.  Env knobs: LO_TPU_BUNDLE_*."""
+
+    # Master switch for trigger-driven capture (the REST list/fetch
+    # surface stays probeable either way).  Env: LO_TPU_BUNDLE_ENABLED.
+    enabled: bool = True
+    # Bundle root; "" derives <volume_root>/_bundles at server
+    # construction.  Env: LO_TPU_BUNDLE_DIR.
+    dir: str = ""
+    # Retained bundles; oldest pruned after each build.
+    # Env: LO_TPU_BUNDLE_MAX.
+    max_bundles: int = 8
+    # Minimum seconds between AUTO-triggered bundles: an alert storm
+    # lands one bundle, not fifty (manual POSTs bypass this).
+    # Env: LO_TPU_BUNDLE_DEBOUNCE_S.
+    debounce_s: float = 300.0
+    # Auto-start a short jax.profiler capture with each bundle (off by
+    # default: a device trace is not free at incident time).
+    # Env: LO_TPU_BUNDLE_PROFILE / LO_TPU_BUNDLE_PROFILE_S.
+    profile: bool = False
+    profile_s: float = 2.0
+    # Journal records included in the bundle's tail (newest-last).
+    # Env: LO_TPU_BUNDLE_JOURNAL_TAIL.
+    journal_tail: int = 200
+
+
+@dataclasses.dataclass
 class MeshConfig:
     """Logical device-mesh shape for distributed execution.
 
@@ -594,6 +643,12 @@ class Config:
     costs: CostsConfig = dataclasses.field(default_factory=CostsConfig)
     profiling: ProfilingConfig = dataclasses.field(
         default_factory=ProfilingConfig
+    )
+    flight: FlightConfig = dataclasses.field(
+        default_factory=FlightConfig
+    )
+    bundle: BundleConfig = dataclasses.field(
+        default_factory=BundleConfig
     )
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     dist: DistributedConfig = dataclasses.field(
@@ -893,6 +948,30 @@ class Config:
         if "LO_TPU_PROF_MAX_CAPTURES" in env:
             cfg.profiling.max_captures = int(
                 env["LO_TPU_PROF_MAX_CAPTURES"]
+            )
+        if "LO_TPU_FLIGHT_ENABLED" in env:
+            cfg.flight.enabled = _bool_env("LO_TPU_FLIGHT_ENABLED")
+        if "LO_TPU_FLIGHT_EVENTS" in env:
+            cfg.flight.events = int(env["LO_TPU_FLIGHT_EVENTS"])
+        if "LO_TPU_BUNDLE_ENABLED" in env:
+            cfg.bundle.enabled = _bool_env("LO_TPU_BUNDLE_ENABLED")
+        if "LO_TPU_BUNDLE_DIR" in env:
+            cfg.bundle.dir = env["LO_TPU_BUNDLE_DIR"]
+        if "LO_TPU_BUNDLE_MAX" in env:
+            cfg.bundle.max_bundles = int(env["LO_TPU_BUNDLE_MAX"])
+        if "LO_TPU_BUNDLE_DEBOUNCE_S" in env:
+            cfg.bundle.debounce_s = float(
+                env["LO_TPU_BUNDLE_DEBOUNCE_S"]
+            )
+        if "LO_TPU_BUNDLE_PROFILE" in env:
+            cfg.bundle.profile = _bool_env("LO_TPU_BUNDLE_PROFILE")
+        if "LO_TPU_BUNDLE_PROFILE_S" in env:
+            cfg.bundle.profile_s = float(
+                env["LO_TPU_BUNDLE_PROFILE_S"]
+            )
+        if "LO_TPU_BUNDLE_JOURNAL_TAIL" in env:
+            cfg.bundle.journal_tail = int(
+                env["LO_TPU_BUNDLE_JOURNAL_TAIL"]
             )
         if "LO_TPU_OBS_BUCKETS_MS" in env:
             edges = tuple(

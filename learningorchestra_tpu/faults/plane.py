@@ -305,6 +305,11 @@ def _fire(point: str) -> None:
         delay_ms = sched.delay_ms
         trigger_n = sched.triggers
     _trigger_counter().inc(point=point, mode=mode)
+    from learningorchestra_tpu.obs import flight as obs_flight
+
+    obs_flight.record(
+        "faults", "trigger", point=point, mode=mode, n=trigger_n,
+    )
     logger.warning(kv(event="fault_triggered", point=point, mode=mode,
                       trigger=trigger_n))
     if mode == "delay":

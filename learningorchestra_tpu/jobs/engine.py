@@ -65,6 +65,23 @@ def current_attempt() -> int:
     return _ATTEMPT.get()
 
 
+def _flight():
+    """Lazy flight-recorder handle (obs/flight.py): dispatch, retry,
+    fence and terminal decisions land in the ``jobs`` ring."""
+    from learningorchestra_tpu.obs import flight
+
+    return flight
+
+
+def _bundle():
+    """Lazy debug-bundle handle (obs/bundle.py): retries-exhausted and
+    deadline terminals ask for an incident bundle (no-op unless a
+    server wired the singleton)."""
+    from learningorchestra_tpu.obs import bundle
+
+    return bundle
+
+
 def _job_metrics():
     """Engine instrumentation handles, resolved per use so a registry
     reset (tests, the bench's on/off probe) takes effect immediately."""
@@ -220,6 +237,9 @@ class JobEngine:
         except jobs_journal.StaleEpochError as exc:
             logger.error(kv(job=name, state="fenced",
                             error=str(exc), **req))
+            _flight().record(
+                "jobs", "fence_refused", job=name, error=str(exc),
+            )
             return True
         return False
 
@@ -450,6 +470,11 @@ class JobEngine:
                     attempt_token = _ATTEMPT.set(attempts)
                     try:
                         faults.hit("engine.dispatch")
+                        _flight().record(
+                            "jobs", "dispatch",
+                            job=name, method=method,
+                            jobClass=job_class, attempt=attempts + 1,
+                        )
                         if capture_stdout:
                             # Thread-scoped: redirect_stdout would capture
                             # every concurrent thread's prints, not this
@@ -476,6 +501,11 @@ class JobEngine:
                         )
                         self._journal(name, "preempted",
                                       attempt=attempts)
+                        _flight().record(
+                            "jobs", "preempt_retry",
+                            job=name, attempt=attempts,
+                            exhausted=exhausted,
+                        )
                         jobs_total.inc(
                             job_class=job_class, state="preempted"
                         )
@@ -518,6 +548,12 @@ class JobEngine:
                         jobs_total.inc(
                             job_class=job_class, state="failed"
                         )
+                        # Retries exhausted IS the incident: freeze
+                        # the flight rings into a debug bundle.
+                        _bundle().trigger(
+                            "job_retries_exhausted",
+                            job=name, attempts=attempts,
+                        )
                         self._notify(name, "failed")
                         return None
                     except BaseException as exc:  # never kill workers
@@ -544,6 +580,10 @@ class JobEngine:
                                **req)
                         )
                         self._journal(name, "failed", reason=err)
+                        _flight().record(
+                            "jobs", "failed",
+                            job=name, error=err[:200],
+                        )
                         meta.mark_failed(name, err)
                         jobs_total.inc(
                             job_class=job_class, state="failed"
@@ -926,6 +966,14 @@ class JobEngine:
         logger.error(kv(job=name, state="deadline",
                         deadlineS=deadline))
         self._journal(name, "deadline", reason=err)
+        _flight().record(
+            "jobs", "deadline", job=name, deadlineS=deadline,
+        )
+        # A watchdog-expired job is a crash-grade incident: snapshot
+        # the rings before the evidence ages out.
+        _bundle().trigger(
+            "job_deadline", job=name, deadlineS=deadline,
+        )
         _, jobs_total = _job_metrics()
         jobs_total.inc(job_class=rec["job_class"], state="deadline")
         try:

@@ -73,6 +73,8 @@ CORE_FAMILIES = (
     "lo_serving_decode_ttft_seconds",
     "lo_serving_decode_itl_seconds",
     "lo_serving_decode_tokens_total",
+    "lo_serving_decode_active_streams",
+    "lo_serving_decode_free_slots",
 )
 
 

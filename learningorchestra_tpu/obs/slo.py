@@ -82,6 +82,7 @@ class _Objective:
         self.name = name
         self.kind = kind
         self.target = target
+        self.source = spec.pop("source", "config")
         self.spec = spec
 
     def instances(self, engine) -> list[str]:
@@ -98,13 +99,22 @@ class _Objective:
                now: float):
         """``(bad, total)`` over the window, or ``None`` (no data)."""
         if self.kind == "availability":
+            # Optional per-route filter (ad-hoc runtime objectives):
+            # a drill can hold ONE route to its own availability
+            # target instead of the fleet-wide aggregate.
+            route = self.spec.get("route")
             total = engine.counter_delta(
-                "lo_http_requests_total", None, window_s, now=now
+                "lo_http_requests_total",
+                {"route": route} if route else None,
+                window_s, now=now,
             )
             if total is None or total <= 0:
                 return None
+            bad_labels = {"status": "5xx"}
+            if route:
+                bad_labels["route"] = route
             bad = engine.counter_delta(
-                "lo_http_requests_total", {"status": "5xx"},
+                "lo_http_requests_total", bad_labels,
                 window_s, now=now,
             ) or 0.0
             return bad, total
@@ -137,11 +147,14 @@ class _Objective:
     def to_doc(self) -> dict:
         doc = {"name": self.name, "kind": self.kind,
                "target": self.target,
-               "errorBudget": round(1.0 - self.target, 6)}
+               "errorBudget": round(1.0 - self.target, 6),
+               "source": self.source}
         if "threshold_s" in self.spec:
             doc["thresholdMs"] = self.spec["threshold_s"] * 1e3
         if "metric" in self.spec:
             doc["metric"] = self.spec["metric"]
+        if "route" in self.spec:
+            doc["route"] = self.spec["route"]
         return doc
 
 
@@ -187,6 +200,75 @@ class SLOService:
         self._sinks = [self._log_sink]
         if cfg.webhook:
             self._sinks.append(self._webhook_sink)
+
+    # -- runtime objectives --------------------------------------------------
+
+    #: Valid kinds for ad-hoc objectives (POST /observability/slo).
+    KINDS = ("availability", "latency", "job_success")
+    #: Runtime-registered objectives are bounded: every objective
+    #: costs two window reads per instance per tick.
+    MAX_OBJECTIVES = 32
+
+    def add_objective(self, name: str, kind: str, target: float,
+                      **spec) -> dict:
+        """Register an ad-hoc objective at runtime (the drill
+        surface): ``availability`` takes an optional ``route`` filter,
+        ``latency`` takes ``threshold_s`` and an optional histogram
+        ``metric``.  Raises ValueError on a bad spec, an existing
+        name, or the objective cap."""
+        name = str(name or "").strip()
+        if not name:
+            raise ValueError("objective needs a non-empty 'name'")
+        if kind not in self.KINDS:
+            raise ValueError(
+                f"unknown objective kind {kind!r} "
+                f"(one of {list(self.KINDS)})"
+            )
+        target = float(target)
+        if not 0.0 < target < 1.0:
+            # Same zero-budget convention the boot knobs enforce: a
+            # target of 1.0 cannot burn.
+            raise ValueError(
+                f"target {target!r} must be a fraction in (0, 1)"
+            )
+        if kind == "latency":
+            if float(spec.get("threshold_s") or 0) <= 0:
+                raise ValueError(
+                    "latency objectives need a positive thresholdMs"
+                )
+            spec["threshold_s"] = float(spec["threshold_s"])
+        spec = {k: v for k, v in spec.items() if v is not None}
+        obj = _Objective(name, kind, target, source="runtime", **spec)
+        with self._lock:
+            if any(o.name == name for o in self.objectives):
+                raise ValueError(
+                    f"objective {name!r} already exists"
+                )
+            if len(self.objectives) >= self.MAX_OBJECTIVES:
+                raise ValueError(
+                    f"objective cap reached ({self.MAX_OBJECTIVES})"
+                )
+            self.objectives.append(obj)
+        return obj.to_doc()
+
+    def remove_objective(self, name: str) -> bool:
+        """Drop a runtime objective and its live alert rows (the
+        transition history keeps the record).  Config-built
+        objectives are deliberately not removable — they are the
+        deployment's contract, not a drill's."""
+        with self._lock:
+            for obj in self.objectives:
+                if obj.name == name and obj.source == "runtime":
+                    self.objectives.remove(obj)
+                    for key in list(self._alerts):
+                        if key[0] == name:
+                            del self._alerts[key]
+                    return True
+        return False
+
+    def _objectives_snapshot(self) -> list:
+        with self._lock:
+            return list(self.objectives)
 
     # -- sinks ---------------------------------------------------------------
 
@@ -254,7 +336,7 @@ class SLOService:
         evaluated: set[tuple] = set()
         with self._lock:
             self.evaluations += 1
-        for obj in self.objectives:
+        for obj in self._objectives_snapshot():
             for instance in obj.instances(engine):
                 evaluated.add((obj.name, instance))
                 fast = obj.counts(
@@ -409,7 +491,7 @@ class SLOService:
             states = {
                 k: dict(v) for k, v in self._alerts.items()
             }
-        for obj in self.objectives:
+        for obj in self._objectives_snapshot():
             doc = obj.to_doc()
             doc["instances"] = []
             for (slo_name, instance), st in sorted(states.items()):

@@ -25,6 +25,7 @@ import numpy as np
 
 from learningorchestra_tpu.concurrency_rt import make_condition, make_lock
 from learningorchestra_tpu.log import get_logger, kv
+from learningorchestra_tpu.obs import flight as obs_flight
 from learningorchestra_tpu.obs.metrics import get_registry
 from learningorchestra_tpu.serve.batcher import QueueFull
 from learningorchestra_tpu.serve.bucketing import bucket_for
@@ -133,10 +134,20 @@ class _ModelDecoder:
                 )
             active = len(self._streams) + len(self._pending)
             if active >= self.cfg.max_streams:
+                obs_flight.record(
+                    "decode", "queue_full",
+                    model=self.name, stream=stream.stream_id,
+                    active=active,
+                )
                 raise QueueFull(
                     f"decode for {self.name!r} at max_streams="
                     f"{self.cfg.max_streams}"
                 )
+            obs_flight.record(
+                "decode", "submit",
+                model=self.name, stream=stream.stream_id,
+                total=stream.total,
+            )
             self._pending.append(stream)
             self._streams[stream.stream_id] = stream
             if self._thread is None or not self._thread.is_alive():
@@ -153,6 +164,10 @@ class _ModelDecoder:
             if stream is None:
                 return False
             stream.token.cancel(reason)
+            obs_flight.record(
+                "decode", "abort",
+                model=self.name, stream=stream_id, reason=reason,
+            )
             self._cv.notify_all()
             return True
 
@@ -273,6 +288,12 @@ class _ModelDecoder:
                 pool = self._pools[(ridx, kvlen)] = PagePool(
                     kvlen, self.cfg.max_slots, replica_idx=ridx,
                 )
+                obs_flight.record(
+                    "decode", "pool_grow",
+                    model=self.name, kv=kvlen,
+                    slots=self.cfg.max_slots,
+                    replica=-1 if ridx is None else ridx,
+                )
             slot = pool.admit(
                 stream,
                 lambda want: self._step_for(want, kvlen)[1],
@@ -282,8 +303,19 @@ class _ModelDecoder:
                 model=self.name, stream=stream.stream_id,
                 error=str(exc),
             ))
+            obs_flight.record(
+                "decode", "admit_failed",
+                model=self.name, stream=stream.stream_id,
+                error=str(exc),
+            )
             self._finish(stream, error=f"admission failed: {exc}")
             return True
+        if slot is not None:
+            obs_flight.record(
+                "decode", "admit",
+                model=self.name, stream=stream.stream_id,
+                kv=kvlen, slot=slot,
+            )
         return slot is not None
 
     def _max_len(self) -> int:
@@ -368,6 +400,10 @@ class _ModelDecoder:
                 logger.error("decode step failed %s", kv(
                     model=self.name, pool=f"{key}", error=str(exc),
                 ))
+                obs_flight.record(
+                    "decode", "step_error",
+                    model=self.name, pool=f"{key}", error=str(exc),
+                )
                 for slot, stream in enumerate(pool.streams):
                     if stream is not None:
                         pool.release(slot)
@@ -435,6 +471,13 @@ class _ModelDecoder:
                     _decode_hists.ttft(
                         stream.first_at - stream.arrived, self.name
                     )
+                    obs_flight.record(
+                        "decode", "ttft",
+                        model=self.name, stream=stream.stream_id,
+                        ttftS=round(
+                            stream.first_at - stream.arrived, 4
+                        ),
+                    )
                     _decode_hists.tokens(
                         len(stream.tokens), self.name
                     )
@@ -464,6 +507,11 @@ class _ModelDecoder:
         if stream.first_at is None:
             stream.first_at = now
             _decode_hists.ttft(now - stream.arrived, self.name)
+            obs_flight.record(
+                "decode", "ttft",
+                model=self.name, stream=stream.stream_id,
+                ttftS=round(now - stream.arrived, 4),
+            )
         else:
             _decode_hists.itl(now - stream.last_at, self.name)
         stream.last_at = now

@@ -98,6 +98,14 @@ def _aot():
     return aot_store
 
 
+def _flight():
+    """Lazy flight-recorder handle (obs/flight.py): builds and AOT
+    restores land in the ``compile`` ring of the incident timeline."""
+    from learningorchestra_tpu.obs import flight
+
+    return flight
+
+
 # -- canonical fingerprinting -------------------------------------------------
 
 
@@ -495,6 +503,10 @@ class CompiledProgramCache:
             value = builder()
             built_s = time.perf_counter() - t0
             _record_compile_span(built_s, label, key)
+            _flight().record(
+                "compile", "build",
+                key=key, label=label or "", builtS=round(built_s, 4),
+            )
             self._note_cost(key, label, built_s)
             return value
         while True:
@@ -558,6 +570,11 @@ class CompiledProgramCache:
             # An AOT-satisfied lookup records NO compile span — the
             # restart drill asserts pre-warmed keys rebuild nothing.
             _record_compile_span(built_s, label, key)
+        _flight().record(
+            "compile",
+            "build" if restored is None else "aot_restore",
+            key=key, label=label or "", builtS=round(built_s, 4),
+        )
         self._note_cost(key, label, built_s)
         measured = False
         if nbytes is None:

@@ -1,0 +1,32 @@
+"""On-box flight-recorder overhead evidence: run bench._flight_probe
+and print its JSON — the hot-path ring append cost (enabled vs
+disabled) and the debounced bundle-trigger cost, expressed as a share
+of a single-row serving dispatch.  Short stage (~1-2 min): the probe
+is host-side, so it banks a number whether or not the TPU tunnel
+stays up, but running it in the chain records the number for the SAME
+box and build the other stages measure.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from bench import _flight_probe  # noqa: E402
+
+
+def main() -> None:
+    result = {"flight": _flight_probe()}
+    share = result["flight"]["per_dispatch_share_pct"]
+    # Loud verdict line for the watch log; the JSON is the record.
+    print(
+        f"flight append share {share}% of one dispatch "
+        f"({'OK' if share <= 1.0 else 'REGRESSION: > 1%'})",
+        file=sys.stderr, flush=True,
+    )
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

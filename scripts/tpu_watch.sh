@@ -58,6 +58,7 @@ print('PROBE_OK')
 STAGES=(
   "scripts/tpu_flash_evidence.py:300"
   "scripts/tpu_obs_evidence.py:300"
+  "scripts/tpu_flight_evidence.py:300"
   "scripts/tpu_warmboot_evidence.py:300"
   "scripts/tpu_decode_evidence.py:300"
   "scripts/tpu_recovery_smoke.py:600"

@@ -25,6 +25,7 @@ END = "<!-- AUTO-ONCHIP-END -->"
 STAGES = [
     ("tpu_flash_evidence", "Flash evidence (sub-minute headline)"),
     ("tpu_obs_evidence", "Observability overhead probe"),
+    ("tpu_flight_evidence", "Flight-recorder append-cost probe"),
     ("tpu_warmboot_evidence", "Warm-boot probe (AOT cache vs cold trace)"),
     ("tpu_decode_evidence", "Streaming decode probe (continuous batching vs solo)"),
     ("tpu_recovery_smoke", "Kill-9 recovery drill (journal resume)"),

@@ -1,0 +1,412 @@
+"""Flight recorder (obs/flight.py) + debug bundles (obs/bundle.py):
+ring bounds/eviction goldens, merged-timeline ordering, the bundle
+assembler's atomic layout / retention / debounce contracts, the manual
+REST round-trip through the client bindings, and the end-to-end chaos
+drill from the issue's acceptance criteria — an armed ``http.handler``
+5xx burst breaches the availability SLO, the firing transition
+auto-lands a bundle on disk whose flight rings carry the faulted
+requests' timeline entries, and the debounce yields exactly ONE
+bundle for the whole storm.
+
+Flight/bundle state is process-wide (like the metrics registry), so
+every test builds its own via reset_* and the autouse fixture
+restores the defaults on exit.
+"""
+
+import json
+import os
+import time
+
+import pytest
+import requests
+
+from learningorchestra_tpu import faults
+from learningorchestra_tpu.api import APIServer
+from learningorchestra_tpu.client import ClientError, Context
+from learningorchestra_tpu.config import (
+    BundleConfig,
+    Config,
+    FlightConfig,
+    RollupConfig,
+    SLOConfig,
+)
+from learningorchestra_tpu.obs import bundle as obs_bundle
+from learningorchestra_tpu.obs import flight as obs_flight
+from learningorchestra_tpu.obs import metrics as obs_metrics
+from learningorchestra_tpu.obs import rollup as obs_rollup
+from learningorchestra_tpu.obs import slo as obs_slo
+from learningorchestra_tpu.obs import tracing as obs_tracing
+
+PREFIX = "/api/learningOrchestra/v1"
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plane():
+    """Every test owns fresh singletons; defaults restored after."""
+    obs_metrics.reset_registry()
+    obs_flight.reset()
+    obs_bundle.reset_service()
+    yield
+    obs_rollup.reset_engine()
+    obs_slo.reset_service()
+    obs_metrics.reset_registry()
+    obs_flight.reset()
+    obs_bundle.reset_service()
+    faults.reset()
+
+
+def _wait_for(predicate, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        got = predicate()
+        if got:
+            return got
+        time.sleep(interval)
+    return predicate()
+
+
+# -- flight recorder rings ----------------------------------------------------
+
+
+class TestFlightRings:
+    def test_ring_bounds_and_eviction_golden(self):
+        obs_flight.configure(FlightConfig(events=4))
+        for i in range(6):
+            obs_flight.record("jobs", f"k{i}", seq=i)
+        events = obs_flight.snapshot()["events"]["jobs"]
+        # Capacity 4: the two oldest evicted, order preserved.
+        assert [e["kind"] for e in events] == ["k2", "k3", "k4", "k5"]
+        assert [e["seq"] for e in events] == [2, 3, 4, 5]
+        assert obs_flight.status()["rings"]["jobs"] == 4
+
+    def test_unknown_domain_dropped_not_raised(self):
+        obs_flight.configure(FlightConfig(events=4))
+        obs_flight.record("nonsense", "kind")
+        assert all(
+            n == 0 for n in obs_flight.status()["rings"].values()
+        )
+
+    def test_disabled_knob_captures_nothing(self):
+        obs_flight.configure(FlightConfig(enabled=False))
+        assert not obs_flight.enabled()
+        obs_flight.record("http", "request", route="GET /health")
+        snap = obs_flight.snapshot()
+        assert snap["enabled"] is False
+        assert snap["events"] == {}
+        assert obs_flight.timeline() == []
+
+    def test_timeline_merges_rings_in_monotonic_order(self):
+        obs_flight.configure(FlightConfig(events=16))
+        obs_flight.record("http", "request", route="GET /a")
+        obs_flight.record("jobs", "dispatch", job="j1")
+        obs_flight.record("http", "request", route="GET /b")
+        obs_flight.record("decode", "admit", stream="s1")
+        merged = obs_flight.timeline()
+        assert [e["domain"] for e in merged] == [
+            "http", "jobs", "http", "decode",
+        ]
+        ts = [e["t"] for e in merged]
+        assert ts == sorted(ts)
+        # limit keeps the NEWEST n after the merge.
+        assert [e["domain"] for e in obs_flight.timeline(limit=2)] == [
+            "http", "decode",
+        ]
+
+    def test_request_id_stamped_from_tracing_context(self):
+        obs_flight.configure(FlightConfig())
+        token = obs_tracing.set_request_id("req-abc")
+        try:
+            obs_flight.record("jobs", "dispatch", job="j1")
+        finally:
+            obs_tracing.reset_request_id(token)
+        obs_flight.record("jobs", "dispatch", job="j2")
+        events = obs_flight.snapshot()["events"]["jobs"]
+        assert events[0]["requestId"] == "req-abc"
+        assert "requestId" not in events[1]
+
+
+# -- bundle assembler ---------------------------------------------------------
+
+
+def _bundle_cfg(tmp_path, **kw):
+    kw.setdefault("dir", str(tmp_path / "bundles"))
+    kw.setdefault("debounce_s", 0.0)
+    return BundleConfig(**kw)
+
+
+class TestBundleService:
+    def test_manual_build_layout_and_broken_provider(self, tmp_path):
+        obs_flight.configure(FlightConfig())
+        obs_flight.record("http", "request", route="GET /x", status=200)
+
+        def broken():
+            raise RuntimeError("subsystem down")
+
+        svc = obs_bundle.BundleService(
+            _bundle_cfg(tmp_path),
+            providers={"metrics": lambda: {"ok": 1}, "slo": broken},
+        )
+        manifest = svc.build("drill", {"who": "test"})
+        name = manifest["name"]
+        assert manifest["reason"] == "drill"
+        assert manifest["detail"] == {"who": "test"}
+        # flight.json always, healthy providers as files, the broken
+        # one degraded to a manifest error — never a lost bundle.
+        stems = {f["name"] for f in manifest["files"]}
+        assert stems == {"flight.json", "metrics.json"}
+        assert "slo" in manifest["errors"]
+        root = os.path.join(str(tmp_path / "bundles"), name)
+        assert os.path.isfile(os.path.join(root, "manifest.json"))
+        doc = json.loads(svc.read_file(name, "flight.json"))
+        kinds = [e["kind"] for e in doc["snapshot"]["events"]["http"]]
+        assert kinds == ["request"]
+        assert doc["timeline"][0]["domain"] == "http"
+        # No half-written temp dirs survive the publish.
+        assert not [
+            e for e in os.listdir(str(tmp_path / "bundles"))
+            if e.startswith(".")
+        ]
+
+    def test_retention_prunes_oldest(self, tmp_path):
+        svc = obs_bundle.BundleService(
+            _bundle_cfg(tmp_path, max_bundles=2), providers={},
+        )
+        names = [svc.build(f"r{i}")["name"] for i in range(3)]
+        kept = svc._names()
+        assert len(kept) == 2
+        assert names[0] not in kept
+        assert names[1] in kept and names[2] in kept
+
+    def test_auto_trigger_debounce_yields_one_bundle(self, tmp_path):
+        svc = obs_bundle.BundleService(
+            _bundle_cfg(tmp_path, debounce_s=300.0), providers={},
+        )
+        first = svc.trigger("slo_firing")
+        assert first is not None
+        # The storm: every further trigger inside the window is
+        # swallowed, whether assembly is still in flight or done.
+        assert svc.trigger("slo_firing") is None
+        assert _wait_for(lambda: not svc.status()["building"])
+        assert svc.trigger("slo_firing") is None
+        assert _wait_for(lambda: svc._names() == [first])
+        assert svc.status()["debounced"] == 2
+        # Manual build bypasses the debounce — an operator asking
+        # for evidence gets it.
+        assert svc.build("manual")["name"] != first
+
+    def test_disabled_knob_trigger_is_noop(self, tmp_path):
+        svc = obs_bundle.BundleService(
+            _bundle_cfg(tmp_path, enabled=False), providers={},
+        )
+        assert svc.trigger("slo_firing") is None
+        assert svc._names() == []
+
+    def test_read_file_rejects_traversal(self, tmp_path):
+        svc = obs_bundle.BundleService(
+            _bundle_cfg(tmp_path), providers={},
+        )
+        name = svc.build("x")["name"]
+        with pytest.raises(obs_bundle.BundleError):
+            svc.read_file(name, "../../etc/passwd")
+        with pytest.raises(obs_bundle.BundleNotFound):
+            svc.read_file(name, "missing.json")
+
+    def test_module_trigger_without_singleton_is_noop(self):
+        assert obs_bundle.get_service() is None
+        assert obs_bundle.trigger("lock_stall", lock="X") is None
+
+
+# -- REST + client round-trip -------------------------------------------------
+
+
+class TestRESTRoundTrip:
+    def test_manual_bundle_and_flight_through_client(self, tmp_path):
+        cfg = Config()
+        cfg.store.root = str(tmp_path / "store")
+        cfg.store.volume_root = str(tmp_path / "volumes")
+        server = APIServer(cfg)
+        port = server.start_background()
+        client = Context(f"http://127.0.0.1:{port}")
+        try:
+            # Generate some HTTP flight traffic first.
+            assert requests.get(
+                f"http://127.0.0.1:{port}{PREFIX}/health",
+                timeout=10,
+            ).status_code == 200
+            doc = client.observability.flight(domains=["http"])
+            assert doc["enabled"]
+            assert set(doc["events"]) <= {"http"}
+
+            created = client.observability.bundle_create("drill")
+            name = created["bundle"]["name"]
+            assert created["bundle"]["reason"] == "drill"
+            stems = {
+                f["name"] for f in created["bundle"]["files"]
+            }
+            assert {
+                "flight.json", "metrics.json", "rollup.json",
+                "slo.json", "fleet.json", "journal.json",
+                "faults.json", "locks.json",
+            } <= stems
+
+            listing = client.observability.bundles()
+            assert [b["name"] for b in listing["bundles"]] == [name]
+            manifest = client.observability.bundle_get(name)
+            assert manifest["name"] == name
+            flight_doc = json.loads(
+                client.observability.bundle_fetch(name, "flight.json")
+            )
+            routes = [
+                e.get("route")
+                for e in flight_doc["snapshot"]["events"]["http"]
+            ]
+            assert "GET /health" in routes
+            # Every HTTP timeline entry carries its request id.
+            assert all(
+                "requestId" in e
+                for e in flight_doc["snapshot"]["events"]["http"]
+            )
+
+            assert client.observability.bundle_delete(name) == {
+                "result": "deleted"
+            }
+            with pytest.raises(ClientError):
+                client.observability.bundle_get(name)
+            assert client.observability.bundles_clear() == {
+                "deleted": 0
+            }
+        finally:
+            server.shutdown()
+
+    def test_runtime_slo_objective_round_trip(self, tmp_path):
+        cfg = Config()
+        cfg.store.root = str(tmp_path / "store")
+        cfg.store.volume_root = str(tmp_path / "volumes")
+        server = APIServer(cfg)
+        port = server.start_background()
+        client = Context(f"http://127.0.0.1:{port}")
+        try:
+            doc = client.observability.slo_create(
+                "drill", "availability", 0.99, route="GET /health"
+            )
+            assert doc["objective"]["source"] == "runtime"
+            assert doc["objective"]["route"] == "GET /health"
+            names = [
+                o["name"]
+                for o in client.observability.slo()["objectives"]
+            ]
+            assert "drill" in names
+            # Bad specs answer 406, duplicates too.
+            with pytest.raises(ClientError):
+                client.observability.slo_create(
+                    "drill", "availability", 0.99
+                )
+            with pytest.raises(ClientError):
+                client.observability.slo_create("x", "nope", 0.5)
+            with pytest.raises(ClientError):
+                client.observability.slo_create(
+                    "lat", "latency", 0.99
+                )
+            assert client.observability.slo_delete("drill") == {
+                "result": "deleted"
+            }
+            # Config-built objectives are not removable.
+            with pytest.raises(ClientError):
+                client.observability.slo_delete("route-availability")
+        finally:
+            server.shutdown()
+
+
+# -- the incident drill -------------------------------------------------------
+
+
+class TestChaosDrill:
+    def test_fault_burst_fires_slo_and_lands_one_bundle(
+        self, tmp_path
+    ):
+        """The acceptance drill: armed ``http.handler`` error fault →
+        5xx burst → availability alert fires → the SLO sink
+        auto-triggers a bundle that lands on disk with the faulted
+        requests' flight timeline + metrics + manifest, fetchable
+        over REST — and the alert storm's further transitions are
+        debounced into exactly one bundle."""
+        cfg = Config()
+        cfg.store.root = str(tmp_path / "store")
+        cfg.store.volume_root = str(tmp_path / "volumes")
+        cfg.rollup = RollupConfig(tick_s=0.1, points=256)
+        cfg.slo = SLOConfig(
+            fast_window_s=2.0, slow_window_s=4.0,
+            burn_threshold=5.0, for_s=0.2, resolve_s=0.5,
+            predict_p99_ms=0.0, job_success_target=0.0,
+        )
+        cfg.bundle = BundleConfig(
+            dir=str(tmp_path / "bundles"), debounce_s=300.0,
+        )
+        obs_rollup.reset_engine(cfg.rollup)
+        obs_slo.reset_service(cfg.slo)
+        server = APIServer(cfg)
+        port = server.start_background()
+        base = f"http://127.0.0.1:{port}{PREFIX}"
+        try:
+            resp = requests.post(
+                f"{base}/faults/http.handler",
+                json={"mode": "error", "maxTriggers": 30},
+                timeout=10,
+            )
+            assert resp.status_code == 201, resp.text
+            for _ in range(30):
+                assert requests.get(
+                    f"{base}/health", timeout=10
+                ).status_code == 500
+
+            def bundle_names():
+                doc = requests.get(
+                    f"{base}/observability/bundles", timeout=10
+                ).json()
+                return [b["name"] for b in doc["bundles"]]
+
+            names = _wait_for(bundle_names, timeout=20)
+            assert names, "no bundle landed after the SLO fired"
+            # The whole storm debounced into ONE auto bundle.
+            assert len(names) == 1
+            name = names[0]
+
+            manifest = requests.get(
+                f"{base}/observability/bundles/{name}", timeout=10
+            ).json()
+            assert manifest["reason"] == "slo_firing"
+            assert manifest["detail"]["slo"] == "route-availability"
+            stems = {f["name"] for f in manifest["files"]}
+            assert "flight.json" in stems
+            assert "metrics.json" in stems
+
+            flight_doc = json.loads(requests.get(
+                f"{base}/observability/bundles/{name}",
+                params={"file": "flight.json"}, timeout=10,
+            ).content)
+            http_events = flight_doc["snapshot"]["events"]["http"]
+            faulted = [
+                e for e in http_events
+                if e.get("route") == "GET /health"
+                and e.get("status") == 500
+            ]
+            assert len(faulted) == 30
+            assert all("requestId" in e for e in faulted)
+            # The chaos plane's own triggers share the timeline.
+            fault_events = flight_doc["snapshot"]["events"]["faults"]
+            assert sum(
+                1 for e in fault_events
+                if e.get("point") == "http.handler"
+            ) == 30
+            # Merged timeline interleaves both domains by time.
+            domains = {
+                e["domain"] for e in flight_doc["timeline"]
+            }
+            assert {"http", "faults"} <= domains
+
+            # A later trigger inside the debounce window is
+            # swallowed — the incident still maps to one bundle.
+            assert server.bundles.trigger("slo_firing") is None
+            assert bundle_names() == [name]
+        finally:
+            server.shutdown()
