@@ -968,6 +968,89 @@ def _journal_probe() -> dict:
     }
 
 
+def _claim_probe() -> dict:
+    """Scale-out control-plane overhead on the dispatch path, pinned
+    as a SUBSYSTEM number (the acceptance bar: claim + release +
+    amortized heartbeat ≤ 5% of a minimal job dispatch).
+
+    The coordinator pays a cross-process flock + WAL refresh per
+    operation, so unlike the journal (pure in-process enqueue) its
+    cost is dominated by the filesystem round-trip:
+
+    - ``claim_us`` — steady-state owner re-claim (what a preemption
+      retry or recovered dispatch pays);
+    - ``cycle_us`` — a fresh claim + release pair (what every
+      clustered dispatch pays end to end);
+    - ``heartbeat_us`` — one lease renewal over an engine doc and a
+      live claim (amortized: runs every ``heartbeat_s`` OFF the
+      dispatch path, included for context);
+    - ``dispatch_us`` — a minimal no-op job end to end on a
+      cluster-less engine, the denominator;
+    - ``claim_share_of_dispatch_pct`` — the acceptance number: the
+      per-dispatch hot-path share (heartbeat renewals run OFF this
+      path on the daemon), bar ≤ 5%;
+    - ``cycle_share_of_dispatch_pct`` — fresh claim + release over
+      dispatch, the worst-case first-dispatch share, for context.
+    """
+    import tempfile
+    from pathlib import Path
+
+    from learningorchestra_tpu.jobs import JobEngine
+    from learningorchestra_tpu.jobs.cluster import ClusterCoordinator
+    from learningorchestra_tpu.store import ArtifactStore, DocumentStore
+
+    tight = _tight_best_of
+    with tempfile.TemporaryDirectory() as td:
+        root = Path(td) / "store"
+        store = DocumentStore(root)
+        coord = ClusterCoordinator(
+            store, root, engine_id="bench",
+            heartbeat_s=3600.0, ttl_s=3600.0, sweep_s=3600.0,
+        )
+        try:
+            # Huge intervals + no join(): the daemons stay parked, so
+            # the tight loops measure the operations, not contention.
+            coord.claim("probe_owned")
+            claim_us = tight(
+                lambda: coord.claim("probe_owned"), m=300, reps=5
+            ) * 1e6
+            heartbeat_us = tight(coord.heartbeat, m=300, reps=5) * 1e6
+
+            def cycle():
+                coord.claim("probe_cycle")
+                coord.release("probe_cycle")
+
+            cycle_us = tight(cycle, m=150, reps=5) * 1e6
+
+            arts = ArtifactStore(store)
+            eng = JobEngine(arts, max_workers=1)
+
+            def one_dispatch():
+                eng.submit(
+                    "bench_job3", lambda: 1, job_class="bench"
+                ).result(timeout=30)
+                eng._futures.pop("bench_job3", None)
+
+            arts.metadata.create("bench_job3", "function/python")
+            dispatch_us = tight(one_dispatch, m=50, reps=5) * 1e6
+            eng.shutdown(wait=True)
+        finally:
+            coord.close()
+            store.close()
+    return {
+        "claim_us": round(claim_us, 2),
+        "cycle_us": round(cycle_us, 2),
+        "heartbeat_us": round(heartbeat_us, 2),
+        "dispatch_us": round(dispatch_us, 1),
+        "claim_share_of_dispatch_pct": round(
+            claim_us / dispatch_us * 100.0, 3
+        ),
+        "cycle_share_of_dispatch_pct": round(
+            cycle_us / dispatch_us * 100.0, 3
+        ),
+    }
+
+
 def _costs_probe() -> dict:
     """Per-dispatch cost-accounting hook cost, pinned as a SUBSYSTEM
     number (the ROADMAP bench caveat: headline A/B windows on this box
@@ -1681,6 +1764,10 @@ def _tpu_suite_child_main() -> None:
     except Exception as exc:  # noqa: BLE001 — record, don't hide
         suite["_journal"] = f"FAILED: {exc!r}"
     try:
+        suite["_cluster"] = _claim_probe()
+    except Exception as exc:  # noqa: BLE001 — record, don't hide
+        suite["_cluster"] = f"FAILED: {exc!r}"
+    try:
         suite["_fleet"] = _fleet_probe()
     except Exception as exc:  # noqa: BLE001 — record, don't hide
         suite["_fleet"] = f"FAILED: {exc!r}"
@@ -1720,6 +1807,7 @@ def main() -> None:
         obs_probe = suite.pop("_obs", None)
         faults_probe = suite.pop("_faults", None)
         journal_probe = suite.pop("_journal", None)
+        cluster_probe = suite.pop("_cluster", None)
         fleet_probe = suite.pop("_fleet", None)
         decode_probe = suite.pop("_decode", None)
         costs_probe = suite.pop("_costs", None)
@@ -1738,6 +1826,8 @@ def main() -> None:
             extra["faults"] = faults_probe
         if journal_probe is not None:
             extra["journal"] = journal_probe
+        if cluster_probe is not None:
+            extra["cluster"] = cluster_probe
         if fleet_probe is not None:
             extra["fleet"] = fleet_probe
         if decode_probe is not None:
@@ -1793,6 +1883,10 @@ def main() -> None:
             extra["costs"] = _costs_probe()
         except Exception as exc:  # noqa: BLE001 — record, don't hide
             extra["costs"] = f"FAILED: {exc!r}"
+        try:
+            extra["cluster"] = _claim_probe()
+        except Exception as exc:  # noqa: BLE001 — record, don't hide
+            extra["cluster"] = f"FAILED: {exc!r}"
         try:
             extra["slo"] = _slo_probe()
         except Exception as exc:  # noqa: BLE001 — record, don't hide

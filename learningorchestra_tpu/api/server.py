@@ -25,6 +25,7 @@ from urllib.parse import parse_qs, urlparse
 from learningorchestra_tpu import faults
 from learningorchestra_tpu.concurrency_rt import make_lock
 from learningorchestra_tpu.config import Config, get_config
+from learningorchestra_tpu.jobs.cluster import QuotaExceeded, bind_tenant
 from learningorchestra_tpu.jobs.leases import LeaseTimeout
 from learningorchestra_tpu.obs import metrics as obs_metrics
 from learningorchestra_tpu.obs import tracing as obs_tracing
@@ -376,6 +377,18 @@ class APIServer:
 
             return concurrency_rt.snapshot()
 
+        def cluster():
+            doc = {
+                "enabled": self.ctx.cluster is not None,
+                "engines": [],
+                "claims": [],
+            }
+            if self.ctx.cluster is not None:
+                doc.update(self.ctx.cluster.status())
+            if self.ctx.admission is not None:
+                doc["tenants"] = self.ctx.admission.snapshot()
+            return doc
+
         return {
             "metrics": metrics,
             "rollup": rollup,
@@ -384,6 +397,7 @@ class APIServer:
             "journal": journal,
             "faults": lambda: faults.status(),
             "locks": locks,
+            "cluster": cluster,
         }
 
     def _slo_bundle_sink(self, event: dict) -> None:
@@ -2135,6 +2149,22 @@ class APIServer:
 
         add("POST", r"/replication/fence", replication_fence)
 
+        # ---- scale-out control plane (jobs/cluster.py) ----
+
+        def cluster_status(m, body, query):
+            # Always 200 so ctx.cluster.status() works against any
+            # topology: single-engine deployments report enabled=False
+            # instead of a 404 the client would have to special-case.
+            if self.ctx.cluster is None:
+                doc = {"enabled": False, "engines": [], "claims": []}
+            else:
+                doc = {"enabled": True, **self.ctx.cluster.status()}
+            if self.ctx.admission is not None:
+                doc["tenants"] = self.ctx.admission.snapshot()
+            return 200, doc
+
+        add("GET", r"/cluster/status", cluster_status)
+
     # -- HTTP plumbing --------------------------------------------------------
 
     def _handle_raw(self, handler, m, body, query):
@@ -2173,6 +2203,15 @@ class APIServer:
             return 429, {
                 "error": str(exc),
                 "retryAfter": self.config.serve.retry_after_s,
+            }
+        except QuotaExceeded as exc:
+            # Defense in depth: admission normally rejects in
+            # _handle_slotted before the handler runs, but a handler
+            # that submits extra jobs internally can still trip a
+            # tenant quota mid-flight.
+            return 429, {
+                "error": str(exc),
+                "retryAfter": exc.retry_after_s,
             }
         except (json.JSONDecodeError, BadRequest) as exc:
             return 400, {"error": f"bad JSON: {exc}"
@@ -2293,7 +2332,32 @@ class APIServer:
             include_empty=True
         ).items():
             depth.sample(n, job_class=cls)
+        # Per-tenant breakdown rides the same family as extra samples
+        # (labelled job_class + tenant) — emitted only once a tenant
+        # has been seen, so single-tenant scrapes keep their shape.
+        for (cls, tenant), n in (
+            self.ctx.engine.queue_depths_by_tenant().items()
+        ):
+            depth.sample(n, job_class=cls, tenant=tenant or "-")
         fams.append(depth)
+
+        # -- scale-out control plane ----------------------------------
+        engines_live = 0
+        if self.ctx.cluster is not None:
+            try:
+                cstat = self.ctx.cluster.status()
+                engines_live = sum(
+                    1 for e in cstat.get("engines", ()) if e.get("live")
+                )
+            except Exception:  # noqa: BLE001 — scrape must not fail
+                engines_live = 0
+        fams.append(
+            Family(
+                "gauge", "lo_cluster_engines",
+                "Live job engines sharing this store "
+                "(0 = clustering off).",
+            ).sample(engines_live)
+        )
 
         # -- chip-lease pool utilization ------------------------------
         snap = self.ctx.leaser.snapshot()
@@ -2697,9 +2761,25 @@ class APIServer:
                 fams.append(bmfu)
         return fams
 
+    #: Route prefixes whose POST/PATCH enqueue engine jobs — the set
+    #: per-tenant admission gates.  Serving routes (/serve/...) are
+    #: deliberately absent: the batcher has its own QueueFull
+    #: backpressure, and admin/observability mutations are not jobs.
+    _JOB_ROUTE_PREFIXES = (
+        "/dataset/", "/transform/", "/explore/", "/model/", "/train/",
+        "/tune/", "/evaluate/", "/predict/", "/function/", "/builder/",
+    )
+
+    def _is_job_route(self, path: str) -> bool:
+        prefix = self.config.api.api_prefix.rstrip("/")
+        if prefix and path.startswith(prefix):
+            path = path[len(prefix):]
+        return path.startswith(self._JOB_ROUTE_PREFIXES)
+
     def handle(self, verb: str, path: str, body: dict, query: dict,
                idem_key: str | None = None,
-               request_id: str | None = None):
+               request_id: str | None = None,
+               tenant: str | None = None):
         """Dispatch with the gateway budget enforced: request deadline
         (reference: krakend 10 s global timeout → 504), TTL response
         cache on opted-in GETs (300 s ``cache_ttl``), and per-route
@@ -2716,7 +2796,7 @@ class APIServer:
         if self._inflight is None:
             return self._handle_admitted(
                 verb, path, body, query, t0, _Slot(None), idem_key,
-                request_id,
+                request_id, tenant,
             )
         if not self._inflight.acquire(blocking=False):
             # Saturated: shed load NOW rather than queue behind
@@ -2731,14 +2811,15 @@ class APIServer:
             }
         return self._handle_admitted(
             verb, path, body, query, t0, _Slot(self._inflight),
-            idem_key, request_id,
+            idem_key, request_id, tenant,
         )
 
     def _handle_admitted(self, verb, path, body, query, t0, slot,
-                         idem_key=None, request_id=None):
+                         idem_key=None, request_id=None, tenant=None):
         try:
             return self._handle_slotted(
-                verb, path, body, query, t0, slot, idem_key, request_id
+                verb, path, body, query, t0, slot, idem_key,
+                request_id, tenant,
             )
         finally:
             # The slot frees only when its LAST owner releases: for a
@@ -2748,7 +2829,7 @@ class APIServer:
             slot.release()
 
     def _handle_slotted(self, verb, path, body, query, t0, slot,
-                        idem_key=None, request_id=None):
+                        idem_key=None, request_id=None, tenant=None):
         import time as _time
 
         handler, m, route_key, flags = self.router.resolve(verb, path)
@@ -2759,6 +2840,28 @@ class APIServer:
                 request_id=request_id,
             )
             return status, payload
+
+        # Per-tenant fair-share admission, checked at the gateway tier
+        # BEFORE the handler runs: a rejected request must not leave an
+        # orphan metadata document behind (the services write metadata
+        # before submitting the job).
+        if (
+            self.ctx.admission is not None
+            and verb in ("POST", "PATCH")
+            and self._is_job_route(path)
+        ):
+            try:
+                self.ctx.admission.check(tenant)
+            except QuotaExceeded as exc:
+                self._record_metric(
+                    route_key, 429,
+                    (_time.perf_counter() - t0) * 1e3,
+                    request_id=request_id,
+                )
+                return 429, {
+                    "error": str(exc),
+                    "retryAfter": exc.retry_after_s,
+                }
 
         ttl = self.config.api.cache_ttl_s
         cache_key = None
@@ -2832,7 +2935,11 @@ class APIServer:
                 if request_id else None
             )
             try:
-                result = self._handle_raw(handler, m, body, query)
+                # The tenant rides a contextvar for the same reason as
+                # the request id: engine.submit() below stamps it onto
+                # the job without every service signature changing.
+                with bind_tenant(tenant):
+                    result = self._handle_raw(handler, m, body, query)
             finally:
                 if token is not None:
                     obs_tracing.reset_request_id(token)
@@ -2908,6 +3015,17 @@ class APIServer:
                 query = {
                     k: v[0] for k, v in parse_qs(parsed.query).items()
                 }
+                # Tenant identity for fair-share admission: same
+                # header-safety rules as the request id, but a bad
+                # value is a 400 (silently reassigning a tenant would
+                # bill one tenant's jobs to another's quota).
+                tenant = (self.headers.get("X-Tenant") or "").strip()
+                if tenant and not self._RID_RE.fullmatch(tenant):
+                    self._send(400, {
+                        "error": "invalid X-Tenant header: expected "
+                                 "1-64 chars of [A-Za-z0-9_.-]",
+                    })
+                    return
                 body = {}
                 length = int(self.headers.get("Content-Length") or 0)
                 if length:
@@ -2921,6 +3039,7 @@ class APIServer:
                     verb, parsed.path, body, query,
                     idem_key=self.headers.get("X-Idempotency-Key"),
                     request_id=rid,
+                    tenant=tenant or None,
                 )
                 self._send(status, payload)
 

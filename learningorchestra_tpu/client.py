@@ -51,8 +51,14 @@ class Context:
     def __init__(self, cluster: str, port: int = 80,
                  prefix: str = "/api/learningOrchestra/v1",
                  failover: str | None = None,
-                 request_timeout: float = 330.0):
+                 request_timeout: float = 330.0,
+                 tenant: str | None = None):
         self.base = self._make_base(cluster, port) + prefix
+        # Tenant identity for per-tenant fair-share admission
+        # (jobs/cluster.py TenantAdmission): sent as X-Tenant on every
+        # request; the gateway may answer 429 + Retry-After when this
+        # tenant's queued/running quota is exhausted.
+        self.tenant = tenant
         # Standby address for automatic store failover (store/ha.py):
         # on a connection-level failure the client retries ONCE against
         # the standby and — mirroring mongo driver re-discovery — keeps
@@ -107,6 +113,7 @@ class Context:
         self.observability = _Observability(self)
         self.faults = _Faults(self)
         self.jobs = _Jobs(self)
+        self.cluster = _Cluster(self)
 
     # -- transport ----------------------------------------------------------
 
@@ -139,6 +146,30 @@ class Context:
 
     def request(self, verb: str, path: str, body: dict | None = None,
                 query: dict | None = None, raw: bool = False):
+        """One logical request with ONE bounded backpressure retry: a
+        429 (tenant quota, serving queue overflow) carries Retry-After
+        — honor it once (capped at 2 s so a misconfigured server can't
+        stall the client), then surface the second 429 to the caller.
+        A single retry is deliberate: quotas clear when the tenant's
+        own jobs finish, so retrying in a loop would just spin against
+        our own backlog."""
+        try:
+            return self._request_routed(verb, path, body, query, raw)
+        except ClientError as exc:
+            if exc.status != 429:
+                raise
+            delay = 0.5
+            if isinstance(exc.payload, dict):
+                try:
+                    delay = float(exc.payload.get("retryAfter") or delay)
+                except (TypeError, ValueError):
+                    pass
+            time.sleep(min(max(delay, 0.0), 2.0))
+            return self._request_routed(verb, path, body, query, raw)
+
+    def _request_routed(self, verb: str, path: str,
+                        body: dict | None = None,
+                        query: dict | None = None, raw: bool = False):
         qs = ""
         if query:
             qs = "?" + urllib.parse.urlencode(
@@ -245,6 +276,8 @@ class Context:
         headers = {"Content-Type": "application/json"}
         if idem_key:
             headers["X-Idempotency-Key"] = idem_key
+        if self.tenant:
+            headers["X-Tenant"] = self.tenant
         req = urllib.request.Request(
             base + path + qs,
             method=verb,
@@ -1189,6 +1222,21 @@ class _Jobs:
         journaled terminal transition.  409 when the job is already
         terminal."""
         return self.ctx.request("DELETE", f"/jobs/{name}")
+
+
+class _Cluster:
+    """Scale-out control plane (server jobs/cluster.py): engine
+    membership, dispatch claims and per-tenant admission counters."""
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+
+    def status(self) -> dict:
+        """GET /cluster/status — ``{"enabled", "engines", "claims"[,
+        "tenants"]}``.  Single-engine deployments answer 200 with
+        ``enabled: false`` rather than 404, so callers never need a
+        topology-aware special case."""
+        return self.ctx.request("GET", "/cluster/status")
 
 
 class _Observe:

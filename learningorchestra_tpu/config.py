@@ -622,6 +622,52 @@ class FaultsConfig:
 
 
 @dataclasses.dataclass
+class ClusterConfig:
+    """Scale-out control plane (jobs/cluster.py): N engine processes
+    over ONE store root share dispatch through a store-backed claim
+    table with heartbeat-renewed leases.  Requires the python store
+    backend (the claim table needs its WAL-refresh coherence
+    primitive); single-engine deployments leave it off and pay only a
+    None-check per dispatch."""
+
+    # Join the cluster at boot.  Env: LO_TPU_CLUSTER_ENABLED.
+    enabled: bool = False
+    # Stable engine identity in the claim table ("" derives
+    # engine-<pid>).  Two engines sharing an id would see each other's
+    # claims as their own — give each process a distinct one.
+    # Env: LO_TPU_CLUSTER_ENGINE_ID.
+    engine_id: str = ""
+    # Lease renewal cadence.  Env: LO_TPU_CLUSTER_HEARTBEAT_S.
+    heartbeat_s: float = 1.0
+    # A claim (or engine) whose heartbeat is older than this is dead
+    # and stealable.  Must comfortably exceed heartbeat_s; the two
+    # engines' clocks must agree to within it.
+    # Env: LO_TPU_CLUSTER_TTL_S.
+    ttl_s: float = 5.0
+    # Expired-claim sweep cadence.  Env: LO_TPU_CLUSTER_SWEEP_S.
+    sweep_s: float = 2.0
+
+
+@dataclasses.dataclass
+class TenantConfig:
+    """Per-tenant fair-share admission (jobs/cluster.py
+    TenantAdmission): quotas on the X-Tenant request header, enforced
+    at the API tier with 429 + Retry-After.  Under clustering the
+    counters live in the claim collection so every engine rejects
+    identically.  0 disables a quota."""
+
+    # Max queued-but-undispatched jobs per tenant.
+    # Env: LO_TPU_TENANT_MAX_QUEUED.
+    max_queued: int = 0
+    # Max concurrently RUNNING fits (executor/distributed classes)
+    # per tenant.  Env: LO_TPU_TENANT_MAX_RUNNING.
+    max_running: int = 0
+    # Retry-After seconds on a quota rejection.
+    # Env: LO_TPU_TENANT_RETRY_AFTER_S.
+    retry_after_s: float = 1.0
+
+
+@dataclasses.dataclass
 class Config:
     store: StoreConfig = dataclasses.field(default_factory=StoreConfig)
     api: APIConfig = dataclasses.field(default_factory=APIConfig)
@@ -657,6 +703,12 @@ class Config:
     ha: HAConfig = dataclasses.field(default_factory=HAConfig)
     faults: FaultsConfig = dataclasses.field(
         default_factory=FaultsConfig
+    )
+    cluster: ClusterConfig = dataclasses.field(
+        default_factory=ClusterConfig
+    )
+    tenant: TenantConfig = dataclasses.field(
+        default_factory=TenantConfig
     )
 
     @staticmethod
@@ -758,6 +810,30 @@ class Config:
         if "LO_TPU_JOB_JOURNAL_MAX" in env:
             cfg.jobs.journal_max_records = int(
                 env["LO_TPU_JOB_JOURNAL_MAX"]
+            )
+        if "LO_TPU_CLUSTER_ENABLED" in env:
+            cfg.cluster.enabled = _bool_env("LO_TPU_CLUSTER_ENABLED")
+        if "LO_TPU_CLUSTER_ENGINE_ID" in env:
+            cfg.cluster.engine_id = env["LO_TPU_CLUSTER_ENGINE_ID"]
+        if "LO_TPU_CLUSTER_HEARTBEAT_S" in env:
+            cfg.cluster.heartbeat_s = float(
+                env["LO_TPU_CLUSTER_HEARTBEAT_S"]
+            )
+        if "LO_TPU_CLUSTER_TTL_S" in env:
+            cfg.cluster.ttl_s = float(env["LO_TPU_CLUSTER_TTL_S"])
+        if "LO_TPU_CLUSTER_SWEEP_S" in env:
+            cfg.cluster.sweep_s = float(env["LO_TPU_CLUSTER_SWEEP_S"])
+        if "LO_TPU_TENANT_MAX_QUEUED" in env:
+            cfg.tenant.max_queued = int(
+                env["LO_TPU_TENANT_MAX_QUEUED"]
+            )
+        if "LO_TPU_TENANT_MAX_RUNNING" in env:
+            cfg.tenant.max_running = int(
+                env["LO_TPU_TENANT_MAX_RUNNING"]
+            )
+        if "LO_TPU_TENANT_RETRY_AFTER_S" in env:
+            cfg.tenant.retry_after_s = float(
+                env["LO_TPU_TENANT_RETRY_AFTER_S"]
             )
         if "LO_TPU_AOT_ENABLED" in env:
             cfg.aot.enabled = _bool_env("LO_TPU_AOT_ENABLED")

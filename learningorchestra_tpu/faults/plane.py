@@ -26,6 +26,9 @@ point                 call site
 ``train.epoch``       train/neural.py — top of every fit epoch
 ``replica.wal_ship``  store/replica.py — entry of every WAL-shipping sync
 ``store.ha.failover`` store/ha.py — entry of a standby's promotion
+``cluster.claim``     jobs/cluster.py — before every dispatch claim CAS
+``cluster.heartbeat`` jobs/cluster.py — entry of every lease renewal
+``cluster.steal``     jobs/cluster.py — before an expired-claim takeover
 ====================  =======================================================
 
 A **schedule** arms a point with one of three behaviors:
@@ -100,6 +103,9 @@ POINTS = (
     "store.ha.failover",
     "cache.aot_load",
     "cache.aot_store",
+    "cluster.claim",
+    "cluster.heartbeat",
+    "cluster.steal",
 )
 
 

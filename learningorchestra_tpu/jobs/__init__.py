@@ -7,6 +7,13 @@ from learningorchestra_tpu.jobs.cancel import (
     cancel_requested,
     current_cancel_token,
 )
+from learningorchestra_tpu.jobs.cluster import (
+    ClusterCoordinator,
+    QuotaExceeded,
+    TenantAdmission,
+    bind_tenant,
+    current_tenant,
+)
 from learningorchestra_tpu.jobs.engine import (
     JobDeadlineExceeded,
     JobEngine,
@@ -21,13 +28,17 @@ from learningorchestra_tpu.jobs.journal import (
 
 __all__ = [
     "CancelToken",
+    "ClusterCoordinator",
     "JobDeadlineExceeded",
     "JobEngine",
     "JobJournal",
     "JobState",
     "Preempted",
+    "QuotaExceeded",
     "StaleEpochError",
+    "TenantAdmission",
+    "bind_tenant",
     "cancel_requested",
     "current_attempt",
-    "current_cancel_token",
+    "current_tenant",
 ]

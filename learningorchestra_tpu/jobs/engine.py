@@ -26,6 +26,7 @@ This engine keeps that durable contract but adds what the reference lacks
 
 from __future__ import annotations
 
+import contextlib
 import contextvars
 import io
 import random
@@ -71,6 +72,14 @@ def _flight():
     from learningorchestra_tpu.obs import flight
 
     return flight
+
+
+def _current_tenant():
+    """The requesting tenant bound by the API tier, or None (lazy
+    import keeps jobs.cluster out of the raw-engine import path)."""
+    from learningorchestra_tpu.jobs.cluster import current_tenant
+
+    return current_tenant()
 
 
 def _bundle():
@@ -217,6 +226,24 @@ class JobEngine:
         # are fenced against the store's current engine epoch.  None
         # (raw engines, tests) disables both.
         self.journal = None
+        # Cluster coordinator (jobs/cluster.py): set by the service
+        # context when multi-engine dispatch is on.  Every dispatch
+        # must CLAIM its job in the store-backed claim table before
+        # running (a lost claim means a peer engine owns it — the
+        # body never starts here).  None keeps the single-engine hot
+        # path at one attribute check.
+        self.cluster = None
+        # Per-tenant admission counters (jobs/cluster.py
+        # TenantAdmission): set by the service context when tenant
+        # quotas are configured; the engine maintains queued/running
+        # counts at submit/dispatch/terminal.  None disables.
+        self.admission = None
+        # Nested tenant fairness state: per-class last-served tenant
+        # for the round-robin inside _pop_queued_locked.  The scan is
+        # gated on _tenant_seen so untenanted deployments keep the
+        # byte-identical popleft path.
+        self._tenant_rr: dict[str, str] = {}
+        self._tenant_seen = False
 
     def _journal(self, name: str, event: str, **fields) -> None:
         """Append one transition record; never raises (a journaling
@@ -304,6 +331,10 @@ class JobEngine:
         request_id = tracing.get_request_id()
         trace = tracing.new_trace(name, request_id)
         t_submit = time.monotonic()
+        # The requesting tenant (bound from the X-Tenant header at the
+        # API tier) rides into the queue entry for nested fair-share
+        # dispatch and into the metadata for attribution.
+        tenant = _current_tenant()
         # Persist the request parameters NOW, not only in the terminal
         # ledger record: a job killed mid-run (process death, store
         # failover) otherwise leaves no parameters anywhere, and the
@@ -314,6 +345,8 @@ class JobEngine:
             stamp["requestParameters"] = parameters
         if request_id:
             stamp["requestId"] = request_id
+        if tenant:
+            stamp["tenant"] = tenant
         if stamp:
             try:
                 self.artifacts.metadata.update(name, stamp)
@@ -341,8 +374,46 @@ class JobEngine:
                 self.journal.epoch if self.journal is not None
                 else None
             )
-            with jobs_cancel.bind(token), jobs_journal.stamp(epoch):
-                return _run_attempts()
+            # Cluster claim: in the multi-engine world a dispatch may
+            # only execute after winning the store-backed claim CAS —
+            # a lost claim means a peer engine owns this job (its own
+            # dispatch or a steal) and this future resolves None.  Any
+            # claim-path error (chaos, store wobble) is treated as
+            # LOST, never as a crash: the peer's copy still runs.
+            claim_ctx = contextlib.nullcontext()
+            if self.cluster is not None:
+                try:
+                    owned = self.cluster.claim(
+                        name, info.get("enqueued_at")
+                    )
+                except Exception:  # noqa: BLE001
+                    owned = False
+                if not owned:
+                    if self.admission is not None:
+                        self.admission.note_dequeued(tenant)
+                    _flight().record(
+                        "jobs", "claim_lost", job=name,
+                        jobClass=job_class,
+                    )
+                    logger.info(kv(job=name, state="claim_lost"))
+                    return None
+                from learningorchestra_tpu.jobs.cluster import bind_claim
+
+                claim_ctx = bind_claim(name)
+            if self.admission is not None:
+                self.admission.note_dispatch(tenant, job_class)
+            try:
+                with jobs_cancel.bind(token), \
+                        jobs_journal.stamp(epoch), claim_ctx:
+                    return _run_attempts()
+            finally:
+                if self.admission is not None:
+                    self.admission.note_done(tenant, job_class)
+                if self.cluster is not None:
+                    try:
+                        self.cluster.release(name)
+                    except Exception:  # noqa: BLE001 — release is
+                        pass  # best-effort; the lease TTL reclaims
 
         def _run_attempts() -> Any:
             meta = self.artifacts.metadata
@@ -671,7 +742,17 @@ class JobEngine:
             "deadline": deadline,
             "ctl": ctl,
             "token": token,
+            "tenant": tenant,
+            # Submit wall-time: the claim table's supersede rule
+            # compares it against a released claim's completion time
+            # to refuse re-running work a peer already finished.
+            "enqueued_at": time.time(),
         }
+        # Queued-quota accounting BEFORE the enqueue (the dispatcher
+        # may pop the entry the instant the lock drops; decrementing
+        # before incrementing would clamp at 0 and leak).
+        if self.admission is not None:
+            self.admission.note_queued(tenant)
         # Journal ahead of the in-memory enqueue (and outside the
         # engine lock — a late-shutdown append drains inline through
         # the store's collection lock, and nesting that under _lock
@@ -687,6 +768,8 @@ class JobEngine:
         with self._lock:
             refused = self._shutdown
             if not refused:
+                if tenant:
+                    self._tenant_seen = True
                 queue = self._queues.get(job_class)
                 if queue is None:
                     queue = self._queues[job_class] = deque()
@@ -697,6 +780,8 @@ class JobEngine:
                 self._prune_locked()
                 self._dispatch_locked()
         if refused:
+            if self.admission is not None:
+                self.admission.note_dequeued(tenant)
             # Same contract as handing the job to a shut-down
             # executor (the pre-fairness behavior) — but the journal
             # already holds this job's submitted/queued pair, so
@@ -790,8 +875,41 @@ class JobEngine:
                     del queue[i]
                     return runner, future, info
         self._warm_bypass[job_class] = 0
+        if self._tenant_seen:
+            picked = self._tenant_pick_locked(queue, job_class)
+            if picked is not None:
+                return picked
         runner, future, _wk, info = queue.popleft()
         return runner, future, info
+
+    def _tenant_pick_locked(self, queue: deque, job_class: str):
+        """Nested tenant round-robin INSIDE one class's WRR turn:
+        when the queue holds work from more than one tenant, serve
+        tenants in sorted cyclic order (per-class last-served
+        pointer), popping the chosen tenant's oldest entry — so one
+        tenant's flood delays, never starves, another tenant's jobs.
+        Returns None with a single (or no) tenant present, keeping
+        the plain-FIFO path byte-identical."""
+        tenants: list[str] = []
+        for _r, f, _wk, info in queue:
+            if f.cancelled():
+                continue
+            t = info.get("tenant") or ""
+            if t not in tenants:
+                tenants.append(t)
+        if len(tenants) <= 1:
+            return None
+        order = sorted(tenants)
+        last = self._tenant_rr.get(job_class, "")
+        pick = next((t for t in order if t > last), order[0])
+        self._tenant_rr[job_class] = pick
+        for i, (runner, future, _wk, info) in enumerate(queue):
+            if future.cancelled():
+                continue
+            if (info.get("tenant") or "") == pick:
+                del queue[i]
+                return runner, future, info
+        return None
 
     def _dispatch_locked(self) -> None:
         """Hand freed workers to queued jobs, class by class (WRR)."""
@@ -1055,15 +1173,14 @@ class JobEngine:
             future = self._futures.get(name)
             cancelled = future is not None and future.cancel()
             if cancelled:
-                cancelled_class = next(
-                    (
-                        cls
-                        for cls, queue in self._queues.items()
-                        for _r, f, _wk, _i in queue
-                        if f is future
-                    ),
-                    "unknown",
-                )
+                cancelled_class = "unknown"
+                cancelled_tenant = None
+                for cls, queue in self._queues.items():
+                    for _r, f, _wk, qinfo in queue:
+                        if f is future:
+                            cancelled_class = cls
+                            cancelled_tenant = qinfo.get("tenant")
+                            break
             if not cancelled:
                 rec = self._running_recs.get(name)
                 if rec is not None and not rec["released"]:
@@ -1077,6 +1194,10 @@ class JobEngine:
                     running_rec = rec
         # Store writes outside the engine lock.
         if cancelled:
+            if self.admission is not None:
+                # The entry left the queue without dispatching — the
+                # tenant's queued count must not leak.
+                self.admission.note_dequeued(cancelled_tenant)
             self._journal(name, "cancelled",
                           reason="cancelled while queued")
             self.artifacts.metadata.update(
@@ -1119,6 +1240,24 @@ class JobEngine:
                 for cls, q in self._queues.items()
                 if q or include_empty
             }
+
+    def queue_depths_by_tenant(self) -> dict[tuple, int]:
+        """Queued-but-undispatched jobs per ``(class, tenant)`` — the
+        per-tenant labels the metrics endpoint adds to
+        ``lo_jobs_queue_depth`` once any tenanted submission arrived
+        (empty dict otherwise, so untenanted deployments emit no
+        extra series)."""
+        with self._lock:
+            if not self._tenant_seen:
+                return {}
+            out: dict[tuple, int] = {}
+            for cls, q in self._queues.items():
+                for _r, f, _wk, info in q:
+                    if f.cancelled():
+                        continue
+                    key = (cls, info.get("tenant") or "")
+                    out[key] = out.get(key, 0) + 1
+            return out
 
     #: Post-cancel join grace inside a bounded shutdown drain: once
     #: the drain budget lapses and every outstanding token is flipped,
@@ -1197,17 +1336,21 @@ class JobEngine:
             stragglers = list(self._threads)
             for rec in self._running_recs.values():
                 rec["token"].cancel("engine shutdown drain deadline")
-            dropped: list[str] = []
+            dropped: list[tuple] = []
             for queue in self._queues.values():
                 for _runner, queued_future, _wk, qinfo in queue:
                     if queued_future.cancel():
-                        dropped.append(qinfo["name"])
+                        dropped.append(
+                            (qinfo["name"], qinfo.get("tenant"))
+                        )
                 queue.clear()
         # Same terminal metadata the explicit cancel() path writes —
         # without it the pre-created doc would sit at "pending"
         # forever (phantom jobs after restart).  Outside the lock:
         # store writes.
-        for name in dropped:
+        for name, drop_tenant in dropped:
+            if self.admission is not None:
+                self.admission.note_dequeued(drop_tenant)
             self._journal(name, "cancelled",
                           reason="shutdown drain deadline")
             try:

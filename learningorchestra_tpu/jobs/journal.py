@@ -162,11 +162,27 @@ class JobJournal:
     # model, not a runtime constraint).
     def __init__(self, documents: DocumentStore,
                  store_root: str | Path, *,
-                 enabled: bool = True, max_records: int = 4096):
+                 enabled: bool = True, max_records: int = 4096,
+                 epoch_lock=None):
         self.documents = documents
         self.store_root = Path(store_root)
         self.enabled = bool(enabled)
         self.max_records = int(max_records)
+        #: Zero-arg callable returning a context manager that holds
+        #: the CLUSTER's cross-process lock (services/context.py wires
+        #: the coordinator's guard in).  Epoch minting runs under it
+        #: so two engines booting concurrently over one store root
+        #: mint distinct epochs.  None → single-process boot, no lock.
+        self._epoch_lock = epoch_lock
+        #: Under clustering (jobs/cluster.py) the context sets these:
+        #: ``cluster`` delegates the fence to claim ownership, and
+        #: ``exclusive`` (a zero-arg guard factory refreshing the
+        #: journal collection) serializes cross-process appends so
+        #: two engines cannot allocate conflicting ``_id`` sequence
+        #: numbers.  Both None in the single-engine world — the hot
+        #: path pays one attribute check.
+        self.cluster = None
+        self.exclusive = None
         #: Appends that failed (store fault, disk full) — surfaced so
         #: a silently lossy journal is at least countable.
         self.dropped = 0
@@ -187,8 +203,13 @@ class JobJournal:
     # -- epoch fencing --------------------------------------------------------
 
     def _mint_epoch(self) -> int:
-        epoch = read_engine_epoch(self.store_root) + 1
-        write_engine_epoch(self.store_root, epoch)
+        lock = (
+            self._epoch_lock() if self._epoch_lock is not None
+            else contextlib.nullcontext()
+        )
+        with lock:
+            epoch = read_engine_epoch(self.store_root) + 1
+            write_engine_epoch(self.store_root, epoch)
         logger.info(kv(event="engine_epoch_minted", epoch=epoch))
         return epoch
 
@@ -210,6 +231,33 @@ class JobJournal:
         if stamped is None:
             stamped = current_stamp()
         if stamped is None:
+            return
+        if self.cluster is not None:
+            # Multi-engine world: two LIVE engines legitimately hold
+            # different durable epochs, so the single-process
+            # "newer epoch exists" comparison is wrong here.  The
+            # fence becomes claim OWNERSHIP: a cluster dispatch may
+            # commit only while its engine still owns the live claim
+            # under the stamped epoch — a stolen claim (partition,
+            # missed heartbeats) refuses the straggler's publication.
+            from learningorchestra_tpu.jobs.cluster import current_claim
+
+            claim = current_claim()
+            if claim is None:
+                return  # direct library use on a clustered store
+            if not self.cluster.verify(claim, stamped):
+                from learningorchestra_tpu.obs import flight as obs_flight
+
+                obs_flight.record(
+                    "cluster", "fence_refused", job=claim,
+                    engine=self.cluster.engine_id, epoch=stamped,
+                )
+                raise StaleEpochError(
+                    f"claim for job {claim!r} is no longer owned by "
+                    f"engine {self.cluster.engine_id!r} under epoch "
+                    f"{stamped} — the claim was stolen or released by "
+                    "a peer; refusing to commit"
+                )
             return
         durable = self.durable_epoch()
         if durable > stamped:
@@ -327,8 +375,19 @@ class JobJournal:
                 batch.append(self._pending.popleft())
             if not batch:
                 return 0
+            # Under clustering, appends run inside the coordinator's
+            # cross-process guard (flock + WAL refresh): two engines
+            # draining concurrently would otherwise allocate the same
+            # ``_id`` sequence numbers from stale in-memory tails.
+            guard = (
+                self.exclusive() if self.exclusive is not None
+                else contextlib.nullcontext()
+            )
             try:
-                self.documents.insert_many(JOURNAL_COLLECTION, batch)
+                with guard:
+                    self.documents.insert_many(
+                        JOURNAL_COLLECTION, batch
+                    )
             except Exception:  # noqa: BLE001
                 self.dropped += len(batch)
                 logger.error(kv(event="journal_append_failed",
@@ -366,10 +425,21 @@ class JobJournal:
         if not self.enabled:
             return {}
         self.flush()  # same-process readers see enqueued records
-        if not self.documents.collection_exists(JOURNAL_COLLECTION):
+        if self.exclusive is not None:
+            # Fold peer engines' appends in before reading (the guard
+            # refreshes the journal collection from its WAL).
+            with self.exclusive():
+                docs = list(
+                    self.documents.find(JOURNAL_COLLECTION)
+                ) if self.documents.collection_exists(
+                    JOURNAL_COLLECTION
+                ) else []
+        elif not self.documents.collection_exists(JOURNAL_COLLECTION):
             return {}
+        else:
+            docs = self.documents.find(JOURNAL_COLLECTION)
         out: dict = {}
-        for doc in self.documents.find(JOURNAL_COLLECTION):
+        for doc in docs:
             if doc.get("docType") != "journal" or not doc.get("job"):
                 continue
             job = doc["job"]

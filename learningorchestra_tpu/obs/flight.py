@@ -15,7 +15,10 @@ bounded, lock-cheap per-domain event rings recording
 - ``compile`` — compiled-program builds and AOT restores;
 - ``faults``  — every fault-point trigger the chaos plane fires;
 - ``locks``   — lock-witness contention waits and stall-watchdog
-  dumps.
+  dumps;
+- ``cluster`` — control-plane claim/renew/steal/fence-refused/
+  quota-reject decisions (jobs/cluster.py), each with the engine id
+  and epoch — a partition incident reads as one merged timeline.
 
 Every event is stamped with ``t`` (``time.monotonic()``), ``wall``
 (``time.time()``) and — when one is bound on the calling thread — the
@@ -58,7 +61,9 @@ __all__ = [
 #: The fixed domain set — one bounded ring each.  Adding a domain is a
 #: code change on purpose: rings are capacity planning, not a dict that
 #: grows per caller typo.
-DOMAINS = ("http", "decode", "jobs", "compile", "faults", "locks")
+DOMAINS = (
+    "http", "decode", "jobs", "compile", "faults", "locks", "cluster",
+)
 
 _lock = make_lock("flight._lock")
 #: None while disabled (the record() fast path is this one check);

@@ -75,6 +75,9 @@ CORE_FAMILIES = (
     "lo_serving_decode_tokens_total",
     "lo_serving_decode_active_streams",
     "lo_serving_decode_free_slots",
+    "lo_cluster_claims_total",
+    "lo_cluster_engines",
+    "lo_admission_rejections_total",
 )
 
 

@@ -108,6 +108,56 @@ class ServiceContext:
         # binary-overwrite paths notify so stale state is dropped
         # before the next read.
         self._artifact_change_listeners: list = []
+        # Scale-out control plane (jobs/cluster.py): when enabled, N
+        # engine processes over ONE store root share dispatch through
+        # the store-backed claim table.  Constructed BEFORE the
+        # journal so epoch minting runs under the cluster's
+        # cross-process lock (two engines booting concurrently must
+        # mint distinct epochs).  Requires the python store backend —
+        # the native backend has no WAL-refresh coherence primitive,
+        # so clustering is LOUDLY disabled rather than silently
+        # incoherent.
+        self.cluster = None
+        self.admission = None
+        if self.config.cluster.enabled:
+            if not hasattr(self.documents, "refresh"):
+                get_logger("context").error(
+                    "LO_TPU_CLUSTER_ENABLED requires the python "
+                    "store backend (LO_TPU_STORE_BACKEND=python): "
+                    "the native backend has no WAL-refresh coherence "
+                    "primitive — clustering DISABLED for this process"
+                )
+            else:
+                from learningorchestra_tpu.jobs.cluster import (
+                    ClusterCoordinator,
+                )
+
+                self.cluster = ClusterCoordinator(
+                    self.documents,
+                    self.config.store.store_path(),
+                    engine_id=self.config.cluster.engine_id,
+                    heartbeat_s=self.config.cluster.heartbeat_s,
+                    ttl_s=self.config.cluster.ttl_s,
+                    sweep_s=self.config.cluster.sweep_s,
+                )
+        # Per-tenant fair-share admission: constructed whenever a
+        # quota is configured; store-backed counters when clustered so
+        # every engine enforces identically.
+        if (
+            self.config.tenant.max_queued > 0
+            or self.config.tenant.max_running > 0
+        ):
+            from learningorchestra_tpu.jobs.cluster import (
+                TenantAdmission,
+            )
+
+            self.admission = TenantAdmission(
+                max_queued=self.config.tenant.max_queued,
+                max_running=self.config.tenant.max_running,
+                retry_after_s=self.config.tenant.retry_after_s,
+                cluster=self.cluster,
+            )
+        self.engine.admission = self.admission
         # Crash-durable job journal + engine-epoch fencing
         # (jobs/journal.py): construction mints this boot's engine
         # epoch, so any straggler from a previous life is refused at
@@ -120,15 +170,37 @@ class ServiceContext:
             self.config.store.store_path(),
             enabled=self.config.jobs.journal,
             max_records=self.config.jobs.journal_max_records,
+            epoch_lock=(
+                (lambda: self.cluster._guard(refresh=()))
+                if self.cluster is not None else None
+            ),
         )
         self.engine.journal = (
             self.journal if self.journal.enabled else None
         )
+        if self.cluster is not None:
+            # Wire the plane together: the coordinator publishes this
+            # boot's epoch on every claim; the journal's fence
+            # delegates to claim ownership and its appends/replays run
+            # under the cross-process guard; the engine claims before
+            # every dispatch.  join() starts heartbeat + sweep.
+            self.cluster.epoch = self.journal.epoch
+            self.cluster.on_steal = self._cluster_steal
+            self.cluster.on_engine_dead = self._cluster_engine_dead
+            if self.journal.enabled:
+                self.journal.cluster = self.cluster
+                self.journal.exclusive = self.cluster.journal_guard
+            self.engine.cluster = self.cluster
+            self.cluster.join()
         # Backend init FIRST: recovery may re-dispatch train fits,
         # and job threads racing first-time backend init deadlock
         # inside xla_bridge (the race _init_backend exists to remove).
         self._init_backend()
-        self.journal.prune()
+        if self.cluster is not None:
+            with self.cluster.journal_guard():
+                self.journal.prune()
+        else:
+            self.journal.prune()
         self._recover_jobs()
         # Durable warm start: restore the persisted AOT hot set into
         # the compile cache on a background thread, so recovered fits
@@ -194,6 +266,15 @@ class ServiceContext:
             if not meta or meta.get("jobState") not in (
                 "pending", "running"
             ):
+                continue
+            if (
+                self.cluster is not None
+                and not self.cluster.claimable(name)
+            ):
+                # A LIVE peer engine holds this job's claim: the job
+                # is running over there, not orphaned here — adopting
+                # it would be the double-run.  If that peer dies, the
+                # sweep steals the claim and resumes it then.
                 continue
             rec = journaled.get(name)
             # Re-enqueue order = pre-crash queue admission order (the
@@ -336,6 +417,107 @@ class ServiceContext:
         except Exception:  # noqa: BLE001 — startup must finish
             pass
 
+    def _cluster_steal(self, job: str, prev_engine: str) -> None:
+        """Sweep callback: this engine now owns a claim stolen from a
+        dead (or partitioned) peer.  Re-read the job's TRUE state from
+        the shared store and either close it out (the peer finished it
+        before dying) or resume it through the same checkpoint-resume
+        machinery boot recovery uses.  The stolen claim stays ours
+        across the re-dispatch (the dispatch-time claim() renews it),
+        so a revived straggler is fenced at its terminal commit."""
+        log = get_logger("context")
+        try:
+            if hasattr(self.documents, "refresh"):
+                # The dead peer's process wrote this job's collection;
+                # fold its WAL tail into our in-memory view first.
+                self.documents.refresh(job)
+            replayed = self.journal.replay()
+            rec = replayed.get(job)
+            if rec is not None and rec.get("terminal"):
+                # Finished/failed before the peer died — release the
+                # claim (its doneAt supersedes stale queue entries)
+                # and touch nothing.
+                self.cluster.release(job)
+                return
+            meta = self.artifacts.metadata.read(job)
+            if meta is None:
+                self.cluster.release(job)
+                return
+            kind = self._recoverable_kind(meta)
+            if kind is None:
+                self._orphan_job(job, journaled=rec is not None)
+                self.cluster.release(job)
+                return
+            self._redispatch(job, kind, (rec or {}).get("spec") or {})
+            log.warning(
+                f"stole job {job!r} from engine {prev_engine!r} "
+                f"(epoch {self.journal.epoch}): re-dispatched "
+                "through the checkpoint-resume path"
+            )
+        except Exception as exc:  # noqa: BLE001 — one bad adoption
+            # must not kill the sweep loop.
+            log.error(
+                f"could not adopt stolen job {job!r}: {exc!r} — "
+                "failing it orphaned-by-restart"
+            )
+            try:
+                self._orphan_job(job, journaled=True,
+                                 detail=repr(exc))
+                self.cluster.release(job)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _cluster_engine_dead(self, engine_id: str, epoch: int) -> None:
+        """Sweep callback: a peer engine's membership expired.  Its
+        RUNNING jobs are adopted by the steal path (they hold claims);
+        this adopts its QUEUED-but-never-claimed jobs — journaled
+        under the dead epoch, non-terminal, no live claim — in
+        pre-crash queue order.  A racing duplicate (the 'dead' engine
+        was only partitioned and still dispatches its copy) is safe:
+        both race the dispatch-time claim CAS and exactly one runs."""
+        log = get_logger("context")
+        try:
+            replayed = self.journal.replay()
+        except Exception:  # noqa: BLE001
+            return
+        work = sorted(
+            (
+                (rec.get("seq", -1), job, rec)
+                for job, rec in replayed.items()
+                if rec.get("epoch") == epoch
+                and not rec.get("terminal")
+                and rec.get("state") in ("submitted", "queued")
+            ),
+            key=lambda t: (t[0], t[1]),
+        )
+        for _seq, job, rec in work:
+            if not self.cluster.claimable(job):
+                continue
+            try:
+                if hasattr(self.documents, "refresh"):
+                    self.documents.refresh(job)
+                meta = self.artifacts.metadata.read(job)
+                kind = (
+                    self._recoverable_kind(meta)
+                    if meta is not None else None
+                )
+                if kind is None:
+                    if meta is not None and meta.get("jobState") in (
+                        "pending", "running"
+                    ):
+                        self._orphan_job(job, journaled=True)
+                    continue
+                self._redispatch(job, kind, rec.get("spec") or {})
+                log.warning(
+                    f"adopted queued job {job!r} from dead engine "
+                    f"{engine_id!r} (epoch {epoch})"
+                )
+            except Exception as exc:  # noqa: BLE001
+                log.error(
+                    f"could not adopt queued job {job!r} from dead "
+                    f"engine {engine_id!r}: {exc!r}"
+                )
+
     def require_current_epoch(self) -> None:
         """Epoch fence at artifact-publication time: a job body from a
         stale engine epoch (pre-crash straggler, or a partitioned
@@ -472,8 +654,13 @@ class ServiceContext:
         )
         # Journal AFTER the engine (shutdown journals its cancelled
         # drops), BEFORE the store (a drain into closed WAL handles
-        # would drop every record).
+        # would drop every record).  The cluster leaves after the
+        # journal's final drain (its guard serializes that drain) and
+        # before the store closes (retracting the membership document
+        # is a store write).
         self.journal.close()
+        if self.cluster is not None:
+            self.cluster.close()
         self.documents.close()
 
     # -- validation helpers shared by services --------------------------------

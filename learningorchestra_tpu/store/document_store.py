@@ -89,19 +89,35 @@ def _match(doc: dict, query: dict | None) -> bool:
 class _Collection:
     def __init__(self, path: Path, durable: bool):
         self.path = path
+        self._path_str = str(path)
         self.durable = durable
         self.lock = make_rlock("_Collection.lock")
         self.docs: dict[int, dict] = {}
         self.next_id = 0
         self._fh = None
+        self._replayed_off = 0
         if path.exists():
             self._replay()
         self._open_log()
 
-    def _replay(self) -> None:
+    def _apply(self, op: dict) -> None:
         # next_id must stay monotonic across deletes, so it tracks the max
         # _id ever inserted, not the max surviving doc.
-        max_seen = -1
+        kind = op["op"]
+        if kind == "i":
+            doc = op["d"]
+            self.docs[doc["_id"]] = doc
+            self.next_id = max(self.next_id, doc["_id"] + 1)
+        elif kind == "u":
+            _id = op["id"]
+            if _id in self.docs:
+                self.docs[_id].update(op["d"])
+        elif kind == "d":
+            self.docs.pop(op["id"], None)
+        elif kind == "n":
+            self.next_id = max(self.next_id, op["v"])
+
+    def _replay(self) -> None:
         data = self.path.read_bytes()
         off = 0
         good_end = 0  # byte offset after the last complete valid record
@@ -127,19 +143,7 @@ class _Collection:
                 # nothing valid follows (checked below).
                 torn_at = off
                 break
-            kind = op["op"]
-            if kind == "i":
-                doc = op["d"]
-                self.docs[doc["_id"]] = doc
-                max_seen = max(max_seen, doc["_id"])
-            elif kind == "u":
-                _id = op["id"]
-                if _id in self.docs:
-                    self.docs[_id].update(op["d"])
-            elif kind == "d":
-                self.docs.pop(op["id"], None)
-            elif kind == "n":
-                max_seen = max(max_seen, op["v"] - 1)
+            self._apply(op)
             good_end = end
             off = end
         if torn_at is not None:
@@ -165,7 +169,53 @@ class _Collection:
             # the new record too).
             with open(self.path, "r+b") as fh:
                 fh.truncate(good_end)
-        self.next_id = max_seen + 1
+        self._replayed_off = good_end
+
+    def catch_up(self) -> None:
+        """Fold in records appended to the WAL since our last replay —
+        the cheap half of cross-process coherence (store.refresh).
+        Only the UNSEEN tail is read, so a no-change call costs one
+        stat.  Our own appends since the last catch-up re-apply
+        idempotently (file order IS the serialized history; last write
+        per field wins either way).  A torn tail (a peer crashed
+        mid-append) stops the scan without truncating — the surviving
+        peer's next append runs through ITS recovery, not ours."""
+        # Lock-free early exit: callers serialize cross-process under
+        # the cluster file lock, and a concurrent IN-process append is
+        # already in our doc map (re-applying it later is idempotent),
+        # so a stale size check can never lose a peer's record.
+        try:
+            size = os.stat(self._path_str).st_size
+        except FileNotFoundError:
+            return
+        if size <= self._replayed_off:
+            return
+        with self.lock:
+            with open(self.path, "rb") as fh:
+                fh.seek(self._replayed_off)
+                data = fh.read()
+            off = self._replayed_off
+            good_end = off
+            for raw in data.splitlines(keepends=True):
+                end = off + len(raw)
+                stripped = raw.strip()
+                if not stripped:
+                    if raw.endswith(b"\n"):
+                        good_end = end
+                    off = end
+                    continue
+                op = None
+                if raw.endswith(b"\n"):
+                    try:
+                        op = json.loads(stripped)
+                    except ValueError:
+                        op = None
+                if not isinstance(op, dict) or "op" not in op:
+                    break  # torn tail: re-scan from here next time
+                self._apply(op)
+                good_end = end
+                off = end
+            self._replayed_off = good_end
 
     def _open_log(self) -> None:
         self.path.parent.mkdir(parents=True, exist_ok=True)
@@ -210,7 +260,12 @@ class DocumentStore:
 
     def collection_exists(self, name: str) -> bool:
         with self._lock:
-            return name in self._collections
+            if name in self._collections:
+                return True
+        # A collection refresh() popped is still on disk: it EXISTS,
+        # the next _get just replays it (multi-process coherence must
+        # not make a collection flicker out of existence).
+        return (self.root / f"{name}.wal").exists()
 
     def list_collections(self) -> list[str]:
         with self._lock:
@@ -220,10 +275,14 @@ class DocumentStore:
         with self._lock:
             coll = self._collections.get(name)
             if coll is None:
-                if not create:
+                path = self.root / f"{name}.wal"
+                if not create and not path.exists():
                     raise NoSuchCollection(name)
                 self._validate_name(name)
-                coll = _Collection(self.root / f"{name}.wal", self.durable)
+                # Replays the WAL when the file exists — how a
+                # collection a PEER process created becomes readable
+                # here without an explicit open.
+                coll = _Collection(path, self.durable)
                 self._collections[name] = coll
             return coll
 
@@ -238,6 +297,26 @@ class DocumentStore:
         except FileNotFoundError:
             pass
         return True
+
+    def refresh(self, name: str) -> None:
+        """Re-read a collection from its WAL, picking up records other
+        PROCESSES appended since we last opened it.
+
+        The store's in-memory map is authoritative within one process;
+        when several processes share a store root (the multi-engine
+        control plane, jobs/cluster.py), each serializes its mutations
+        under a cross-process file lock and calls this on entry so it
+        folds the others' appends before reading or writing.  Safe to
+        call for a collection this process has never opened (the next
+        ``_get`` replays the file) or that does not exist at all.
+        """
+        with self._lock:
+            coll = self._collections.get(name)
+        if coll is None:
+            # Never opened in this process: the next _get replays the
+            # file from disk (peer-created collections included).
+            return
+        coll.catch_up()
 
     # -- writes ---------------------------------------------------------------
 
@@ -299,6 +378,30 @@ class DocumentStore:
             doc = coll.docs.get(_id)
             if doc is None:
                 return False
+            fields = dict(fields)
+            fields.pop("_id", None)
+            doc.update(fields)
+            coll._append({"op": "u", "id": _id, "d": fields})
+            return True
+
+    def compare_and_update(self, name: str, _id: int, expect: dict,
+                           fields: dict) -> bool:
+        """Atomic compare-and-swap on one document: apply ``fields``
+        only if every ``expect`` item currently matches, under the
+        collection lock.  The claim table's takeover primitive
+        (jobs/cluster.py): two engines racing an expired claim both
+        read the same stale owner, but only one CAS lands."""
+        try:
+            coll = self._get(name)
+        except NoSuchCollection:
+            return False
+        with coll.lock:
+            doc = coll.docs.get(_id)
+            if doc is None:
+                return False
+            for key, val in expect.items():
+                if doc.get(key) != val:
+                    return False
             fields = dict(fields)
             fields.pop("_id", None)
             doc.update(fields)

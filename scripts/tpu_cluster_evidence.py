@@ -1,0 +1,32 @@
+"""Control-plane claim-path evidence: one JSON row for the writeup.
+
+Runs bench.py's `_claim_probe` — store-backed claim CAS, heartbeat
+renewal, full claim+release cycle, and a minimal no-op dispatch on the
+same box — and prints the shares the acceptance bar is stated in
+(claim-path overhead <= 5% of a minimal dispatch).  The probe is
+platform-independent (no device work), but runs in the watch chain so
+the number is banked on the SAME host and load profile as the rest of
+the round's evidence.
+"""
+
+import json
+import os
+import sys
+from pathlib import Path
+
+# The dispatch floor runs ~1k no-op jobs; per-job INFO lines would
+# swamp the banked log without adding evidence.
+os.environ.setdefault("LO_TPU_LOG_LEVEL", "WARNING")
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench  # noqa: E402
+
+
+def main() -> None:
+    out = bench._claim_probe()
+    print(json.dumps({"metric": "cluster_claim_probe", **out}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

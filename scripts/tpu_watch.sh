@@ -61,6 +61,7 @@ STAGES=(
   "scripts/tpu_flight_evidence.py:300"
   "scripts/tpu_warmboot_evidence.py:300"
   "scripts/tpu_decode_evidence.py:300"
+  "scripts/tpu_cluster_evidence.py:300"
   "scripts/tpu_recovery_smoke.py:600"
   "scripts/tpu_quick_evidence.py:900"
   "scripts/tpu_validate_r2.py:2700"

@@ -28,6 +28,8 @@ STAGES = [
     ("tpu_flight_evidence", "Flight-recorder append-cost probe"),
     ("tpu_warmboot_evidence", "Warm-boot probe (AOT cache vs cold trace)"),
     ("tpu_decode_evidence", "Streaming decode probe (continuous batching vs solo)"),
+    ("tpu_cluster_evidence",
+     "Control-plane claim-path probe (share of a minimal dispatch)"),
     ("tpu_recovery_smoke", "Kill-9 recovery drill (journal resume)"),
     ("tpu_quick_evidence", "Quick evidence (headline numbers)"),
     ("tpu_validate_r2", "Round-2 backlog validation"),
@@ -56,6 +58,36 @@ def _json_rows(path: Path) -> list[str]:
     return rows
 
 
+def _cluster_highlight(rows: list[str]) -> list[str]:
+    """Surface the control-plane acceptance number from bench's row:
+    claim-path overhead as a fraction of a minimal dispatch
+    (bench.py `_claim_probe`, banked under the `cluster` key)."""
+    for line in reversed(rows):
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(doc, dict):
+            continue
+        # bench banks the probe under "cluster"; the dedicated
+        # tpu_cluster_evidence stage prints it at top level.
+        probe = doc.get("cluster")
+        if not isinstance(probe, dict):
+            probe = doc
+        if (
+            isinstance(probe, dict)
+            and "claim_share_of_dispatch_pct" in probe
+        ):
+            return [
+                f"Claim-path overhead: {probe.get('claim_us')} us/claim "
+                f"= {probe['claim_share_of_dispatch_pct']}% of a "
+                "minimal dispatch (acceptance bar: <= 5%); full "
+                f"claim+release cycle {probe.get('cycle_us')} us.",
+                "",
+            ]
+    return []
+
+
 def build_section() -> str:
     stamp = datetime.now(timezone.utc).strftime("%Y-%m-%d %H:%M UTC")
     out = [BEGIN,
@@ -76,6 +108,8 @@ def build_section() -> str:
         out.extend(rows[-60:])  # sweeps print one row per point
         out.append("```")
         out.append("")
+        if stem in ("bench", "tpu_cluster_evidence"):
+            out.extend(_cluster_highlight(rows))
     if not any_rows:
         out.append("_No stage has produced results yet._")
         out.append("")

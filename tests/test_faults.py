@@ -1042,6 +1042,96 @@ class TestReplicationChaos:
         assert faults.triggers("store.ha.failover") == 1
 
 
+# -- cluster control plane: claim / heartbeat / steal ------------------------
+
+
+class TestClusterChaos:
+    """Chaos on the scale-out control plane (jobs/cluster.py): claim
+    failures must resolve to LOST (the peer's copy runs), heartbeat
+    and steal wobbles must heal on the next tick — never crash an
+    engine."""
+
+    def _coordinator(self, store, **kw):
+        from learningorchestra_tpu.jobs.cluster import ClusterCoordinator
+
+        kw.setdefault("heartbeat_s", 30.0)
+        kw.setdefault("ttl_s", 60.0)
+        kw.setdefault("sweep_s", 30.0)
+        # No join(): tests drive claim/heartbeat/sweep directly so the
+        # seeded schedules hit deterministic call counts.
+        return ClusterCoordinator(store, store.root, **kw)
+
+    def test_injected_claim_error_resolves_to_lost(self, artifacts):
+        """An armed cluster.claim error rides a REAL engine dispatch:
+        the job's future resolves None (claim lost — in production the
+        peer that owns the claim runs the body) and the engine worker
+        survives to run the next, unfaulted dispatch."""
+        from learningorchestra_tpu.jobs import JobEngine
+
+        eng = JobEngine(artifacts, max_workers=1)
+        eng.cluster = self._coordinator(
+            artifacts.documents, engine_id="chaos-a"
+        )
+        try:
+            faults.arm("cluster.claim", "error", max_triggers=1)
+            artifacts.metadata.create("chaos_claim1", "train/x")
+            eng.submit("chaos_claim1", lambda: "never")
+            assert eng.wait("chaos_claim1", timeout=30) is None
+            assert faults.triggers("cluster.claim") == 1
+            # Same engine, fault exhausted: claim lands, body runs.
+            artifacts.metadata.create("chaos_claim2", "train/x")
+            eng.submit("chaos_claim2", lambda: "ok")
+            assert eng.wait("chaos_claim2", timeout=30) == "ok"
+            assert eng.cluster.verify("chaos_claim1") is False
+        finally:
+            eng.shutdown()
+            eng.cluster.close()
+
+    def test_injected_heartbeat_error_next_tick_renews(self, tmp_store):
+        """A heartbeat-tick fault is one missed renewal, absorbed by
+        the lease TTL margin — the next tick renews every live claim
+        (the daemon loop catches per-tick exceptions the same way)."""
+        from learningorchestra_tpu.faults import FaultInjected
+
+        coord = self._coordinator(tmp_store, engine_id="chaos-hb")
+        try:
+            assert coord.claim("chaos_hb_job")
+            faults.arm("cluster.heartbeat", "error", max_triggers=1)
+            with pytest.raises(FaultInjected):
+                coord.heartbeat()
+            assert coord.heartbeat() == 1  # renewed the live claim
+            assert faults.triggers("cluster.heartbeat") == 1
+        finally:
+            coord.close()
+
+    def test_injected_steal_error_next_sweep_finishes(self, tmp_store):
+        """A sweeper crashing mid-steal leaves the claim with its
+        (dead) owner; the NEXT sweep completes the takeover in the
+        same claim order — no claim is ever half-stolen."""
+        from learningorchestra_tpu.faults import FaultInjected
+
+        dead = self._coordinator(tmp_store, engine_id="chaos-dead")
+        thief = self._coordinator(
+            tmp_store, engine_id="chaos-thief", ttl_s=0.05
+        )
+        try:
+            assert dead.claim("chaos_steal_job")
+            time.sleep(0.12)  # lease idles past the thief's TTL
+            faults.arm("cluster.steal", "error", max_triggers=1)
+            with pytest.raises(FaultInjected):
+                thief.sweep()
+            # Interrupted steal: ownership unchanged.
+            assert dead.verify("chaos_steal_job") is True
+            stolen = thief.sweep()  # fault exhausted
+            assert ("chaos_steal_job", "chaos-dead") in stolen
+            assert thief.verify("chaos_steal_job") is True
+            assert dead.verify("chaos_steal_job") is False
+            assert faults.triggers("cluster.steal") == 1
+        finally:
+            dead.close()
+            thief.close()
+
+
 # -- bench probe -------------------------------------------------------------
 
 
