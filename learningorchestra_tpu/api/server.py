@@ -1396,9 +1396,11 @@ class APIServer:
 
         def serve_replicas_post(m, body, query):
             """Create/resize a model's replica set: any of ``min``,
-            ``max`` (autoscaler bounds) and ``count`` (manual scale,
-            clamped to the bounds).  Leases chips per replica;
-            an exhausted pool surfaces as the LeaseTimeout 503."""
+            ``max`` (autoscaler bounds), ``count`` (manual scale,
+            clamped to the bounds) and ``devicesPerReplica`` (chips
+            each replica leases; > 1 shards the params across the
+            slice).  Leases chips per replica; an exhausted pool
+            surfaces as the LeaseTimeout 503."""
             body = body or {}
 
             def _int(key):
@@ -1413,13 +1415,16 @@ class APIServer:
                     ) from None
 
             mn, mx, count = _int("min"), _int("max"), _int("count")
-            if mn is None and mx is None and count is None:
+            dpr = _int("devicesPerReplica")
+            if mn is None and mx is None and count is None and (
+                    dpr is None):
                 raise ValidationError(
-                    "body needs at least one of 'min', 'max', 'count'"
+                    "body needs at least one of 'min', 'max', "
+                    "'count', 'devicesPerReplica'"
                 )
             return 200, self.serving.fleet.configure(
                 m.group("name"), min_replicas=mn, max_replicas=mx,
-                count=count,
+                count=count, devices_per_replica=dpr,
             )
 
         add("POST", rf"/serve/{NAME}/replicas", serve_replicas_post)

@@ -925,17 +925,23 @@ class _Serve:
 
     def replicas(self, model: str) -> dict:
         """GET /serve/<model>/replicas — the model's replica set:
-        per-replica device, queue depth, request counts, plus the
-        min/max autoscaler bounds; 404 until a set exists."""
+        per-replica device LIST (multi-chip replicas lease a slice)
+        and shard spec, queue depth, request counts, plus the min/max
+        autoscaler bounds and chips-per-replica; 404 until a set
+        exists."""
         return self.ctx.request("GET", f"/serve/{model}/replicas")
 
     def scale(self, model: str, *, count: int | None = None,
               min_replicas: int | None = None,
-              max_replicas: int | None = None) -> dict:
+              max_replicas: int | None = None,
+              devices_per_replica: int | None = None) -> dict:
         """POST /serve/<model>/replicas — create/resize the model's
         replica set: ``min``/``max`` set the autoscaler bounds,
-        ``count`` scales manually (clamped to the bounds).  Each
-        replica pins a chip through the lease pool; an exhausted pool
+        ``count`` scales manually (clamped to the bounds),
+        ``devices_per_replica`` sets the chips each replica leases
+        (> 1 shards the params across the slice for models bigger
+        than one chip; fixed while the set is live).  Each replica
+        pins its chips through the lease pool; an exhausted pool
         surfaces as 503 + Retry-After."""
         body: dict = {}
         if count is not None:
@@ -944,6 +950,8 @@ class _Serve:
             body["min"] = min_replicas
         if max_replicas is not None:
             body["max"] = max_replicas
+        if devices_per_replica is not None:
+            body["devicesPerReplica"] = devices_per_replica
         return self.ctx.request(
             "POST", f"/serve/{model}/replicas", body
         )

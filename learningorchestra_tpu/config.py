@@ -291,6 +291,30 @@ class FleetConfig:
     # Router RNG seed (P2C is seeded-deterministic, like the fault
     # plane's schedules).
     router_seed: int = 0
+    # Chips leased per replica (deployment default; override per model
+    # via POST /serve/<model>/replicas devicesPerReplica).  > 1 makes
+    # every replica a multi-chip SHARD GROUP: params place across its
+    # devices (serve/fleet/replicaset.py) — models bigger than one
+    # chip serve through the same P2C/autoscaler path.
+    # Env: LO_TPU_FLEET_DEVICES_PER_REPLICA.
+    devices_per_replica: int = 1
+
+
+@dataclasses.dataclass
+class MPMDConfig:
+    """MPMD pipeline-parallel training (parallel/mpmd.py): per-stage
+    compiled programs driven by a host-side 1F1B dispatcher.  Env
+    knobs: LO_TPU_MPMD_*."""
+
+    # Deployment-default pipeline schedule for PipelinedTransformer
+    # when the job doesn't pass one: "" keeps the estimator default
+    # (gpipe); "gpipe" | "1f1b" | "mpmd" force it fleet-wide.
+    # Env: LO_TPU_MPMD_SCHEDULE.
+    schedule: str = ""
+    # Default microbatch count when the job doesn't pass
+    # n_microbatches; 0 = auto (2 × pipeline stages).
+    # Env: LO_TPU_MPMD_MICRO.
+    n_micro: int = 0
 
 
 @dataclasses.dataclass
@@ -681,6 +705,7 @@ class Config:
         default_factory=DecodeConfig
     )
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
+    mpmd: MPMDConfig = dataclasses.field(default_factory=MPMDConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     rollup: RollupConfig = dataclasses.field(
         default_factory=RollupConfig
@@ -899,6 +924,26 @@ class Config:
             cfg.fleet.lease_timeout_s = float(
                 env["LO_TPU_FLEET_LEASE_TIMEOUT_S"]
             )
+        if "LO_TPU_FLEET_DEVICES_PER_REPLICA" in env:
+            cfg.fleet.devices_per_replica = int(
+                env["LO_TPU_FLEET_DEVICES_PER_REPLICA"]
+            )
+        if cfg.fleet.devices_per_replica < 1:
+            raise ValueError(
+                "LO_TPU_FLEET_DEVICES_PER_REPLICA must be >= 1, got "
+                f"{cfg.fleet.devices_per_replica}"
+            )
+        if "LO_TPU_MPMD_SCHEDULE" in env:
+            cfg.mpmd.schedule = env["LO_TPU_MPMD_SCHEDULE"].strip()
+        if cfg.mpmd.schedule not in ("", "gpipe", "1f1b", "mpmd"):
+            # Loud at boot, not deep inside the first pipeline fit.
+            raise ValueError(
+                "LO_TPU_MPMD_SCHEDULE must be one of gpipe|1f1b|mpmd "
+                f"(or empty for the estimator default), got "
+                f"{cfg.mpmd.schedule!r}"
+            )
+        if "LO_TPU_MPMD_MICRO" in env:
+            cfg.mpmd.n_micro = int(env["LO_TPU_MPMD_MICRO"])
         if not 1 <= cfg.fleet.min_replicas <= cfg.fleet.max_replicas:
             # Loud at BOOT, like the boolean knobs: deferred, these
             # bounds first fail inside a predict's lazy ReplicaSet

@@ -94,6 +94,13 @@ class ProgramCost:
     built_s: float = 0.0
     builds: int = 0
     analyzed: bool = False
+    # True when the analyzed lowering is collective-free by
+    # construction (single-device MPMD stage programs, host-avatar
+    # serve probes): the flops/bytes are pure compute, so MFU and
+    # lo_serving_bucket_* derived from them stay honest for multi-chip
+    # programs — a whole-mesh lowering's collective FLOPs would
+    # inflate both.
+    collectives_excluded: bool = False
     created_at: float = dataclasses.field(default_factory=time.time)
 
     @property
@@ -120,6 +127,7 @@ class ProgramCost:
             "builtS": round(self.built_s, 4),
             "builds": self.builds,
             "analyzed": self.analyzed,
+            "collectivesExcluded": self.collectives_excluded,
         }
 
 
@@ -160,10 +168,13 @@ class CostLedger:
 
     def record_analysis(self, key: str, label: str | None, *,
                         flops=None, bytes_accessed=None, memory=None,
-                        serialized=None, analysis_s: float = 0.0
+                        serialized=None, analysis_s: float = 0.0,
+                        collectives_excluded: bool = False
                         ) -> ProgramCost:
         with self._lock:
             cost = self._entry_locked(key, label or "")
+            if collectives_excluded:
+                cost.collectives_excluded = True
             if flops is not None:
                 cost.flops = float(flops)
             if bytes_accessed is not None:
@@ -561,7 +572,9 @@ def _flatten_cost_analysis(raw):
 
 def analyze_jitted(key: str, label: str | None, fn,
                    example_args: tuple, *,
-                   aot_eligible: bool = True) -> ProgramCost | None:
+                   aot_eligible: bool = True,
+                   collectives_excluded: bool = False
+                   ) -> ProgramCost | None:
     """Run XLA cost (and, deep, memory/size) analysis for the program
     ``fn(*example_args)`` and record it under ``key``.
 
@@ -619,6 +632,7 @@ def analyze_jitted(key: str, label: str | None, fn,
         memory=memory,
         serialized=serialized,
         analysis_s=time.perf_counter() - t0,
+        collectives_excluded=collectives_excluded,
     )
     if aot_eligible and payload is not None:
         _offer_aot(key, label, payload)

@@ -1,0 +1,825 @@
+"""MPMD pipeline dispatch: every stage is its OWN compiled program.
+
+The SPMD schedules in parallel/pipeline.py compile the whole pipeline
+(fwd + bwd + optimizer for all stages) into ONE program — correct, but
+the program's identity bakes in the full model, so a multi-chip fit
+can never share compiles across jobs and was the explicit AOT-store
+carve-out (a single executable spanning a mesh can't be serialized
+per device).  "Scaling Deep Learning Training with MPMD Pipeline
+Parallelism" (PAPERS.md) shows the alternative the TPU runtime already
+supports: give each stage its own small program on its own chip and
+let a host-side dispatcher run the microbatch schedule.
+
+What that buys here:
+
+- **Per-stage fingerprints.**  Each stage/embed/head program goes
+  through ``CompiledProgramCache`` under its own key (module
+  fingerprint + stage index + microbatch shape), so stage compiles are
+  shared across jobs with the same architecture and — being
+  single-device programs — are AOT-serializable: warm boot
+  (train/aot_store.py) now covers multi-chip fits.
+- **Overlap from enqueue order.**  JAX dispatch is async and each
+  device executes its queue in FIFO order, so the host 1F1B loop below
+  IS the schedule: enqueueing stage s's tick-t work before stage
+  s+1's makes compute overlap the inter-stage ``device_put`` activation
+  hops without any collective in any program.
+- **Stage-partitioned state.**  Params/opt live as per-stage subtrees
+  committed to their stage device: ``(embed, (stage_0, ..), head)``.
+  Checkpoints write one orbax directory per partition and publish one
+  top-level marker, so the PR-15 journal resume path restores every
+  stage from its newest step after a SIGKILL.
+
+The math is the SPMD 1F1B schedule's exactly: per-microbatch cotangent
+seeds scaled ``w_m / gw`` (global masked-mean loss), rematerialize-in-
+backward via ``jax.vjp`` on the saved stage input, one adam step per
+batch from f32 master weights (optax adam is leafwise, so P+2
+per-partition optimizer states step identically to one stacked state).
+``tests/test_mpmd.py`` pins MPMD-vs-SPMD loss parity.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+__all__ = ["MPMDEngine", "stage_devices", "partition_names"]
+
+
+def stage_devices(mesh, n_stages: int) -> list:
+    """One device per pipeline stage: walk the ``pp`` axis of the
+    owner's mesh at index 0 of every other axis.  MPMD ignores the dp
+    axis — scale batch via bigger microbatches instead."""
+    names = list(mesh.axis_names)
+    pp_ax = names.index("pp")
+    grid = mesh.devices
+    out = []
+    idx = [0] * grid.ndim
+    for s in range(n_stages):
+        idx[pp_ax] = s
+        out.append(grid[tuple(idx)])
+    return out
+
+
+def partition_names(n_stages: int) -> list[str]:
+    """Checkpoint sub-directory names, in pipeline order."""
+    return (
+        ["embed"]
+        + [f"stage_{s:02d}" for s in range(n_stages)]
+        + ["head"]
+    )
+
+
+def _tree_avatars(tree):
+    return jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(np.shape(l), l.dtype), tree
+    )
+
+
+def _is_partitioned(middle) -> bool:
+    """Stage params/opt arrive either STACKED (one subtree with a
+    leading (pp,) axis — the SPMD layout and the host layout pickles
+    carry) or PARTITIONED (a list/tuple with one subtree per stage —
+    this engine's layout)."""
+    return isinstance(middle, (list, tuple))
+
+
+class _ProgramSet:
+    """The cached per-shape program handles one fit (or predict shape)
+    uses, plus their cache keys for cost aggregation."""
+
+    def __init__(self, sig):
+        self.sig = sig
+        self.fns: dict = {}
+        self.keys: dict = {}
+
+
+class MPMDEngine:
+    """Host-side MPMD dispatcher bound to one ``PipelinedTransformer``.
+
+    Owns nothing persistent: params/opt_state stay on the owner (the
+    fit/checkpoint surface), programs live in the process-wide
+    ``CompiledProgramCache``.  The engine is dropped on pickle and
+    lazily rebuilt."""
+
+    def __init__(self, owner):
+        from learningorchestra_tpu.train.neural import _param_cast_for
+
+        self.o = owner
+        self.pp = int(owner.pp)
+        self.devices = stage_devices(owner.mesh, self.pp)
+        self._pcast = _param_cast_for(
+            jnp.bfloat16 if owner.compute_dtype == "bfloat16" else None
+        )
+        self._train: _ProgramSet | None = None
+        self._fwd: _ProgramSet | None = None
+        self._placed = False
+        self._stage_s = [0.0] * self.pp
+        self._batch_cost = None  # per-batch aggregate ProgramCost
+
+    # -- placement ------------------------------------------------------------
+
+    def ensure_placed(self) -> None:
+        """Commit the owner's state to the per-stage layout: embed on
+        the first stage device, stage s's subtree on device s, head on
+        the last.  Accepts the stacked SPMD/host layout and splits it;
+        re-entry after placement is a flag check."""
+        if self._placed:
+            return
+        o = self.o
+        if o.params is None:
+            return
+        ep, sp, hp = o.params
+        if not _is_partitioned(sp):
+            sp = tuple(
+                jax.tree_util.tree_map(lambda l, s=s: l[s], sp)
+                for s in range(self.pp)
+            )
+        devs = self.devices
+        ep = jax.device_put(ep, devs[0])
+        sp = tuple(
+            jax.device_put(sp[s], devs[s]) for s in range(self.pp)
+        )
+        hp = jax.device_put(hp, devs[-1])
+        o.params = (ep, sp, hp)
+
+        opt = o.opt_state
+        if opt is not None and _is_partitioned(
+            opt[1] if isinstance(opt, tuple) and len(opt) == 3
+            and not hasattr(opt, "_fields") else None
+        ):
+            oe, osp, oh = opt
+            o.opt_state = (
+                jax.device_put(oe, devs[0]),
+                tuple(
+                    jax.device_put(osp[s], devs[s])
+                    for s in range(self.pp)
+                ),
+                jax.device_put(oh, devs[-1]),
+            )
+        else:
+            # Stacked (or missing) optimizer state can't be split into
+            # per-stage adam counts — re-init fresh moments per
+            # partition (the restore-best contract: moments belong to
+            # the run that makes them).
+            self._init_opt()
+        self._placed = True
+
+    def _init_opt(self) -> None:
+        """Per-partition optimizer states.  optax transforms are
+        leafwise, so P+2 independent states updated once per batch are
+        numerically identical to one stacked state."""
+        o = self.o
+        ep, sp, hp = o.params
+        init = jax.jit(o.optimizer.init)
+        o.opt_state = (
+            init(ep),
+            tuple(init(sp[s]) for s in range(self.pp)),
+            init(hp),
+        )
+
+    # -- compiled-program plumbing -------------------------------------------
+
+    def _cached(self, pset, name, kind, *, module, shapes, builder,
+                donate=None, with_opt=False, cost_args=None):
+        """One program through the process-wide compile cache.  Keys
+        carry the PART identity (kind includes the stage index), so N
+        stages yield N independent, AOT-eligible entries."""
+        from learningorchestra_tpu.train import compile_cache as cc
+        from learningorchestra_tpu.train.neural import (
+            _probe_program_cost,
+        )
+
+        o = self.o
+        key = cc.program_key(
+            f"mpmd:{kind}",
+            module=cc.module_fingerprint(module),
+            optimizer=cc.optimizer_fingerprint(o) if with_opt else None,
+            loss="softmax_ce",
+            dtype=o.compute_dtype,
+            shapes=shapes,
+            mesh=None,
+            donate=donate,
+        )
+        label = f"mpmd:{type(o).__name__}:{kind}"
+
+        def building():
+            fn = builder()
+            if cost_args is not None:
+                # Single-device, collective-free lowering: the probe's
+                # flops/bytes are per-stage honest, and the serialized
+                # executable is AOT-store eligible — the multi-chip
+                # warm-boot carve-out closes here.
+                _probe_program_cost(
+                    key, label, fn, cost_args,
+                    aot_eligible=True,
+                    collectives_excluded=True,
+                )
+            return fn
+
+        fn = cc.get_cache().get_or_build(key, building, label=label)
+        pset.fns[name] = fn
+        pset.keys[name] = key
+        return fn
+
+    def _prepare_train(self, mb_sz: int, seq_len: int,
+                       y_shape: tuple) -> _ProgramSet:
+        sig = (mb_sz, seq_len, tuple(y_shape))
+        if self._train is not None and self._train.sig == sig:
+            return self._train
+        o = self.o
+        pcast = self._pcast
+        embed, stage, head = o._embed, o._stage, o._head
+        loss_fn = o._loss_fn
+        f32 = jnp.float32
+        tree = jax.tree_util
+
+        ep, sp, hp = o.params
+        ep_av, sp_av, hp_av = (
+            _tree_avatars(ep), _tree_avatars(sp[0]), _tree_avatars(hp)
+        )
+        tok_av = jax.ShapeDtypeStruct((mb_sz, seq_len), jnp.int32)
+        h_av = jax.eval_shape(
+            lambda p, t: embed.apply(pcast(p), t), ep_av, tok_av
+        )
+        km_av = jax.ShapeDtypeStruct((mb_sz, seq_len), jnp.bool_)
+        y_av = jax.ShapeDtypeStruct((mb_sz, *y_shape), jnp.int32)
+        m_av = jax.ShapeDtypeStruct((mb_sz,), f32)
+        logits_av = jax.eval_shape(
+            lambda p, h: head.apply(pcast(p), h), hp_av, h_av
+        )
+        _, metrics_av = jax.eval_shape(
+            lambda l, y, m: loss_fn(l.astype(f32), y, m),
+            logits_av, y_av, m_av,
+        )
+        scalar_av = jax.ShapeDtypeStruct((), f32)
+
+        def embed_fwd(p, tok):
+            return embed.apply(pcast(p), tok)
+
+        def embed_bwd(p, tok, dh, acc):
+            _, vjp = jax.vjp(lambda q: embed.apply(pcast(q), tok), p)
+            (dp_,) = vjp(dh)
+            return tree.tree_map(jnp.add, acc, dp_)
+
+        def stage_fwd(p, x, km):
+            return stage.apply(pcast(p), x, km)
+
+        def stage_bwd(p, x, km, cot, acc):
+            # Rematerialize-in-backward: re-apply the stage under vjp
+            # on the SAVED input — the same FLOPs-for-HBM trade the
+            # SPMD 1F1B schedule makes.
+            _, vjp = jax.vjp(
+                lambda q, xx: stage.apply(pcast(q), xx, km), p, x
+            )
+            dp_, dx = vjp(cot)
+            return tree.tree_map(jnp.add, acc, dp_), dx
+
+        def head_bwd(p, h, y, m, inv_gw, acc, macc, wacc):
+            def head_loss(q, hh):
+                logits = head.apply(pcast(q), hh).astype(f32)
+                return loss_fn(logits, y, m)
+
+            loss_m, vjp, metrics_m = jax.vjp(
+                head_loss, p, h, has_aux=True
+            )
+            del loss_m  # metrics carry "loss"; accumulated below
+            w_m = m.sum().astype(f32)
+            # Seed = w_m/gw: the stitched gradient equals the gradient
+            # of the SPMD schedules' global masked-mean loss.
+            dp_, dh = vjp(w_m * inv_gw)
+            acc = tree.tree_map(jnp.add, acc, dp_)
+            macc = tree.tree_map(
+                lambda a, v: a + w_m * v, macc, metrics_m
+            )
+            return dh, acc, macc, wacc + w_m
+
+        def zeros_like_tree(p):
+            return tree.tree_map(jnp.zeros_like, p)
+
+        def head_zeros(p):
+            return (
+                tree.tree_map(jnp.zeros_like, p),
+                tree.tree_map(
+                    lambda a: jnp.zeros(a.shape, a.dtype), metrics_av
+                ),
+                jnp.zeros((), f32),
+            )
+
+        def finalize(macc, wacc):
+            gw = jnp.maximum(wacc, 1e-9)
+            return tree.tree_map(lambda v: v / gw, macc)
+
+        def opt_step(p, s_, g):
+            # f32 master weights; grads come back f32 through the
+            # cast-inside-vjp, the astype is the neural.py contract.
+            g = tree.tree_map(
+                lambda gg, pp_: gg.astype(pp_.dtype), g, p
+            )
+            updates, s_ = o.optimizer.update(g, s_, p)
+            return optax.apply_updates(p, updates), s_
+
+        oe, osp, oh = (
+            o.opt_state if o.opt_state is not None
+            else (None, (None,) * self.pp, None)
+        )
+        pset = _ProgramSet(sig)
+        mb = (mb_sz, seq_len)
+        self._cached(
+            pset, "embed:fwd", "embed:fwd", module=embed, shapes=mb,
+            builder=lambda: jax.jit(embed_fwd),
+            cost_args=lambda: (ep_av, tok_av),
+        )
+        self._cached(
+            pset, "embed:bwd", "embed:bwd", module=embed, shapes=mb,
+            donate=(3,),
+            builder=lambda: jax.jit(embed_bwd, donate_argnums=(3,)),
+            cost_args=lambda: (ep_av, tok_av, h_av, ep_av),
+        )
+        self._cached(
+            pset, "embed:zeros", "embed:zeros", module=embed, shapes=mb,
+            builder=lambda: jax.jit(zeros_like_tree),
+            cost_args=lambda: (ep_av,),
+        )
+        if oe is not None:
+            self._cached(
+                pset, "embed:opt", "embed:opt", module=embed, shapes=mb,
+                with_opt=True, donate=(0, 1, 2),
+                builder=lambda: jax.jit(
+                    opt_step, donate_argnums=(0, 1, 2)
+                ),
+                cost_args=lambda: (ep_av, _tree_avatars(oe), ep_av),
+            )
+        for s in range(self.pp):
+            self._cached(
+                pset, ("stage:fwd", s), f"stage:fwd:s{s}", module=stage,
+                shapes=mb,
+                builder=lambda: jax.jit(stage_fwd),
+                cost_args=lambda: (sp_av, h_av, km_av),
+            )
+            self._cached(
+                pset, ("stage:bwd", s), f"stage:bwd:s{s}", module=stage,
+                shapes=mb, donate=(4,),
+                builder=lambda: jax.jit(
+                    stage_bwd, donate_argnums=(4,)
+                ),
+                cost_args=lambda: (sp_av, h_av, km_av, h_av, sp_av),
+            )
+            self._cached(
+                pset, ("stage:zeros", s), f"stage:zeros:s{s}",
+                module=stage, shapes=mb,
+                builder=lambda: jax.jit(zeros_like_tree),
+                cost_args=lambda: (sp_av,),
+            )
+            if osp[s] is not None:
+                self._cached(
+                    pset, ("stage:opt", s), f"stage:opt:s{s}",
+                    module=stage, shapes=mb, with_opt=True,
+                    donate=(0, 1, 2),
+                    builder=lambda: jax.jit(
+                        opt_step, donate_argnums=(0, 1, 2)
+                    ),
+                    cost_args=lambda: (
+                        sp_av, _tree_avatars(osp[0]), sp_av
+                    ),
+                )
+        self._cached(
+            pset, "head:bwd", "head:bwd", module=head, shapes=mb,
+            donate=(5, 6, 7),
+            builder=lambda: jax.jit(
+                head_bwd, donate_argnums=(5, 6, 7)
+            ),
+            cost_args=lambda: (
+                hp_av, h_av, y_av, m_av, scalar_av, hp_av, metrics_av,
+                scalar_av,
+            ),
+        )
+        self._cached(
+            pset, "head:zeros", "head:zeros", module=head, shapes=mb,
+            builder=lambda: jax.jit(head_zeros),
+            cost_args=lambda: (hp_av,),
+        )
+        self._cached(
+            pset, "head:finalize", "head:finalize", module=head,
+            shapes=mb,
+            builder=lambda: jax.jit(finalize),
+            cost_args=lambda: (metrics_av, scalar_av),
+        )
+        if oh is not None:
+            self._cached(
+                pset, "head:opt", "head:opt", module=head, shapes=mb,
+                with_opt=True, donate=(0, 1, 2),
+                builder=lambda: jax.jit(
+                    opt_step, donate_argnums=(0, 1, 2)
+                ),
+                cost_args=lambda: (hp_av, _tree_avatars(oh), hp_av),
+            )
+        self._train = pset
+        self._batch_cost = self._aggregate_batch_cost(pset)
+        return pset
+
+    # -- the 1F1B host schedule ----------------------------------------------
+
+    def train_batch(self, xb: np.ndarray, yb: np.ndarray,
+                    mask: np.ndarray):
+        """One optimizer step over one global batch, scheduled 1F1B
+        across the stage devices.  Enqueue order is the schedule:
+        dispatch is async and each device drains its queue FIFO, so
+        tick t's stage-s forward is in flight while the tick-(t-1)
+        activation hop lands on stage s+1.  Returns the DEVICE metrics
+        dict and the batch's real-row weight — the owner's
+        ``_weighted_update`` consumes both unchanged."""
+        o = self.o
+        self.ensure_placed()
+        if o.opt_state is None:  # restore-best dropped the moments
+            self._init_opt()
+        P = self.pp
+        M = int(o.n_micro)
+        B = xb.shape[0]
+        mb_sz = B // M
+        yb = np.asarray(yb)
+        pset = self._prepare_train(mb_sz, xb.shape[1], yb.shape[1:])
+        fns = pset.fns
+        devs = self.devices
+        clock = time.perf_counter
+
+        xm = np.asarray(xb, np.int32).reshape(M, mb_sz, *xb.shape[1:])
+        ym = yb.astype(np.int32).reshape(M, mb_sz, *yb.shape[1:])
+        mm = np.asarray(mask, np.float32).reshape(M, mb_sz)
+        km = xm != 0  # (M, mb, T) pad id 0
+        gw = float(mask.sum())
+        inv_gw = jax.device_put(
+            np.float32(1.0 / max(gw, 1e-9)), devs[-1]
+        )
+
+        tok = [jax.device_put(xm[i], devs[0]) for i in range(M)]
+        km_d = [
+            [jax.device_put(km[i], devs[s]) for i in range(M)]
+            for s in range(P)
+        ]
+        y_d = [jax.device_put(ym[i], devs[-1]) for i in range(M)]
+        w_d = [jax.device_put(mm[i], devs[-1]) for i in range(M)]
+
+        ep, sp, hp = o.params
+        oe, osp, oh = o.opt_state
+        sp = list(sp)
+        osp = list(osp)
+        acc_e = fns["embed:zeros"](ep)
+        acc_s = [fns[("stage:zeros", s)](sp[s]) for s in range(P)]
+        acc_h, macc, wacc = fns["head:zeros"](hp)
+
+        saved = [[None] * M for _ in range(P)]  # stage inputs (remat)
+        inbox = [[None] * M for _ in range(P)]  # activations arriving
+        cotbox = [[None] * M for _ in range(P)]  # cotangents arriving
+        dh_seed = [None] * M
+
+        stage_s = self._stage_s
+        for t in range(M + 2 * P - 2):
+            # ---- forward slots: stage s runs microbatch t - s ----
+            for s in range(P):
+                m = t - s
+                if not 0 <= m < M:
+                    continue
+                t0 = clock()
+                if s == 0:
+                    x_in = fns["embed:fwd"](ep, tok[m])
+                else:
+                    x_in = inbox[s][m]
+                    inbox[s][m] = None
+                saved[s][m] = x_in
+                out = fns[("stage:fwd", s)](sp[s], x_in, km_d[s][m])
+                if s + 1 < P:
+                    nxt = jax.device_put(out, devs[s + 1])
+                    stage_s[s] += clock() - t0
+                    inbox[s + 1][m] = nxt
+                else:
+                    # 1F1B: the head+loss VJP seeds microbatch m's
+                    # cotangent the very tick its forward completes.
+                    dh, acc_h, macc, wacc = fns["head:bwd"](
+                        hp, out, y_d[m], w_d[m], inv_gw,
+                        acc_h, macc, wacc,
+                    )
+                    dh_seed[m] = dh
+                    stage_s[s] += clock() - t0
+            # ---- backward slots: stage s runs microbatch
+            # t - 2P + 2 + s (last stage first — its seed is fresh) ---
+            for s in range(P - 1, -1, -1):
+                m = t - 2 * P + 2 + s
+                if not 0 <= m < M:
+                    continue
+                t0 = clock()
+                if s == P - 1:
+                    cot = dh_seed[m]
+                    dh_seed[m] = None
+                else:
+                    cot = cotbox[s][m]
+                    cotbox[s][m] = None
+                x_saved = saved[s][m]
+                saved[s][m] = None
+                acc_s[s], dx = fns[("stage:bwd", s)](
+                    sp[s], x_saved, km_d[s][m], cot, acc_s[s]
+                )
+                if s > 0:
+                    cotbox[s - 1][m] = jax.device_put(dx, devs[s - 1])
+                else:
+                    acc_e = fns["embed:bwd"](ep, tok[m], dx, acc_e)
+                stage_s[s] += clock() - t0
+
+        t0 = clock()
+        ep, oe = fns["embed:opt"](ep, oe, acc_e)
+        stage_s[0] += clock() - t0
+        for s in range(P):
+            t0 = clock()
+            sp[s], osp[s] = fns[("stage:opt", s)](sp[s], osp[s],
+                                                  acc_s[s])
+            stage_s[s] += clock() - t0
+        t0 = clock()
+        hp, oh = fns["head:opt"](hp, oh, acc_h)
+        metrics = fns["head:finalize"](macc, wacc)
+        stage_s[P - 1] += clock() - t0
+
+        o.params = (ep, tuple(sp), hp)
+        o.opt_state = (oe, tuple(osp), oh)
+        return metrics, gw
+
+    # -- inference ------------------------------------------------------------
+
+    def forward_logits(self, chunk: np.ndarray):
+        """Sequential forward across the stage devices (inference
+        needs no microbatch schedule): tokens to stage 0, activations
+        hop stage to stage, logits land on the last device."""
+        o = self.o
+        self.ensure_placed()
+        sig = ("fwd", chunk.shape)
+        if self._fwd is None or self._fwd.sig != sig:
+            pcast = self._pcast
+            embed, stage, head = o._embed, o._stage, o._head
+            ep, sp, hp = o.params
+            tok_av = jax.ShapeDtypeStruct(chunk.shape, jnp.int32)
+            km_av = jax.ShapeDtypeStruct(chunk.shape, jnp.bool_)
+            h_av = jax.eval_shape(
+                lambda p, t: embed.apply(pcast(p), t),
+                _tree_avatars(ep), tok_av,
+            )
+            pset = _ProgramSet(sig)
+            self._cached(
+                pset, "embed:fwd", "embed:fwd", module=embed,
+                shapes=chunk.shape,
+                builder=lambda: jax.jit(
+                    lambda p, t: embed.apply(pcast(p), t)
+                ),
+                cost_args=lambda: (_tree_avatars(ep), tok_av),
+            )
+            for s in range(self.pp):
+                self._cached(
+                    pset, ("stage:fwd", s), f"stage:fwd:s{s}",
+                    module=stage, shapes=chunk.shape,
+                    builder=lambda: jax.jit(
+                        lambda p, x, km: stage.apply(pcast(p), x, km)
+                    ),
+                    cost_args=lambda: (
+                        _tree_avatars(sp[0]), h_av, km_av
+                    ),
+                )
+            self._cached(
+                pset, "head:fwd", "head:fwd", module=head,
+                shapes=chunk.shape,
+                builder=lambda: jax.jit(
+                    lambda p, h: head.apply(pcast(p), h)
+                ),
+                cost_args=lambda: (_tree_avatars(hp), h_av),
+            )
+            self._fwd = pset
+        fns = self._fwd.fns
+        devs = self.devices
+        ep, sp, hp = o.params
+        tok = jax.device_put(np.asarray(chunk, np.int32), devs[0])
+        km = jax.device_put(chunk != 0, devs[0])
+        h = fns["embed:fwd"](ep, tok)
+        for s in range(self.pp):
+            if s > 0:
+                h = jax.device_put(h, devs[s])
+                km = jax.device_put(np.asarray(chunk != 0), devs[s])
+            h = fns[("stage:fwd", s)](sp[s], h, km)
+        return fns["head:fwd"](hp, jax.device_put(h, devs[-1]))
+
+    # -- observability --------------------------------------------------------
+
+    def pop_stage_seconds(self) -> list[float]:
+        """Per-stage host dispatch seconds accumulated since the last
+        call — the owner turns these into ``mpmd.stage`` trace spans
+        once per epoch."""
+        out = list(self._stage_s)
+        self._stage_s = [0.0] * self.pp
+        return out
+
+    def _aggregate_batch_cost(self, pset):
+        """One ProgramCost for a whole batch: per-microbatch program
+        costs × n_micro plus the once-per-batch optimizer/finalize
+        programs.  Collectives are excluded BY CONSTRUCTION — no MPMD
+        program contains one — so job MFU from this number is honest
+        for multi-chip fits."""
+        from learningorchestra_tpu.obs import costs as obs_costs
+
+        if not obs_costs.enabled():
+            return None
+        ledger = obs_costs.get_ledger()
+        M = int(self.o.n_micro)
+        per_micro = ["embed:fwd", "embed:bwd", "head:bwd"] + [
+            (k, s) for s in range(self.pp)
+            for k in ("stage:fwd", "stage:bwd")
+        ]
+        per_batch = (
+            ["embed:opt", "head:opt", "head:finalize"]
+            + [("stage:opt", s) for s in range(self.pp)]
+        )
+        flops = 0.0
+        nbytes = 0.0
+        analyzed = False
+        for name, mult in (
+            [(n, M) for n in per_micro] + [(n, 1) for n in per_batch]
+        ):
+            key = pset.keys.get(name)
+            cost = ledger.get(key) if key else None
+            if cost is None or not cost.analyzed:
+                continue
+            analyzed = True
+            flops += (cost.flops or 0.0) * mult
+            nbytes += (cost.bytes_accessed or 0.0) * mult
+        if not analyzed:
+            return None
+        return obs_costs.ProgramCost(
+            key=f"mpmd:batch:{pset.keys.get('head:bwd', '')[:12]}",
+            label=f"mpmd:{type(self.o).__name__}:batch",
+            flops=flops or None,
+            bytes_accessed=nbytes or None,
+            analyzed=True,
+            collectives_excluded=True,
+        )
+
+    def attribute_epoch(self, epoch_s: float, n_batches: int) -> None:
+        """One epoch's device interval into the per-job ledger with
+        the aggregate MPMD flops attached (collectives excluded)."""
+        from learningorchestra_tpu.obs import costs as obs_costs
+
+        cost = self._batch_cost
+        if cost is None or not obs_costs.enabled():
+            return
+        try:
+            import dataclasses
+
+            obs_costs.attribute(
+                epoch_s,
+                cost=dataclasses.replace(
+                    cost,
+                    flops=(cost.flops or 0.0) * n_batches or None,
+                    bytes_accessed=(
+                        (cost.bytes_accessed or 0.0) * n_batches
+                        or None
+                    ),
+                ),
+            )
+        except Exception:  # noqa: BLE001 — accounting never fails a fit
+            pass
+
+    def epoch_cost_attrs(self, epoch_s: float,
+                         n_batches: int) -> dict:
+        """flops/MFU span annotations mirroring neural.py's
+        ``_epoch_cost_attrs`` for the per-epoch trace span."""
+        from learningorchestra_tpu.obs import costs as obs_costs
+
+        cost = self._batch_cost
+        if cost is None or cost.flops is None:
+            return {}
+        flops = cost.flops * n_batches
+        attrs = {"flops": flops, "collectivesExcluded": True}
+        try:
+            util = obs_costs.mfu(
+                flops, epoch_s, peak_flops=obs_costs.peak_flops()
+            )
+        except Exception:  # noqa: BLE001
+            util = None
+        if util is not None:
+            attrs["mfu"] = util
+        return attrs
+
+    # -- stage-partitioned checkpoints ---------------------------------------
+
+    def save_checkpoint(self, directory, step: int, history: dict,
+                        *, async_save: bool = True) -> None:
+        """One orbax directory per partition, then ONE top-level
+        marker.  Async saves overlap the P+2 device→host transfers;
+        the marker publishes only after every partition commits, so
+        the journal's top-level ``latest.json`` wait (and a resuming
+        fit) never sees a torn multi-stage checkpoint."""
+        from learningorchestra_tpu.train import checkpoint as ckpt
+
+        self.ensure_placed()
+        o = self.o
+        if o.opt_state is None:  # restore-best dropped the moments
+            self._init_opt()
+        d = Path(directory)
+        for name, part, opt in self._parts():
+            ckpt.save(
+                d / name, step, {"params": part, "opt_state": opt},
+                history=None, async_save=async_save,
+            )
+        if async_save:
+            for name in partition_names(self.pp):
+                ckpt.finalize_async(d / name)
+        ckpt.publish_marker(d, step, history)
+
+    def resume_checkpoint(self, directory):
+        """Restore every partition from the newest COMMON step.  Each
+        partition dir carries its own marker; the resume step is the
+        minimum — a SIGKILL between partition saves resumes from the
+        last step every stage completed.  Returns ``(step, history)``
+        or None."""
+        from learningorchestra_tpu.train import checkpoint as ckpt
+
+        self.ensure_placed()
+        o = self.o
+        if o.params is None:
+            return None
+        d = Path(directory)
+        names = partition_names(self.pp)
+        steps = []
+        for name in names:
+            marker = d / name / "latest.json"
+            if not marker.exists():
+                return None
+            try:
+                steps.append(
+                    int(json.loads(marker.read_text())["step"])
+                )
+            except (ValueError, KeyError, json.JSONDecodeError):
+                return None
+        step = min(steps)
+        if o.opt_state is None:
+            self._init_opt()
+        restored = []
+        for (name, part, opt) in self._parts():
+            template = {"params": part, "opt_state": opt}
+            state = ckpt.load_step(d / name, step, template)
+            if state is None:
+                # The common step was pruned in one partition (KEEP
+                # window) — resume has nothing consistent to offer.
+                return None
+            restored.append(state)
+        ep_s, *st_s, hp_s = restored
+        # Orbax restores onto the default device; re-commit every
+        # partition to ITS stage device or the first post-resume
+        # dispatch mixes devices inside one jitted call.
+        devs = self.devices
+        o.params = (
+            jax.device_put(ep_s["params"], devs[0]),
+            tuple(
+                jax.device_put(s["params"], devs[i])
+                for i, s in enumerate(st_s)
+            ),
+            jax.device_put(hp_s["params"], devs[-1]),
+        )
+        o.opt_state = (
+            jax.device_put(ep_s["opt_state"], devs[0]),
+            tuple(
+                jax.device_put(s["opt_state"], devs[i])
+                for i, s in enumerate(st_s)
+            ),
+            jax.device_put(hp_s["opt_state"], devs[-1]),
+        )
+        history: dict = {}
+        top = d / "latest.json"
+        if top.exists():
+            try:
+                marker = json.loads(top.read_text())
+                if int(marker.get("step", -1)) == step:
+                    history = marker.get("history") or {}
+            except (ValueError, json.JSONDecodeError):
+                history = {}
+        return step, history
+
+    def finalize_checkpoints(self, directory) -> None:
+        from learningorchestra_tpu.train import checkpoint as ckpt
+
+        d = Path(directory)
+        for name in partition_names(self.pp):
+            ckpt.finalize_async(d / name)
+
+    def _parts(self):
+        """(name, params, opt_state) per partition, pipeline order —
+        matches :func:`partition_names`."""
+        o = self.o
+        ep, sp, hp = o.params
+        oe, osp, oh = (
+            o.opt_state if o.opt_state is not None
+            else (None, (None,) * self.pp, None)
+        )
+        yield "embed", ep, oe
+        for s in range(self.pp):
+            yield f"stage_{s:02d}", sp[s], osp[s]
+        yield "head", hp, oh

@@ -408,6 +408,10 @@ class PipelinedTransformer:
     ``num_layers`` must divide evenly into ``pp`` stages.
     """
 
+    # Opt in to the executor's managed checkpoint-dir injection so a
+    # service-path fit checkpoints (and SIGKILL-resumes) per stage.
+    supports_managed_checkpoints = True
+
     def __init__(
         self,
         vocab_size: int = 20000,
@@ -424,8 +428,10 @@ class PipelinedTransformer:
         mesh: Mesh | None = None,
         pp: int | None = None,
         compute_dtype: str = "bfloat16",
-        schedule: str = "gpipe",  # 'gpipe' | '1f1b'
+        schedule: str | None = None,  # 'gpipe' | '1f1b' | 'mpmd'
     ):
+        from learningorchestra_tpu.config import get_config
+
         self.vocab_size = vocab_size
         self.hidden_dim = hidden_dim
         self.num_layers = num_layers
@@ -437,11 +443,20 @@ class PipelinedTransformer:
         self.learning_rate = learning_rate
         self.seed = seed
         self.compute_dtype = compute_dtype
-        if schedule not in ("gpipe", "1f1b"):
+        mpmd_cfg = get_config().mpmd
+        if schedule is None:
+            # Deployment-default schedule (LO_TPU_MPMD_SCHEDULE): lets
+            # an operator flip a fleet to MPMD dispatch without every
+            # client spelling the parameter.
+            schedule = mpmd_cfg.schedule or "gpipe"
+        if schedule not in ("gpipe", "1f1b", "mpmd"):
             raise ValueError(
-                f"schedule must be 'gpipe' or '1f1b', got {schedule!r}"
+                "schedule must be 'gpipe', '1f1b' or 'mpmd', "
+                f"got {schedule!r}"
             )
         self.schedule = schedule
+        if n_microbatches is None and mpmd_cfg.n_micro > 0:
+            n_microbatches = mpmd_cfg.n_micro
         if mesh is None:
             n = jax.device_count()
             if pp is not None:
@@ -467,6 +482,10 @@ class PipelinedTransformer:
             )
         self.n_micro = n_microbatches or 2 * self.pp
         self.optimizer = optax.adam(learning_rate)
+        # Declarative spec → per-stage MPMD optimizer programs share
+        # compile-cache entries ACROSS jobs (an opaque-object key never
+        # matches another instance's; compile_cache.py).
+        self._optimizer_spec = {"name": "adam"}
 
         causal = head == "lm"
         out_dim = vocab_size if head == "lm" else num_classes
@@ -486,8 +505,19 @@ class PipelinedTransformer:
         self._step = None
         self._oracle = None
         self._seq_fwd = None
+        self._mpmd = None
 
     # -- init -----------------------------------------------------------------
+
+    def _engine(self):
+        """The MPMD host dispatcher (parallel/mpmd.py), built lazily —
+        it holds Device handles and cached program refs, so it drops on
+        pickle and rebuilds here on first use."""
+        if self._mpmd is None:
+            from learningorchestra_tpu.parallel.mpmd import MPMDEngine
+
+            self._mpmd = MPMDEngine(self)
+        return self._mpmd
 
     def _init_params(self, x0: jnp.ndarray) -> None:
         k0, k1, k2 = jax.random.split(jax.random.PRNGKey(self.seed), 3)
@@ -498,6 +528,13 @@ class PipelinedTransformer:
             lambda k: self._stage.init(k, h0, km0)
         )(jax.random.split(k1, self.pp))
         hp = self._head.init(k2, h0)
+        if self.schedule == "mpmd":
+            # Stage-partitioned layout: the engine splits the stacked
+            # stage stack, commits each partition to its stage device,
+            # and inits per-partition optimizer states.
+            self.params = (ep, sp, hp)
+            self._engine().ensure_placed()
+            return
         self.params = self._place_params((ep, sp, hp))
         self.opt_state = jax.jit(
             self.optimizer.init,
@@ -627,7 +664,12 @@ class PipelinedTransformer:
         dicts and each batch's real-row weight — callers device_get at
         their own granularity (per epoch in-memory, per shard when
         streaming) so tunnel round-trips stay amortized."""
+        mpmd = self.schedule == "mpmd"
+        engine = self._engine() if mpmd else None
         metrics_list, weights = [], []
+        # Accumulates across calls (streaming fits pass one shard per
+        # call); the epoch loops zero it per epoch for attribution.
+        self._epoch_batches = getattr(self, "_epoch_batches", 0)
         for lo in range(0, len(order), batch_size):
             idx = order[lo: lo + batch_size]
             if len(idx) < batch_size:
@@ -639,13 +681,22 @@ class PipelinedTransformer:
                 ])
             else:
                 mask = np.ones(batch_size, np.float32)
-            self.params, self.opt_state, m = self._step(
-                self.params, self.opt_state,
-                jnp.asarray(xs[idx]), jnp.asarray(ys[idx]),
-                jnp.asarray(mask),
-            )
-            metrics_list.append(m)
-            weights.append(float(mask.sum()))
+            if mpmd:
+                # Host-dispatched 1F1B over per-stage programs; the
+                # engine mutates params/opt_state in place of the
+                # donate-and-reassign the jitted step does.
+                m, w = engine.train_batch(xs[idx], ys[idx], mask)
+                metrics_list.append(m)
+                weights.append(w)
+            else:
+                self.params, self.opt_state, m = self._step(
+                    self.params, self.opt_state,
+                    jnp.asarray(xs[idx]), jnp.asarray(ys[idx]),
+                    jnp.asarray(mask),
+                )
+                metrics_list.append(m)
+                weights.append(float(mask.sum()))
+            self._epoch_batches += 1
         return metrics_list, weights
 
     @staticmethod
@@ -664,6 +715,96 @@ class PipelinedTransformer:
         if "perplexity" in row:  # raw CE until post-mean exp
             row["perplexity"] = float(np.exp(row["perplexity"]))
         return row
+
+    # -- shared fit plumbing --------------------------------------------------
+
+    def _batch_quantum(self) -> int:
+        """Smallest legal global batch: n_micro microbatches, times
+        the dp replication for the SPMD schedules.  MPMD ignores dp —
+        one device per stage, scale via bigger microbatches."""
+        if self.schedule == "mpmd":
+            return self.n_micro
+        return self.n_micro * (
+            self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
+        )
+
+    def _ckpt_resume(self, checkpoint_dir) -> int:
+        """Resume from ``checkpoint_dir`` if it holds a checkpoint;
+        returns the epoch to continue from (0 = fresh).  MPMD resumes
+        every stage partition from its newest COMMON step
+        (parallel/mpmd.py); the SPMD schedules restore the single
+        stacked state."""
+        if self.schedule == "mpmd":
+            loaded = self._engine().resume_checkpoint(checkpoint_dir)
+            if loaded is None:
+                return 0
+            step, past_history = loaded
+            self.history = TrainHistory(past_history)
+            return step
+        from learningorchestra_tpu.train import checkpoint as ckpt
+
+        loaded = ckpt.resume_or_none(
+            checkpoint_dir,
+            {"params": self.params, "opt_state": self.opt_state},
+        )
+        if loaded is None:
+            return 0
+        state, step, past_history = loaded
+        self._restore_placed(state)
+        self.history = TrainHistory(past_history)
+        return step
+
+    def _ckpt_save(self, checkpoint_dir, step: int,
+                   *, async_save: bool) -> None:
+        if self.schedule == "mpmd":
+            self._engine().save_checkpoint(
+                checkpoint_dir, step, dict(self.history),
+                async_save=async_save,
+            )
+            return
+        from learningorchestra_tpu.train import checkpoint as ckpt
+
+        opt_state = self.opt_state
+        if opt_state is None:
+            # restore-best dropped the moments: checkpoint the
+            # restored params with FRESH moments, else resume=True
+            # would replay the last periodic save's pre-restore params
+            # (same contract as train/neural.py).
+            opt_state = jax.jit(self.optimizer.init)(self.params)
+            self.opt_state = opt_state
+        ckpt.save(
+            checkpoint_dir, step,
+            {"params": self.params, "opt_state": opt_state},
+            history=dict(self.history),
+            async_save=async_save,
+        )
+
+    def _ckpt_finalize(self, checkpoint_dir) -> None:
+        from learningorchestra_tpu.train import checkpoint as ckpt
+
+        if self.schedule == "mpmd":
+            self._engine().finalize_checkpoints(checkpoint_dir)
+        ckpt.finalize_async(checkpoint_dir)
+
+    def _record_epoch_obs(self, epoch_i: int, epoch_s: float) -> None:
+        """Per-epoch trace spans + device-time attribution.  MPMD adds
+        one ``mpmd.stage`` span per pipeline stage (host dispatch
+        seconds — where the schedule spent its enqueue time) and books
+        the epoch against the job cost ledger with the aggregate
+        per-stage flops, collectives excluded."""
+        from learningorchestra_tpu.obs import tracing
+
+        attrs: dict = {}
+        if self.schedule == "mpmd" and self._mpmd is not None:
+            engine = self._mpmd
+            n_batches = getattr(self, "_epoch_batches", 0)
+            engine.attribute_epoch(epoch_s, n_batches)
+            attrs = engine.epoch_cost_attrs(epoch_s, n_batches)
+            for s, secs in enumerate(engine.pop_stage_seconds()):
+                tracing.record_span(
+                    "mpmd.stage", secs, stage=s, epoch=epoch_i
+                )
+        tracing.record_span("epoch", epoch_s, epoch=epoch_i, **attrs)
 
     # -- keras-fit surface ----------------------------------------------------
 
@@ -690,9 +831,8 @@ class PipelinedTransformer:
             build_stop_callbacks,
         )
 
-        callbacks = build_stop_callbacks(
-            self, callbacks, early_stopping, allow_restore=False
-        )
+        callbacks = build_stop_callbacks(self, callbacks,
+                                         early_stopping)
         if _is_sharded(x) or _is_sharded(y):
             return self._fit_streaming(
                 x, y, epochs=epochs, batch_size=batch_size,
@@ -708,28 +848,18 @@ class PipelinedTransformer:
         # Global batch must split into n_micro microbatches that split
         # over dp; round it DOWN to the nearest legal multiple (never
         # below one quantum) so the effective batch fits the request.
-        dp = self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
-        quantum = self.n_micro * dp
+        quantum = self._batch_quantum()
         batch_size = max(quantum, (batch_size // quantum) * quantum)
         if self.params is None:
             self._init_params(jnp.asarray(x[:1]))
-        if self._step is None:
+        if self._step is None and self.schedule != "mpmd":
             self._build()
 
         start_epoch = 0
         if checkpoint_dir and resume:
-            from learningorchestra_tpu.train import checkpoint as ckpt
+            start_epoch = self._ckpt_resume(checkpoint_dir)
 
-            loaded = ckpt.resume_or_none(
-                checkpoint_dir,
-                {"params": self.params, "opt_state": self.opt_state},
-            )
-            if loaded is not None:
-                state, step, past_history = loaded
-                self._restore_placed(state)
-                self.history = TrainHistory(past_history)
-                start_epoch = step
-
+        from learningorchestra_tpu import faults
         from learningorchestra_tpu.train import checkpoint as ckpt_mod
 
         last_save = time.monotonic()
@@ -748,6 +878,9 @@ class PipelinedTransformer:
                     # early stop.
                     self.stop_training = True
                     break
+                faults.hit("train.epoch")
+                t0 = time.perf_counter()
+                self._epoch_batches = 0
                 order = rng.permutation(n) if shuffle else np.arange(n)
                 totals: dict = {}
                 wsum = self._weighted_update(
@@ -755,6 +888,9 @@ class PipelinedTransformer:
                 )
                 epoch_row = self._finish_row(totals, wsum)
                 self.history.append(epoch_row)
+                self._record_epoch_obs(
+                    epoch_i, time.perf_counter() - t0
+                )
                 if verbose:
                     print(f"pipeline epoch: {self.history['loss'][-1]:.4f}",
                           flush=True)
@@ -766,11 +902,8 @@ class PipelinedTransformer:
                     checkpoint_min_interval_s, last_save,
                     stopped=self.stop_training,
                 ):
-                    ckpt_mod.save(
+                    self._ckpt_save(
                         checkpoint_dir, epoch_i + 1,
-                        {"params": self.params,
-                         "opt_state": self.opt_state},
-                        history=dict(self.history),
                         async_save=checkpoint_async,
                     )
                     last_save = time.monotonic()
@@ -780,7 +913,7 @@ class PipelinedTransformer:
             if checkpoint_dir:
                 # The last async save must be durable when fit
                 # returns — exception paths included.
-                ckpt_mod.finalize_async(checkpoint_dir)
+                self._ckpt_finalize(checkpoint_dir)
         return self
 
     def _fit_streaming(
@@ -800,29 +933,18 @@ class PipelinedTransformer:
         # dataset (same contract as NeuralEstimator).
         self._sharded_fit_cols = list(x.cols)
         ds = x.dataset
-        quantum = self.n_micro * (
-            self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
-        )
+        quantum = self._batch_quantum()
         batch_size = max(quantum, (batch_size // quantum) * quantum)
         if self.params is None:
             self._init_params(jnp.asarray(np.asarray(x.head(1))))
-        if self._step is None:
+        if self._step is None and self.schedule != "mpmd":
             self._build()
 
         start_epoch = 0
         if checkpoint_dir and resume:
-            from learningorchestra_tpu.train import checkpoint as ckpt
+            start_epoch = self._ckpt_resume(checkpoint_dir)
 
-            loaded = ckpt.resume_or_none(
-                checkpoint_dir,
-                {"params": self.params, "opt_state": self.opt_state},
-            )
-            if loaded is not None:
-                state, step, past_history = loaded
-                self._restore_placed(state)
-                self.history = TrainHistory(past_history)
-                start_epoch = step
-
+        from learningorchestra_tpu import faults
         from learningorchestra_tpu.train import checkpoint as ckpt_mod
 
         def load(k: int):
@@ -840,6 +962,9 @@ class PipelinedTransformer:
                         # Same contract as the in-memory loop.
                         self.stop_training = True
                         break
+                    faults.hit("train.epoch")  # see in-memory loop
+                    t0 = time.perf_counter()
+                    self._epoch_batches = 0
                     order = (
                         np.random.default_rng(
                             [self.seed, 3, epoch_i]
@@ -870,6 +995,9 @@ class PipelinedTransformer:
                         )
                     epoch_row = self._finish_row(totals, wsum)
                     self.history.append(epoch_row)
+                    self._record_epoch_obs(
+                        epoch_i, time.perf_counter() - t0
+                    )
                     if verbose:
                         print(
                             "pipeline epoch: "
@@ -884,11 +1012,8 @@ class PipelinedTransformer:
                         checkpoint_min_interval_s, last_save,
                         stopped=self.stop_training,
                     ):
-                        ckpt_mod.save(
+                        self._ckpt_save(
                             checkpoint_dir, epoch_i + 1,
-                            {"params": self.params,
-                             "opt_state": self.opt_state},
-                            history=dict(self.history),
                             async_save=checkpoint_async,
                         )
                         last_save = time.monotonic()
@@ -896,7 +1021,7 @@ class PipelinedTransformer:
                         break
             finally:
                 if checkpoint_dir:
-                    ckpt_mod.finalize_async(checkpoint_dir)
+                    self._ckpt_finalize(checkpoint_dir)
         return self
 
     _CHUNK = 512  # inference batch: fixed shape -> one compile
@@ -906,6 +1031,17 @@ class PipelinedTransformer:
         inference needs no microbatch schedule, and chunking keeps
         activations O(chunk) instead of O(dataset) while the fixed
         chunk shape compiles once."""
+        if self.schedule == "mpmd":
+            engine = self._engine()
+            for lo in range(0, len(x), self._CHUNK):
+                chunk = x[lo: lo + self._CHUNK]
+                n = len(chunk)
+                if n < self._CHUNK:  # pad to the compiled shape
+                    chunk = np.pad(
+                        chunk, ((0, self._CHUNK - n), (0, 0))
+                    )
+                yield np.asarray(engine.forward_logits(chunk))[:n]
+            return
         if self._seq_fwd is None:
             def fwd(params, xb):
                 ep, sp, hp = params
@@ -1003,6 +1139,7 @@ class PipelinedTransformer:
         self._step = None
         self._oracle = None
         self._seq_fwd = None
+        self._mpmd = None  # host state → engine re-places on next use
 
     def __getstate__(self):
         """dill support (the model service persists instances): drop
@@ -1012,6 +1149,7 @@ class PipelinedTransformer:
         d["_step"] = None
         d["_oracle"] = None
         d["_seq_fwd"] = None
+        d["_mpmd"] = None
         d["mesh"] = None
         d["_mesh_shape"] = dict(self.mesh.shape) \
             if self.mesh is not None else None

@@ -39,6 +39,12 @@ class FleetManager:
         # deployment default LO_TPU_FLEET_MAX would fleet it); an
         # absent key falls back to the deployment default.
         self._bounds: dict[str, tuple[int, int] | None] = {}
+        # Per-model chips-per-replica overrides (POST body
+        # ``devicesPerReplica``); absent falls back to the deployment
+        # default LO_TPU_FLEET_DEVICES_PER_REPLICA.  Fixed while a set
+        # is live — changing the shard width means re-placing every
+        # replica, so configure() rejects it until a dissolve.
+        self._shards: dict[str, int] = {}
         self._lock = make_lock("FleetManager._lock")
         # Per-model creation coalescing (the ModelRegistry idiom): a
         # set is only REGISTERED once its first replica is placed, so
@@ -169,6 +175,7 @@ class FleetManager:
                 max_replicas=mx,
                 lease_timeout_s=self.cfg.lease_timeout_s,
                 router_seed=self.cfg.router_seed,
+                devices_per_replica=self.devices_per_replica(name),
                 # getattr: test stubs provide only the dispatch seam.
                 warmup=(
                     self.service.replica_warmup_factory(name)
@@ -260,8 +267,20 @@ class FleetManager:
 
     # -- control surface -----------------------------------------------------
 
+    def devices_per_replica(self, name: str) -> int:
+        """Chips each of ``name``'s replicas leases: the per-model
+        override, else the deployment default."""
+        with self._lock:
+            override = self._shards.get(name)
+        if override is not None:
+            return override
+        return max(1, int(getattr(
+            self.cfg, "devices_per_replica", 1
+        )))
+
     def configure(self, name: str, *, min_replicas=None,
-                  max_replicas=None, count=None) -> dict:
+                  max_replicas=None, count=None,
+                  devices_per_replica=None) -> dict:
         """The POST /serve/<model>/replicas body: set bounds and/or a
         manual replica count (clamped to the bounds).  Pins the model
         resident — a bad name 404s here, before any chip is leased."""
@@ -284,6 +303,23 @@ class FleetManager:
             raise ValidationError(
                 f"replica count must be >= 1, got {count}"
             )
+        if devices_per_replica is not None:
+            dpr = int(devices_per_replica)
+            if dpr < 1:
+                raise ValidationError(
+                    "devicesPerReplica must be >= 1, got "
+                    f"{devices_per_replica}"
+                )
+            with self._lock:
+                live = self._sets.get(name)
+                if (live is not None
+                        and live.devices_per_replica != dpr):
+                    raise ValidationError(
+                        "devicesPerReplica is fixed while a replica "
+                        f"set is live ({live.devices_per_replica}); "
+                        "dissolve the fleet first"
+                    )
+                self._shards[name] = dpr
         self.service.registry.get(name)  # 404 before leasing anything
         with self._lock:
             self._bounds[name] = (mn, mx)
@@ -369,6 +405,7 @@ class FleetManager:
                 self._cancel_create.add(name)
             if not keep_bounds:
                 self._bounds.pop(name, None)
+                self._shards.pop(name, None)
                 self._scale_totals.pop(name, None)
         self.autoscaler.forget(name)
         if rs is not None:
@@ -415,6 +452,7 @@ class FleetManager:
         return {
             "model": name, "replicas": [], "size": 0,
             "min": bounds[0], "max": bounds[1],
+            "devicesPerReplica": self.devices_per_replica(name),
             "scaleUps": 0, "scaleDowns": 0,
         }
 

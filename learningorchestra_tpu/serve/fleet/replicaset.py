@@ -68,24 +68,75 @@ def _stats_delta(final: dict, pre: dict) -> dict:
     return delta
 
 
+def _shard_tree(params, devs):
+    """Place a param tree across a multi-chip slice: one-axis GSPMD
+    mesh, leading-dim sharding where the dim divides the slice size,
+    replication elsewhere.  Returns ``(placed_tree, spec)`` where
+    ``spec`` describes the layout (status surface) and carries the
+    replicated input sharding under the private ``"_repl"`` key."""
+    import jax
+    import numpy as _np
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    n = len(devs)
+    mesh = Mesh(_np.array(devs), ("shard",))
+    repl = NamedSharding(mesh, P())
+    counts = {"sharded": 0, "replicated": 0}
+
+    def put(leaf):
+        if (getattr(leaf, "ndim", 0) >= 1
+                and leaf.shape[0] >= n and leaf.shape[0] % n == 0):
+            counts["sharded"] += 1
+            return jax.device_put(
+                leaf, NamedSharding(mesh, P("shard"))
+            )
+        counts["replicated"] += 1
+        return jax.device_put(leaf, repl)
+
+    placed = jax.tree_util.tree_map(put, params)
+    spec = {
+        "axis": "shard", "devices": n,
+        "strategy": "leading-dim",
+        "shardedLeaves": counts["sharded"],
+        "replicatedLeaves": counts["replicated"],
+        "_repl": repl,
+    }
+    return placed, spec
+
+
 class Replica:
     """One routable copy of a served model: chip lease + batcher +
-    per-device parameter placement."""
+    per-device parameter placement.
+
+    A replica may hold MORE than one chip (``devices_per_replica`` on
+    the set): the lease then carries the whole slice and ``place``
+    shards the parameter tree across it with a one-axis GSPMD mesh —
+    leaves whose leading dim divides evenly split along it, the rest
+    replicate.  The router/autoscaler/pre-warm never look inside: a
+    sharded replica is one routable unit with one batcher, exactly
+    like a single-chip one."""
 
     __slots__ = (
-        "model", "idx", "device_id", "batcher", "created_at",
-        "warmed", "_handle", "_jax_device", "_device_resolved",
-        "_placed",
+        "model", "idx", "device_id", "devices", "shard_spec",
+        "batcher", "created_at", "warmed", "_handle", "_jax_device",
+        "_jax_devices", "_device_resolved", "_placed",
     )
 
     def __init__(self, model: str, idx: int, handle):
         self.model = model
         self.idx = idx
         self._handle = handle
-        self.device_id: str | None = (
-            handle.devices[0] if handle is not None and handle.devices
-            else None
+        self.devices: list[str] = (
+            list(handle.devices) if handle is not None else []
         )
+        self.device_id: str | None = (
+            self.devices[0] if self.devices else None
+        )
+        # Populated on first multi-chip placement: how the param tree
+        # landed on the slice (surfaced via GET /serve/<m>/replicas).
+        self.shard_spec: dict | None = None
+        self._jax_devices: list | None = None
         self.created_at = time.time()
         # True once the pre-warm dispatches (hot bucket set) completed
         # before the replica became routable; False means it serves
@@ -100,31 +151,48 @@ class Replica:
         self._placed: tuple | None = None
 
     def place(self, entry, x):
-        """(params, inputs) for this replica's device, from the HOST
-        input array — one host→device transfer, never a bounce
+        """(params, inputs) for this replica's device(s), from the
+        HOST input array — one host→device transfer, never a bounce
         through the default device.  Unplaced replicas (CPU backend,
         unresolvable id) share the registry's resident tree — zero
         extra memory, shared executables (jit converts host inputs
-        itself)."""
+        itself).
+
+        Multi-chip leases shard instead of copy: the param tree lands
+        on a one-axis mesh over the slice (leaves split along the
+        leading dim when it divides, replicated otherwise) and the
+        input is replicated — ``jax.jit`` then runs the bucket program
+        under GSPMD across the slice, so a model too big for one
+        chip's HBM still serves as ONE routable replica."""
         if not self._device_resolved:
             self._device_resolved = True
-            if self.device_id is not None:
+            if self.devices:
                 from learningorchestra_tpu.jobs.leases import (
                     jax_device_for,
                 )
 
-                self._jax_device = jax_device_for(self.device_id)
-        dev = self._jax_device
-        if dev is None:
+                resolved = [jax_device_for(d) for d in self.devices]
+                if all(d is not None for d in resolved):
+                    self._jax_devices = resolved
+                    self._jax_device = resolved[0]
+        devs = self._jax_devices
+        if devs is None:
             return entry.params, x
         import jax
 
+        if len(devs) == 1:
+            cached = self._placed
+            if cached is None or cached[0] is not entry:
+                self._placed = cached = (
+                    entry, jax.device_put(entry.params, devs[0])
+                )
+            return cached[1], jax.device_put(x, devs[0])
         cached = self._placed
         if cached is None or cached[0] is not entry:
-            self._placed = cached = (
-                entry, jax.device_put(entry.params, dev)
-            )
-        return cached[1], jax.device_put(x, dev)
+            placed, spec = _shard_tree(entry.params, devs)
+            self.shard_spec = spec
+            self._placed = cached = (entry, placed, spec["_repl"])
+        return cached[1], jax.device_put(x, cached[2])
 
     def release(self) -> None:
         self._placed = None
@@ -133,9 +201,15 @@ class Replica:
 
     def status(self) -> dict:
         stats = self.batcher.stats() if self.batcher is not None else {}
+        spec = self.shard_spec
         return {
             "replica": self.idx,
             "device": self.device_id or "host",
+            "devices": self.devices or ["host"],
+            "shardSpec": (
+                {k: v for k, v in spec.items() if not k.startswith("_")}
+                if spec is not None else None
+            ),
             "createdAt": self.created_at,
             "requests": stats.get("requests", 0),
             "queueDepth": stats.get("queueDepth", 0),
@@ -167,11 +241,17 @@ class ReplicaSet:
         lease_timeout_s: float = 5.0,
         router_seed: int = 0,
         warmup: Callable[[Replica], None] | None = None,
+        devices_per_replica: int = 1,
     ):
         if not 1 <= min_replicas <= max_replicas:
             raise ValueError(
                 f"need 1 <= min ({min_replicas}) <= max "
                 f"({max_replicas})"
+            )
+        if int(devices_per_replica) < 1:
+            raise ValueError(
+                "devices_per_replica must be >= 1, got "
+                f"{devices_per_replica}"
             )
         self.name = name
         self._cfg = serve_cfg
@@ -184,6 +264,12 @@ class ReplicaSet:
         self._warmup = warmup
         self.min_replicas = int(min_replicas)
         self.max_replicas = int(max_replicas)
+        # Chips per replica: > 1 turns every lease into a multi-chip
+        # slice and every replica into a GSPMD-sharded one (models
+        # bigger than one chip's HBM).  Fixed for the set's lifetime —
+        # changing it means re-placing every param tree, i.e. a new
+        # set.
+        self.devices_per_replica = int(devices_per_replica)
         self.lease_timeout_s = float(lease_timeout_s)
         import zlib
 
@@ -284,7 +370,8 @@ class ReplicaSet:
         # never contain "@" — a job named "serve" expiring its
         # deadline must not force-free every fleet replica's chip.
         handle = self._leaser.acquire(
-            1, label=f"serve@{self.name}:r{idx}",
+            self.devices_per_replica,
+            label=f"serve@{self.name}:r{idx}",
             timeout=self.lease_timeout_s,
         )
         replica = Replica(self.name, idx, handle)
@@ -552,6 +639,7 @@ class ReplicaSet:
             "size": len(replicas),
             "min": self.min_replicas,
             "max": self.max_replicas,
+            "devicesPerReplica": self.devices_per_replica,
             "scaleUps": self.scale_ups,
             "scaleDowns": self.scale_downs,
         }

@@ -260,10 +260,15 @@ class ServingService:
                 # Cost probe on the build-once path (the one shared
                 # wrapper, train/neural.py): the bucket's flops/HBM
                 # land in the program ledger, so every later dispatch
-                # attributes with real numerators.
+                # attributes with real numerators.  The lowering runs
+                # on host avatars with no mesh — collective-free by
+                # construction — so the numbers stay honest when a
+                # SHARDED replica later runs this bucket under GSPMD
+                # (lo_serving_bucket_* must not book collective flops).
                 _probe_program_cost(
                     key, label, jitted,
                     lambda: (entry.params, padded),
+                    collectives_excluded=True,
                 )
                 return jitted
 

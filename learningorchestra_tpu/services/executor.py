@@ -191,7 +191,9 @@ class ExecutorService:
             if (
                 kind in TRAIN_KINDS
                 and method == "fit"
-                and isinstance(instance, NeuralEstimator)
+                and getattr(
+                    instance, "supports_managed_checkpoints", False
+                )
                 and "checkpoint_dir" not in params
             ):
                 # Managed in-loop checkpointing: a FAILED train job

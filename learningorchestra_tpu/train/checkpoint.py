@@ -239,6 +239,38 @@ def load_latest(directory: str | Path, template: dict):
     return state, step, marker.get("history") or {}
 
 
+def load_step(directory: str | Path, step: int, template: dict):
+    """Restore one SPECIFIC step (template-placed, like
+    ``load_latest``), or None when that step directory is absent.  The
+    MPMD fit surface uses this to pull every stage partition back to
+    the newest COMMON step — a crash between partition saves must not
+    resume stages from different epochs."""
+    directory = Path(directory)
+    finalize_async(directory)
+    path = directory / f"step_{step}"
+    if not path.exists():
+        return None
+    with _checkpointer() as ck:
+        return ck.restore(path, template)
+
+
+def publish_marker(directory: str | Path, step: int,
+                   history: dict | None = None) -> None:
+    """Public commit-point writer for fit surfaces that persist state
+    in their OWN sub-layout (MPMD writes one orbax directory per
+    pipeline stage under ``<dir>/<part>/``): the same atomic
+    ``latest.json`` the single-directory path writes, at the top
+    level, AFTER every partition has committed — so the journal's
+    marker wait and a resuming fit see only whole checkpoints.  The
+    prune pass inside ``_publish`` globs ``step_*`` at this level,
+    which a partitioned layout doesn't create."""
+    directory = Path(directory)
+    if _is_primary():
+        directory.mkdir(parents=True, exist_ok=True)
+        _publish(directory, step, history)
+    _barrier(f"ckpt-marker-{step}")
+
+
 def resume_or_none(directory, template: dict):
     """``load_latest`` with configuration-mismatch errors translated to
     an actionable message — the shared resume front door for every fit

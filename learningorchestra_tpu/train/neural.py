@@ -175,15 +175,15 @@ class TrainHistory(dict):
             self.setdefault(key, []).append(float(val))
 
 
-def build_stop_callbacks(owner, callbacks, early_stopping,
-                         *, allow_restore: bool = True) -> list:
+def build_stop_callbacks(owner, callbacks, early_stopping) -> list:
     """Shared fit-surface plumbing: normalize the callback list, fold
     in an ``early_stopping`` spec, reset reused EarlyStopping
-    instances, and clear ``owner.stop_training``.  The pipelined
-    surface passes ``allow_restore=False`` — its stage-partitioned
-    state has no rollback wired; the single-device AND mesh-sharded
-    fits both support restore-best (the latter snapshots device-side,
-    sharding preserved — parallel/distributed.py)."""
+    instances, and clear ``owner.stop_training``.  Every fit surface
+    supports ``restoreBestWeights`` now: single-device and mesh-
+    sharded fits snapshot device-side with sharding preserved
+    (parallel/distributed.py), and stage-partitioned pipeline state
+    snapshots leaf-by-leaf with each leaf's own placement preserved
+    (:func:`snapshot_params`)."""
     owner.stop_training = False
     cbs = list(callbacks or [])
     # False is the natural JSON off-toggle mirroring True — disabled,
@@ -192,13 +192,6 @@ def build_stop_callbacks(owner, callbacks, early_stopping,
         cbs.append(EarlyStopping.from_spec(early_stopping))
     for cb in cbs:
         if isinstance(cb, EarlyStopping):
-            if cb.restore_best_weights and not allow_restore:
-                raise ValueError(
-                    "restoreBestWeights is not supported on this fit "
-                    "surface (pipeline-stage-partitioned state); use "
-                    "the single-device or mesh-sharded fit, or drop "
-                    "the flag"
-                )
             cb.reset()
     return cbs
 
@@ -211,18 +204,20 @@ def snapshot_params(params):
 
     Eager ``jnp.copy`` rejects non-fully-addressable arrays (a
     multi-host mesh's fsdp/tp shards live on other hosts), so the copy
-    runs under ONE cached jit: each leaf copies following its own
-    sharding, which covers host numpy trees, single-device arrays and
-    global sharded arrays alike.  Every process of a multi-controller
-    fit issues the same call in the same order (callbacks run the same
-    loop on every host), the SPMD requirement.
+    runs under one cached jit PER LEAF: each leaf copies following its
+    own sharding/placement, which covers host numpy trees,
+    single-device arrays, global sharded arrays — and stage-PARTITIONED
+    pipeline trees whose leaves are committed to different devices (a
+    single whole-tree jit would reject a computation spanning devices;
+    leaf-wise, every stage's weights snapshot on their own chip).
+    Every process of a multi-controller fit issues the same calls in
+    the same order (callbacks run the same loop on every host), the
+    SPMD requirement.
     """
     global _SNAPSHOT_FN
     if _SNAPSHOT_FN is None:
-        _SNAPSHOT_FN = jax.jit(
-            lambda t: jax.tree_util.tree_map(jnp.copy, t)
-        )
-    return _SNAPSHOT_FN(params)
+        _SNAPSHOT_FN = jax.jit(jnp.copy)
+    return jax.tree_util.tree_map(_SNAPSHOT_FN, params)
 
 
 class EarlyStopping:
@@ -594,10 +589,14 @@ def _cached_program(
 
 
 def _probe_program_cost(key, label, fn, cost_args, *,
-                        aot_eligible: bool = True) -> None:
+                        aot_eligible: bool = True,
+                        collectives_excluded: bool = False) -> None:
     """Best-effort XLA cost analysis for a just-built program; a
     failed probe (opaque callable, exotic arg tree) must never fail
-    the build it rides."""
+    the build it rides.  ``collectives_excluded=True`` marks probes
+    whose lowering is collective-free by construction (single-device
+    MPMD stage programs, host-avatar serve probes) so downstream MFU
+    math knows the flops are pure compute."""
     from learningorchestra_tpu.obs import costs as obs_costs
 
     if not obs_costs.enabled():
@@ -606,6 +605,7 @@ def _probe_program_cost(key, label, fn, cost_args, *,
         obs_costs.analyze_jitted(
             key, label, fn, tuple(cost_args()),
             aot_eligible=aot_eligible,
+            collectives_excluded=collectives_excluded,
         )
     except Exception:  # noqa: BLE001
         pass
@@ -784,6 +784,11 @@ def build_resident_epoch_fns(
 
 class NeuralEstimator(Estimator):
     """Wraps a Flax module with fit/evaluate/predict/save/load."""
+
+    # The executor injects the managed checkpoint dir (and resume
+    # semantics) into ``fit`` for any estimator that declares this —
+    # the pipeline model mirrors the surface without subclassing.
+    supports_managed_checkpoints = True
 
     def __init__(
         self,

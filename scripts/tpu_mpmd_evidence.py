@@ -1,0 +1,38 @@
+"""On-box MPMD pipeline evidence: run bench._mpmd_probe and print its
+JSON — per-stage programs host-dispatched under 1F1B vs the same math
+as one monolithic jitted program.  Short stage (~2-5 min): banks the
+re-fit cold-compile advantage (per-stage compile-cache entries hit
+with zero misses while a fresh monolithic wrapper re-pays its
+whole-pipeline compile) and the steady-state host-dispatch overhead
+bound the README section quotes.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from bench import _mpmd_probe  # noqa: E402
+
+
+def main() -> None:
+    result = {"mpmd": _mpmd_probe()}
+    probe = result["mpmd"]
+    ratio = probe["steady_overhead_ratio"]
+    misses = probe["refit_misses"]
+    # Loud verdict line for the watch log; the JSON is the record.
+    # Acceptance: a re-fit hits every per-stage cache entry (zero
+    # misses) and the host 1F1B loop stays within 10% of the
+    # monolithic step at steady state.
+    ok = misses == 0 and ratio is not None and ratio <= 1.10
+    print(
+        f"mpmd refit misses {misses}, steady overhead {ratio}x "
+        f"({'OK' if ok else 'REGRESSION: misses > 0 or > 1.10x'})",
+        file=sys.stderr, flush=True,
+    )
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

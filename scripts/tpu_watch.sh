@@ -60,6 +60,7 @@ STAGES=(
   "scripts/tpu_obs_evidence.py:300"
   "scripts/tpu_flight_evidence.py:300"
   "scripts/tpu_warmboot_evidence.py:300"
+  "scripts/tpu_mpmd_evidence.py:300"
   "scripts/tpu_decode_evidence.py:300"
   "scripts/tpu_cluster_evidence.py:300"
   "scripts/tpu_recovery_smoke.py:600"

@@ -27,6 +27,7 @@ STAGES = [
     ("tpu_obs_evidence", "Observability overhead probe"),
     ("tpu_flight_evidence", "Flight-recorder append-cost probe"),
     ("tpu_warmboot_evidence", "Warm-boot probe (AOT cache vs cold trace)"),
+    ("tpu_mpmd_evidence", "MPMD pipeline probe (per-stage programs vs monolithic)"),
     ("tpu_decode_evidence", "Streaming decode probe (continuous batching vs solo)"),
     ("tpu_cluster_evidence",
      "Control-plane claim-path probe (share of a minimal dispatch)"),
